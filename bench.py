@@ -1,0 +1,89 @@
+"""Benchmark: GPT-base (124M) bf16 training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no in-repo numbers (BASELINE.md), so vs_baseline is
+reported against BASELINE.json's empty "published" table as 1.0 when the run
+succeeds; the absolute tokens/sec (and derived MFU) is the tracked number.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.engine import ParallelTrainer
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.text.models import GPTForPretraining
+
+    paddle.seed(0)
+    n_dev = len(jax.devices())
+    build_mesh({"data": 1})
+
+    vocab, hidden, layers, heads, seq = 50304, 768, 12, 12, 1024
+    batch = 8
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:  # CPU smoke config
+        vocab, hidden, layers, heads, seq, batch = 1024, 256, 2, 4, 256, 4
+
+    model = GPTForPretraining(
+        tensor_parallel=False, vocab_size=vocab, hidden_size=hidden,
+        num_layers=layers, num_heads=heads, max_position_embeddings=seq,
+        attn_dropout=0.0, hidden_dropout=0.0)
+    model.bfloat16()
+
+    opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters())
+
+    def loss_fn(logits, labels):
+        return nn.functional.cross_entropy(
+            logits.astype(jnp.float32), labels)
+
+    trainer = ParallelTrainer(model, opt, loss_fn)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (batch, seq)).astype("int32")
+    labels = rng.randint(0, vocab, (batch, seq)).astype("int32")
+
+    # warmup (compile + flush; NOTE: under the axon tunnel
+    # block_until_ready returns early — a host fetch is the reliable sync)
+    for _ in range(12):
+        loss = trainer.train_step(ids, labels)
+    float(loss)
+
+    iters = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.train_step(ids, labels)
+    final_loss = float(loss)  # device->host sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_tok = 6 * n_params
+    mfu = None
+    if on_tpu:
+        peak = 197e12  # v5e bf16 peak FLOP/s
+        mfu = tokens_per_sec * flops_per_tok / peak
+
+    result = {
+        "metric": "gpt_base_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": 1.0,
+    }
+    if mfu is not None:
+        result["mfu"] = round(mfu, 4)
+        result["params"] = n_params
+        result["final_loss"] = round(final_loss, 4)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

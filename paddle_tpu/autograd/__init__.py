@@ -1,0 +1,158 @@
+"""Autograd surface.
+
+The reference has a taping autograd engine (imperative/basic_engine.cc:305)
+that walks recorded grad-ops when ``loss.backward()`` is called. JAX's
+functional autodiff replaces the tape: gradients come from tracing a pure
+function. This module provides:
+
+- ``backward(layer, loss_closure, *inputs)`` — the imperative bridge: compute
+  grads of the closure w.r.t. the layer's parameters and store them on
+  ``Parameter.grad`` so ``optimizer.step()`` works like the reference's
+  dygraph loop (CS-2 in SURVEY.md §3).
+- ``grad`` — functional jax.grad with paddle-flavored signature.
+- ``no_grad`` — context/decorator parity (a no-op under functional autodiff,
+  kept so reference code ports line-for-line; stop_gradient is the real
+  mechanism).
+- ``PyLayer`` — custom fwd/bwd pairs (reference: python/paddle/autograd/
+  py_layer.py:192) lowered onto jax.custom_vjp.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+from collections import OrderedDict
+
+import jax
+
+from ..jit.functionalization import functional_call, state_of
+
+
+def backward(layer, forward_closure, retain_graph=False):
+    """Compute d loss / d params for ``loss = forward_closure()`` where the
+    closure reads the layer's current parameters; store grads on ``p.grad``
+    (accumulating, like the reference's gradient accumulator).
+    """
+    params, buffers = state_of(layer)
+    trainable = {n: p for n, p in layer.named_parameters() if p.trainable}
+
+    def pure(train_params):
+        merged = dict(params)
+        merged.update(train_params)
+        with _swap(layer, merged):
+            loss = forward_closure()
+        return loss
+
+    grads = jax.grad(pure)({n: p.value for n, p in trainable.items()})
+    for n, p in trainable.items():
+        g = grads[n]
+        p.grad = g if p.grad is None else p.grad + g
+
+
+@contextlib.contextmanager
+def _swap(layer, params):
+    boxes = OrderedDict(layer.named_parameters())
+    saved = {n: b.value for n, b in boxes.items()}
+    try:
+        for n, v in params.items():
+            if n in boxes:
+                boxes[n].value = v
+        yield
+    finally:
+        for n, v in saved.items():
+            boxes[n].value = v
+
+
+def grad(outputs=None, inputs=None, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None, func=None, argnums=0):
+    """Functional gradient. Two forms:
+
+    - ``grad(func=f, argnums=0)`` → jax.grad(f, argnums)
+    - ``grad(outputs=f, inputs=xs)`` where ``outputs`` is a callable taking
+      ``inputs`` (list of arrays) → list of grads, mirroring paddle.grad's
+      output-list shape.
+    """
+    if func is not None:
+        return jax.grad(func, argnums=argnums)
+    if not callable(outputs):
+        raise TypeError(
+            "paddle_tpu.grad requires `outputs` to be a callable of `inputs` "
+            "(functional autodiff replaces the reference's recorded tape); "
+            "wrap the forward computation in a function.")
+    xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+
+    def scalarized(args):
+        out = outputs(*args)
+        if isinstance(out, (list, tuple)):
+            out = sum(o.sum() for o in out)
+        elif hasattr(out, "sum") and getattr(out, "ndim", 0) > 0:
+            out = out.sum()
+        return out
+
+    gs = jax.grad(scalarized)(list(xs))
+    return list(gs)
+
+
+class no_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """Custom op with user forward/backward (reference:
+    python/paddle/autograd/py_layer.py:192), implemented on jax.custom_vjp.
+
+    class Cube(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x ** 3
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor
+            return 3 * x ** 2 * dy
+
+    y = Cube.apply(x)
+    """
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        @jax.custom_vjp
+        def fn(*a):
+            ctx = PyLayerContext()
+            return cls.forward(ctx, *a, **kwargs)
+
+        def fwd(*a):
+            ctx = PyLayerContext()
+            out = cls.forward(ctx, *a, **kwargs)
+            return out, ctx
+
+        def bwd(ctx, dy):
+            gs = cls.backward(ctx, dy)
+            return gs if isinstance(gs, tuple) else (gs,)
+
+        fn.defvjp(fwd, bwd)
+        return fn(*args)
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError

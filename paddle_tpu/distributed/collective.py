@@ -1,0 +1,240 @@
+"""Collective communication API (reference: python/paddle/distributed/
+collective.py — new_group:209, all_reduce:415, all_gather:589, broadcast:348,
+reduce:495, scatter:667, barrier:167; C++ kernels operators/collective/c_*).
+
+TPU-native semantics: a Group is a *named mesh axis*. Inside a
+shard_map/pjit-traced region the ops lower to XLA collectives over ICI/DCN
+(lax.psum / all_gather / ppermute / all_to_all); the reference's stream-sync
+ops (c_sync_calc_stream etc.) have no equivalent because XLA schedules
+communication. Outside a traced region (plain eager call, world_size 1) they
+are identity — matching the reference's single-card fast path.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .mesh import get_mesh
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = a mesh axis (+ optional rank subset)."""
+
+    def __init__(self, axis_name: str, ranks: Optional[List[int]] = None,
+                 gid: int = 0):
+        self.axis_name = axis_name
+        self.ranks = ranks
+        self.id = gid
+
+    @property
+    def nranks(self):
+        from .mesh import axis_size
+        if self.ranks is not None:
+            return len(self.ranks)
+        return axis_size(self.axis_name)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if self.ranks else rank
+
+    def __repr__(self):
+        return f"Group(axis={self.axis_name!r}, nranks={self.nranks})"
+
+
+_GLOBAL_GROUP = Group("data", gid=0)
+_groups = {0: _GLOBAL_GROUP}
+_next_gid = 1
+
+
+def get_group(gid: int = 0) -> Group:
+    return _groups[gid]
+
+
+def new_group(ranks=None, backend=None, axis_name: str = "data") -> Group:
+    global _next_gid
+    g = Group(axis_name, ranks=list(ranks) if ranks else None, gid=_next_gid)
+    _groups[_next_gid] = g
+    _next_gid += 1
+    return g
+
+
+def split_group(origin_group, split_sizes):
+    out = []
+    start = 0
+    ranks = origin_group.ranks or list(range(origin_group.nranks))
+    for s in split_sizes:
+        out.append(new_group(ranks[start:start + s],
+                             axis_name=origin_group.axis_name))
+        start += s
+    return out
+
+
+def _axis(group: Optional[Group]) -> str:
+    return (group or _GLOBAL_GROUP).axis_name
+
+
+def in_traced_axis(axis_name: str) -> bool:
+    """True when `axis_name` is bound (inside shard_map/pmap trace)."""
+    try:
+        lax.axis_index(axis_name)
+        return True
+    except Exception:
+        return False
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    ax = _axis(group)
+    if not in_traced_axis(ax):
+        return tensor
+    if op == ReduceOp.SUM:
+        return lax.psum(tensor, ax)
+    if op == ReduceOp.AVG:
+        return lax.pmean(tensor, ax)
+    if op == ReduceOp.MAX:
+        return lax.pmax(tensor, ax)
+    if op == ReduceOp.MIN:
+        return lax.pmin(tensor, ax)
+    if op == ReduceOp.PROD:
+        gathered = lax.all_gather(tensor, ax, axis=0, tiled=False)
+        return jnp.prod(gathered, axis=0)
+    raise ValueError(f"bad op {op}")
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    """Returns the gathered tensor; also appends shards to tensor_list when a
+    list is passed (reference signature compatibility)."""
+    ax = _axis(group)
+    if not in_traced_axis(ax):
+        if isinstance(tensor_list, list):
+            tensor_list.append(tensor)
+        return tensor
+    gathered = lax.all_gather(tensor, ax, axis=axis, tiled=False)
+    if isinstance(tensor_list, list):
+        n = gathered.shape[axis]
+        for i in range(n):
+            tensor_list.append(jnp.take(gathered, i, axis=axis))
+    return gathered
+
+
+def all_gather_concat(tensor, group=None, axis=0):
+    """Gather and concatenate along `axis` (tiled all-gather)."""
+    ax = _axis(group)
+    if not in_traced_axis(ax):
+        return tensor
+    return lax.all_gather(tensor, ax, axis=axis, tiled=True)
+
+
+def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, axis=0):
+    ax = _axis(group)
+    if not in_traced_axis(ax):
+        return tensor
+    return lax.psum_scatter(tensor, ax, scatter_dimension=axis, tiled=True)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    if not in_traced_axis(ax):
+        return tensor
+    # select src's value on every member: gather then index (XLA folds this
+    # into a collective-broadcast)
+    gathered = lax.all_gather(tensor, ax, axis=0, tiled=False)
+    return gathered[src]
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # On SPMD hardware a reduce-to-one is a psum everyone keeps; the
+    # non-dst ranks simply ignore it (same cost on ICI).
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    if not in_traced_axis(ax):
+        return tensor
+    if tensor_list is not None:
+        stacked = jnp.stack(tensor_list, axis=0)
+    else:
+        stacked = tensor
+    idx = lax.axis_index(ax)
+    return lax.dynamic_index_in_dim(stacked, idx, axis=0, keepdims=False)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True,
+             split_axis=0, concat_axis=0):
+    """reference: operators/collective/alltoall_op.cc — the EP building block."""
+    ax = _axis(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        x = jnp.stack(list(in_tensor_list), axis=0)
+        if not in_traced_axis(ax):
+            return list(in_tensor_list)
+        out = lax.all_to_all(x, ax, split_axis=0, concat_axis=0, tiled=False)
+        res = [out[i] for i in range(out.shape[0])]
+        if isinstance(out_tensor_list, list):
+            out_tensor_list.extend(res)
+        return res
+    if not in_traced_axis(ax):
+        return in_tensor_list
+    return lax.all_to_all(in_tensor_list, ax, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P send along a ring (reference: send_v2_op.cc). In SPMD this is a
+    collective_permute shifting +1 along the axis; use ppermute_send/recv
+    pairs via p2p helpers in meta_parallel for pipeline."""
+    ax = _axis(group)
+    if not in_traced_axis(ax):
+        return tensor
+    n = lax.axis_size(ax)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return lax.ppermute(tensor, ax, perm)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    if not in_traced_axis(ax):
+        return tensor
+    n = lax.axis_size(ax)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return lax.ppermute(tensor, ax, perm)
+
+
+def barrier(group=None):
+    """Host barrier. Inside SPMD, XLA's program is already bulk-synchronous;
+    across processes use multihost sync when available."""
+    try:
+        from jax.experimental import multihost_utils
+        if jax.process_count() > 1:
+            multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    except Exception:
+        pass
+
+
+def split(x, num_partitions, axis=0, group=None):
+    """Shard-and-keep-local split (reference: collective.py:1283 split)."""
+    ax = _axis(group)
+    if not in_traced_axis(ax):
+        return x
+    idx = lax.axis_index(ax)
+    size = x.shape[axis] // num_partitions
+    return lax.dynamic_slice_in_dim(x, idx * size, size, axis=axis)
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Stream-sync parity stub: XLA orders communication automatically
+    (reference c_wait_compute/c_wait_comm have no TPU analogue)."""
+    return tensor

@@ -1,0 +1,81 @@
+"""Process/env discovery (reference: fleet/base/role_maker.py env parsing +
+distributed/parallel.py init_parallel_env).
+
+On TPU, rank/world-size come from jax.distributed / jax.process_index rather
+than PADDLE_TRAINER_* env vars; the env vars are still honored for
+subprocess-simulated tests (SURVEY.md §4 TestDistBase translation).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def get_rank() -> int:
+    if "PADDLE_TRAINER_ID" in os.environ:
+        return int(os.environ["PADDLE_TRAINER_ID"])
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size() -> int:
+    if "PADDLE_TRAINERS_NUM" in os.environ:
+        return int(os.environ["PADDLE_TRAINERS_NUM"])
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+class ParallelEnv:
+    """reference: fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_RANK_IN_NODE", get_rank()))
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else ["127.0.0.1:6170"]
+
+
+def init_parallel_env(coordinator_address=None, num_processes=None,
+                      process_id=None):
+    """reference: distributed/parallel.py:58 init_parallel_env.
+
+    Multi-host TPU: jax.distributed.initialize discovers pod topology from
+    TPU metadata (replacing the reference's TCP ncclUniqueId broadcast,
+    gen_comm_id_helper.cc:297).
+    """
+    if get_world_size() > 1 or coordinator_address is not None:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+        except (RuntimeError, ValueError):
+            pass  # already initialized or single-process
+    return ParallelEnv()

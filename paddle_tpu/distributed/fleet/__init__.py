@@ -1,0 +1,174 @@
+"""Fleet API (reference: python/paddle/distributed/fleet/base/fleet_base.py:72
+Fleet, :139 init, :836 distributed_model; DistributedStrategy
+fleet/base/distributed_strategy.py:105).
+
+TPU-native: ``init`` builds the hybrid mesh (data/pipe/sharding/sep/model)
+from DistributedStrategy.hybrid_configs; ``distributed_model`` wraps the
+network per the active degrees (DataParallel / TensorParallel /
+PipelineParallel / ShardingParallel); ``distributed_optimizer`` attaches
+mesh-wide grad clip + DP grad averaging. The 18 static meta-optimizers of
+the reference collapse into sharding annotations + jit options here (AMP →
+amp.auto_cast, Recompute → jax.checkpoint, GradientMerge → accumulate steps,
+DGC/LocalSGD → documented non-goals of XLA SPMD).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .. import env as _env
+from ..mesh import (AXES_ORDER, CommunicateTopology, HybridCommunicateGroup,
+                    build_mesh, get_mesh)
+
+
+@dataclasses.dataclass
+class HybridConfig:
+    dp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sep_degree: int = 1
+
+
+class DistributedStrategy:
+    """reference: fleet/base/distributed_strategy.py:105 (wrapping
+    distributed_strategy.proto). Plain dataclass-style config; serializable
+    via __dict__."""
+
+    def __init__(self):
+        self.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                               "sharding_degree": 1, "sep_degree": 1}
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0, "use_pure_fp16": False,
+                            "use_bf16": True}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.sharding = False
+        self.sharding_configs = {"stage": 1, "offload": False}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+
+class Fleet:
+    def __init__(self):
+        self._is_initialized = False
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._topology: Optional[CommunicateTopology] = None
+        self._user_defined_strategy = None
+
+    # -- init --------------------------------------------------------------
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        degrees = {
+            "data": hc.get("dp_degree", 1),
+            "pipe": hc.get("pp_degree", 1),
+            "sharding": hc.get("sharding_degree", 1),
+            "sep": hc.get("sep_degree", 1),
+            "model": hc.get("mp_degree", 1),
+        }
+        import numpy as np
+        import jax
+        total = int(np.prod(list(degrees.values())))
+        n_dev = len(jax.devices())
+        if total == 1 and n_dev > 1:
+            degrees["data"] = n_dev  # default: DP over all devices
+        build_mesh(degrees)
+        dims = [degrees[a] for a in ("data", "pipe", "sharding", "model")]
+        self._topology = CommunicateTopology(
+            ("data", "pipe", "sharding", "model"), dims)
+        self._hcg = HybridCommunicateGroup(self._topology,
+                                           global_rank=_env.get_rank() %
+                                           max(self._topology.world_size(), 1))
+        self._is_initialized = True
+        return self
+
+    def get_hybrid_communicate_group(self) -> HybridCommunicateGroup:
+        return self._hcg
+
+    # -- info --------------------------------------------------------------
+    def is_first_worker(self):
+        return _env.get_rank() == 0
+
+    def worker_index(self):
+        return _env.get_rank()
+
+    def worker_num(self):
+        return _env.get_world_size()
+
+    def is_worker(self):
+        return True
+
+    def worker_endpoints(self, to_string=False):
+        eps = _env.ParallelEnv().trainer_endpoints
+        return ",".join(eps) if to_string else eps
+
+    def server_num(self):
+        return 0
+
+    def is_server(self):
+        return False
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+    # -- model/optimizer wrapping -----------------------------------------
+    def distributed_model(self, model):
+        """reference: fleet_base.py:836 — dispatch on hybrid degrees."""
+        from ..parallel import DataParallel
+        from ..meta_parallel import (PipelineParallel, ShardingParallel,
+                                     TensorParallel)
+        hcg = self._hcg
+        if hcg is None:
+            self.init()
+            hcg = self._hcg
+        if hcg.get_pipe_parallel_world_size() > 1:
+            return PipelineParallel(model, hcg, self._strategy)
+        if hcg.get_model_parallel_world_size() > 1:
+            return TensorParallel(model, hcg, self._strategy)
+        if hcg.get_sharding_parallel_world_size() > 1:
+            return ShardingParallel(model, hcg, self._strategy)
+        return DataParallel(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from ..meta_parallel.hybrid_optimizer import HybridParallelOptimizer
+        if strategy is not None:
+            self._user_defined_strategy = strategy
+        return HybridParallelOptimizer(optimizer, self._hcg,
+                                       self._strategy or DistributedStrategy())
+
+    # -- checkpoint passthrough -------------------------------------------
+    def save_persistables(self, executor=None, dirname=None, main_program=None):
+        raise NotImplementedError("use paddle_tpu.save / distributed.checkpoint")
+
+
+fleet = Fleet()
+
+# Module-level API mirroring `from paddle.distributed import fleet`
+init = fleet.init
+is_first_worker = fleet.is_first_worker
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_worker = fleet.is_worker
+worker_endpoints = fleet.worker_endpoints
+server_num = fleet.server_num
+is_server = fleet.is_server
+barrier_worker = fleet.barrier_worker
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group

@@ -1,0 +1,189 @@
+"""Device-mesh management.
+
+TPU-native replacement for the reference's 4-D communicator topology
+(reference: fleet/base/topology.py:36 CommunicateTopology, :117
+HybridCommunicateGroup, axis order ["data", "pipe", "sharding", "model"]).
+Instead of NCCL rings per axis-subgroup (collective_helper.h), we build ONE
+jax.sharding.Mesh whose named axes are the hybrid-parallel axes; XLA derives
+every subgroup collective from shardings/axis names.
+
+An extra "sep" (sequence/context-parallel) axis is supported beyond the
+reference — used by ring attention (SURVEY.md §5 long-context gap).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: F401
+
+AXES_ORDER = ("data", "pipe", "sharding", "sep", "model")
+
+
+class _MeshState(threading.local):
+    def __init__(self):
+        self.mesh: Optional[Mesh] = None
+
+
+_state = _MeshState()
+
+
+def build_mesh(degrees: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Create (and set current) a named mesh from per-axis degrees.
+
+    Axis order follows the reference's topology.py ordering so that
+    neighboring ranks in the fastest-varying axis ("model") are
+    ICI-adjacent — TP traffic rides the fastest links, DP the slowest, the
+    same locality reasoning as the reference's ring assignment.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    shape = [int(degrees.get(a, 1)) for a in AXES_ORDER]
+    total = int(np.prod(shape))
+    if total != len(devices):
+        if total < len(devices):
+            devices = devices[:total]
+        else:
+            raise ValueError(f"mesh degrees {degrees} need {total} devices, "
+                             f"have {len(devices)}")
+    arr = np.asarray(devices).reshape(shape)
+    mesh = Mesh(arr, AXES_ORDER)
+    _state.mesh = mesh
+    return mesh
+
+
+def set_mesh(mesh: Mesh):
+    _state.mesh = mesh
+    return mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _state.mesh
+
+
+def require_mesh() -> Mesh:
+    if _state.mesh is None:
+        # default: pure data-parallel over all local devices
+        return build_mesh({"data": len(jax.devices())})
+    return _state.mesh
+
+
+def named_sharding(*spec) -> NamedSharding:
+    return NamedSharding(require_mesh(), P(*spec))
+
+
+def axis_size(axis: str) -> int:
+    mesh = get_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        return 1
+    return mesh.shape[axis]
+
+
+class CommunicateTopology:
+    """reference: fleet/base/topology.py:36 — coordinate math over the mesh."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = None
+        shape = tuple(dims)
+        self._world = int(np.prod(shape))
+        self._coords = {}
+        for rank in range(self._world):
+            self._coords[rank] = tuple(
+                int(x) for x in np.unravel_index(rank, shape))
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **kwargs):
+        coords = tuple(kwargs[n] for n in self._parallel_names)
+        return int(np.ravel_multi_index(coords, tuple(self._dims)))
+
+    def get_coord(self, rank):
+        return self._coords[rank]
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        return [r for r, c in self._coords.items() if c[axis] == index]
+
+    def get_comm_list(self, axis_name):
+        """All subgroups along `axis_name` (list of rank lists)."""
+        axis = self._parallel_names.index(axis_name)
+        groups = {}
+        for r, c in self._coords.items():
+            key = tuple(v for i, v in enumerate(c) if i != axis)
+            groups.setdefault(key, []).append(r)
+        return [sorted(v) for _, v in sorted(groups.items())]
+
+
+class HybridCommunicateGroup:
+    """reference: fleet/base/topology.py:117 — per-rank view of the 4-D mesh.
+
+    On TPU the "groups" are mesh axes; this object provides the reference's
+    rank/degree queries for code (pipeline schedules, TP layers) that needs
+    explicit coordinates.
+    """
+
+    def __init__(self, topology: CommunicateTopology, global_rank: int = 0):
+        self._topo = topology
+        self.global_rank = global_rank
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._mp_degree = topology.get_dim("model")
+        coord = topology.get_coord(global_rank)
+        names = topology.get_hybrid_group_names()
+        self._coord = dict(zip(names, coord))
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_rank(self):
+        return self._coord["data"]
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_rank(self):
+        return self._coord["model"]
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_stage_id(self):
+        return self._coord["pipe"]
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_rank(self):
+        return self._coord["sharding"]
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_next_rank(self):
+        names = self._topo.get_hybrid_group_names()
+        coords = dict(self._coord)
+        coords["pipe"] = (coords["pipe"] + 1) % self._pp_degree
+        return self._topo.get_rank(**coords)
+
+    def get_p2p_prev_rank(self):
+        coords = dict(self._coord)
+        coords["pipe"] = (coords["pipe"] - 1) % self._pp_degree
+        return self._topo.get_rank(**coords)

@@ -1,0 +1,11 @@
+"""Hybrid-parallel wrappers (reference: fleet/meta_parallel/)."""
+from .parallel_layers.mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding)
+from .parallel_layers.pp_layers import (  # noqa: F401
+    LayerDesc, PipelineLayer, SegmentLayers, SharedLayerDesc)
+from .tensor_parallel import TensorParallel  # noqa: F401
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .sharding_parallel import ShardingParallel  # noqa: F401
+from .hybrid_optimizer import (  # noqa: F401
+    HybridParallelGradScaler, HybridParallelOptimizer)

@@ -1,0 +1,84 @@
+"""HybridParallelOptimizer (reference:
+fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:89 —
+wraps the inner optimizer so grad clip norms span the WHOLE hybrid mesh, and
+DP-axis grad averaging happens before the update).
+
+TPU-native: installs a psum-over-axes hook on ClipGradByGlobalNorm and
+averages grads over "data" (and "sharding") axes inside the jitted step.
+"""
+from __future__ import annotations
+
+from jax import lax
+
+from ...optimizer.clip import ClipGradByGlobalNorm
+from ...optimizer.optimizer import Optimizer
+
+
+def _bound_axes(axes):
+    out = []
+    for a in axes:
+        try:
+            lax.axis_index(a)
+            out.append(a)
+        except Exception:
+            pass
+    return out
+
+
+class HybridParallelOptimizer:
+    def __init__(self, inner_opt: Optimizer, hcg=None, strategy=None):
+        self._inner_opt = inner_opt
+        self._hcg = hcg
+        self._strategy = strategy
+        clip = inner_opt._grad_clip
+        if isinstance(clip, ClipGradByGlobalNorm):
+            # The squared-norm must be summed over model/pipe/sharding axes
+            # (each rank holds only its shard of those params) — reference
+            # HybridParallelClipGrad._dygraph_clip.
+            def reduce_fn(total):
+                for ax in _bound_axes(("model", "pipe", "sharding")):
+                    total = lax.psum(total, ax)
+                return total
+
+            clip.norm_reduce_fn = reduce_fn
+
+    # delegate the full Optimizer surface
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    def sync_gradients(self, grads: dict) -> dict:
+        axes = _bound_axes(("data",))
+        if not axes:
+            return grads
+        return {k: None if g is None else lax.pmean(g, axes[0])
+                for k, g in grads.items()}
+
+    def apply_gradients(self, params, grads, state, lr=None, lr_scales=None):
+        grads = self.sync_gradients(grads)
+        return self._inner_opt.apply_gradients(params, grads, state, lr,
+                                               lr_scales)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self):
+        self._inner_opt.clear_grad()
+
+
+class HybridParallelGradScaler:
+    """reference: dygraph_optimizer/hybrid_parallel_gradscaler.py — the
+    found-inf flag must be any-reduced across the mesh so all ranks skip the
+    step together."""
+
+    def __init__(self, scaler, hcg=None):
+        self._scaler = scaler
+        self._hcg = hcg
+
+    def __getattr__(self, name):
+        return getattr(self._scaler, name)
+
+    def unscale_(self, grads):
+        unscaled, found = self._scaler.unscale_(grads)
+        for ax in _bound_axes(("data", "model", "pipe", "sharding")):
+            found = lax.pmax(found.astype("int32"), ax) > 0
+        return unscaled, found

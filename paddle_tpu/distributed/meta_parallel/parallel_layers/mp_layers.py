@@ -1,0 +1,199 @@
+"""Megatron-style tensor-parallel layers (reference:
+fleet/meta_parallel/parallel_layers/mp_layers.py — VocabParallelEmbedding:30,
+ColumnParallelLinear:97, RowParallelLinear:170, ParallelCrossEntropy:249;
+collective kernels c_embedding / c_softmax_with_cross_entropy / c_allreduce).
+
+TPU-native dual path:
+- **GSPMD mode** (default, under pjit): layers hold FULL logical weights with
+  a PartitionSpec on Parameter.pspec; the engine shards them physically via
+  NamedSharding and XLA inserts the collectives. The layer forward adds
+  with_sharding_constraint hints matching the reference's explicit
+  identity/allreduce placement.
+- **shard_map mode** (axis "model" bound): explicit lax collectives, exactly
+  the reference's algebra (column: local matmul [+ all_gather]; row:
+  local matmul + psum; vocab: masked lookup + psum). Used by tests and by
+  the pipeline engine where per-device code is explicit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ....nn import functional as F
+from ....nn.initializer import XavierUniform, _to_initializer
+from ....nn.layer import Layer
+from ...mesh import axis_size, get_mesh
+
+MODEL_AXIS = "model"
+
+
+def _in_shard_map(axis=MODEL_AXIS) -> bool:
+    try:
+        lax.axis_index(axis)
+        return True
+    except Exception:
+        return False
+
+
+def _constraint(x, *spec):
+    mesh = get_mesh()
+    if mesh is None or axis_size(MODEL_AXIS) <= 1:
+        return x
+    try:
+        return lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(mesh, P(*spec)))
+    except Exception:
+        return x
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding sharded over the vocab dim (reference: mp_layers.py:30;
+    kernel operators/collective/c_embedding_op.cu)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            initializer=_to_initializer(weight_attr, None) or XavierUniform())
+        self.weight.pspec = P(MODEL_AXIS, None)
+
+    def forward(self, x):
+        if _in_shard_map():
+            n_shards = lax.axis_size(MODEL_AXIS)
+            per = self.num_embeddings // n_shards
+            rank = lax.axis_index(MODEL_AXIS)
+            start = rank * per
+            local_ids = x - start
+            mask = (local_ids >= 0) & (local_ids < per)
+            safe = jnp.where(mask, local_ids, 0)
+            out = jnp.take(self.weight.value, safe, axis=0)
+            out = out * mask[..., None].astype(out.dtype)
+            return lax.psum(out, MODEL_AXIS)
+        out = F.embedding(x, self.weight)
+        return _constraint(out, None, None, None)
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with output-dim sharding (reference: mp_layers.py:97)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            initializer=_to_initializer(weight_attr, None) or XavierUniform())
+        self.weight.pspec = P(None, MODEL_AXIS)
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            self.bias.pspec = P(MODEL_AXIS)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if _in_shard_map():
+            # weights arrive as local shards inside shard_map
+            y = jnp.matmul(x, self.weight.value)
+            if self.bias is not None:
+                y = y + self.bias.value
+            if self.gather_output:
+                y = lax.all_gather(y, MODEL_AXIS, axis=y.ndim - 1, tiled=True)
+            return y
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return _constraint(y, *([None] * y.ndim))
+        return _constraint(y, *([None] * (y.ndim - 1)), MODEL_AXIS)
+
+
+class RowParallelLinear(Layer):
+    """Linear with input-dim sharding + psum (reference: mp_layers.py:170)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            initializer=_to_initializer(weight_attr, None) or XavierUniform())
+        self.weight.pspec = P(MODEL_AXIS, None)
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if _in_shard_map():
+            if not self.input_is_parallel:
+                # split the replicated input over the model axis
+                n = lax.axis_size(MODEL_AXIS)
+                idx = lax.axis_index(MODEL_AXIS)
+                per = x.shape[-1] // n
+                x = lax.dynamic_slice_in_dim(x, idx * per, per, axis=x.ndim - 1)
+            y = jnp.matmul(x, self.weight.value)
+            y = lax.psum(y, MODEL_AXIS)
+            if self.bias is not None:
+                y = y + self.bias.value
+            return y
+        if self.input_is_parallel:
+            x = _constraint(x, *([None] * (x.ndim - 1)), MODEL_AXIS)
+        y = jnp.matmul(x, self.weight.value)
+        y = _constraint(y, *([None] * y.ndim))
+        if self.bias is not None:
+            y = y + self.bias.value
+        return y
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over class-sharded logits (reference: mp_layers.py:249;
+    kernel c_softmax_with_cross_entropy_op.cu): global max/sumexp via psum —
+    never materializes the gathered logits."""
+
+    def __init__(self, mp_group=None, name=None):
+        super().__init__()
+
+    def forward(self, input, label):
+        if not _in_shard_map():
+            return F.cross_entropy(input, label, reduction="none")
+        n = lax.axis_size(MODEL_AXIS)
+        rank = lax.axis_index(MODEL_AXIS)
+        n_local = input.shape[-1]
+        start = rank * n_local
+        x = input.astype(jnp.float32)
+        local_max = jnp.max(x, axis=-1, keepdims=True)
+        # stability shift needs no gradient (pmax has no JVP rule, so the
+        # stop_gradient must be on the INPUT to keep the tangent symbolically
+        # zero through pmax)
+        gmax = lax.pmax(lax.stop_gradient(local_max), MODEL_AXIS)
+        shifted = x - gmax
+        sumexp = lax.psum(jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True),
+                          MODEL_AXIS)
+        logz = jnp.log(sumexp) + gmax
+        lbl = label.astype(jnp.int32)
+        lbl = lbl[..., 0] if lbl.ndim == x.ndim else lbl
+        local_lbl = lbl - start
+        in_range = (local_lbl >= 0) & (local_lbl < n_local)
+        safe = jnp.where(in_range, local_lbl, 0)
+        picked = jnp.take_along_axis(x, safe[..., None], axis=-1)[..., 0]
+        picked = jnp.where(in_range, picked, 0.0)
+        picked = lax.psum(picked, MODEL_AXIS)
+        return logz[..., 0] - picked
+
+
+class ParallelColumnLinearWithGeluFused(ColumnParallelLinear):
+    """Column linear + GELU in one layer — keeps the activation sharded so
+    GELU runs on 1/mp of the data (XLA fuses it into the matmul epilogue)."""
+
+    def forward(self, x):
+        y = super().forward(x)
+        return F.gelu(y, approximate=True)

@@ -1,0 +1,128 @@
+"""Pipeline layer description (reference:
+fleet/meta_parallel/parallel_layers/pp_layers.py — LayerDesc, SharedLayerDesc:62,
+SegmentLayers:23 uniform/param-count partition, PipelineLayer:76).
+
+TPU-native: PipelineLayer partitions a LayerDesc list into pp_degree stages.
+The SPMD pipeline engine (pipeline_parallel.py) requires the *middle* stages
+to be structurally identical (the classic stacked-stage trick: per-stage
+params carry a leading "pipe" dim sharded over the pipe axis); embedding and
+head live on the first/last stage via the engine's cond-dispatch.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ....nn.layer import Layer
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError(f"{layer_cls} must be a Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Tied layers across stages (reference: pp_layers.py:62 — e.g. embedding
+    weights shared with the LM head). The engine keeps ONE copy of the shared
+    params (replicated over the pipe axis) and psums their grads over the
+    stages that use them — the TPU version of the reference's allreduce over
+    the shared-comm group."""
+
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Partition N layer descs into `num_parts` stages (reference:
+    pp_layers.py:23): uniform or parameter-count weighted."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self.layers_desc = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+        assert len(layers_desc) >= num_parts, \
+            f"{len(layers_desc)} layers < {num_parts} stages"
+
+    def do_segment(self) -> List[int]:
+        if self.method == "uniform":
+            return self.uniform(len(self.layers_desc), self.num_parts)
+        if self.method.startswith("layer:"):
+            # segment so each stage has equal count of the named layer type
+            name = self.method.split(":", 1)[1]
+            marks = [i for i, d in enumerate(self.layers_desc)
+                     if d.layer_cls.__name__ == name]
+            per = len(marks) // self.num_parts
+            assert per > 0
+            bounds = [0]
+            for p in range(1, self.num_parts):
+                bounds.append(marks[p * per])
+            bounds.append(len(self.layers_desc))
+            return bounds
+        raise ValueError(f"unknown segment method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts) -> List[int]:
+        return [int(np.round(i * num_items / num_parts))
+                for i in range(num_parts + 1)]
+
+
+class PipelineLayer(Layer):
+    """Holds the full desc list + this build's stage assignment.
+
+    Unlike the reference (which materializes only the local stage's layers per
+    rank), the single-controller SPMD engine materializes ALL stages' layers
+    and shards their (stacked) parameters over the "pipe" mesh axis — each
+    device stores only its own stage's shard, same memory as the reference.
+    """
+
+    def __init__(self, layers: List[LayerDesc], num_stages: int,
+                 loss_fn: Optional[Callable] = None, seg_method="uniform",
+                 topology=None, **kwargs):
+        super().__init__()
+        self.descs = layers
+        self.num_stages = num_stages
+        self.loss_fn = loss_fn
+        self.segment = SegmentLayers(layers, num_stages, seg_method).do_segment()
+        from ....nn.layers.container import LayerList
+        built = [d.build_layer() for d in layers]
+        self.runs = LayerList(built)
+        self.shared_keys = {d.layer_name for d in layers
+                            if isinstance(d, SharedLayerDesc)}
+
+    def stage_layers(self, stage_id: int):
+        lo, hi = self.segment[stage_id], self.segment[stage_id + 1]
+        return list(self.runs)[lo:hi]
+
+    def forward(self, x):
+        """Non-pipelined reference forward (single-device semantics)."""
+        shared = {}
+        for desc, layer in zip(self.descs, self.runs):
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name not in shared:
+                    shared[desc.layer_name] = layer
+                    x = layer(x)
+                else:
+                    owner = shared[desc.layer_name]
+                    if desc.forward_func is not None:
+                        x = desc.forward_func(
+                            x, getattr(owner, desc.shared_weight_attr))
+                    else:
+                        x = owner(x)
+            else:
+                x = layer(x)
+        return x

@@ -1,0 +1,148 @@
+"""Pipeline-parallel engine (reference:
+fleet/meta_parallel/pipeline_parallel.py:114 train_batch — micro-batch
+forward :156 / backward :199 loops with p2p send/recv
+(pp_utils/p2p_communication.py:84,:93); static 1F1B in
+framework/section_worker.cc:139-183).
+
+TPU-native schedule: the whole pipeline is ONE SPMD program under shard_map
+over the "pipe" mesh axis. Activations move between stages with
+lax.ppermute; the schedule is a lax.scan over M + S - 1 ticks (GPipe fill +
+steady state). The *backward* pipeline is not hand-written: jax AD
+differentiates through the scan, transposing every ppermute into the
+reverse-direction hop — producing exactly the reversed communication pattern
+that pipeline_parallel.py:199 implements manually. Per-microbatch activation
+memory is bounded with jax.checkpoint (remat) over each stage application,
+which is how 1F1B's memory advantage is recovered on TPU (remat trades the
+stashed activations for recompute, reference C54 recompute).
+
+Stage dispatch inside the SPMD program is a lax.switch on the stage id —
+first stage consumes the (replicated) token microbatch, the last computes
+the loss; middle stages are pure activation → activation maps.
+"""
+from __future__ import annotations
+
+import functools
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...jit.functionalization import functional_call, state_of
+from ...nn.layer import Layer
+
+PIPE_AXIS = "pipe"
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        from .parallel_layers.pp_layers import PipelineLayer
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel requires a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        self.num_stages = hcg.get_pipe_parallel_world_size()
+        self.accumulate_steps = 1
+        if strategy is not None:
+            self.accumulate_steps = int(
+                strategy.pipeline_configs.get("accumulate_steps", 1))
+        self._compiled = None
+
+    # -- single-device semantics (debug/eval) ------------------------------
+    def forward(self, x):
+        return self._layers(x)
+
+    # -- the SPMD pipelined loss -------------------------------------------
+    def build_pipeline_loss_fn(self, loss_fn, micro_batches: int):
+        """Return pure_loss(params, buffers, rng, inputs, labels) that runs
+        the GPipe schedule inside an active shard_map over the pipe axis.
+
+        inputs/labels are the FULL batch (replicated over pipe); they are
+        re-split into `micro_batches` microbatches here (reference
+        pipeline_parallel.py _load_micro_batch).
+        """
+        layers = self._layers
+        S = self.num_stages
+        M = micro_batches
+        segment = layers.segment
+
+        def stage_forward(stage_id, params, buffers, h, key):
+            """Apply the layers of `stage_id` functionally."""
+            lo, hi = segment[stage_id], segment[stage_id + 1]
+            out = h
+            for i in range(lo, hi):
+                sub = layers.runs[i]
+                sub_prefix = f"runs.{i}"
+                sub_params = {k[len(sub_prefix) + 1:]: v for k, v in params.items()
+                              if k.startswith(sub_prefix + ".")}
+                sub_bufs = {k[len(sub_prefix) + 1:]: v for k, v in buffers.items()
+                            if k.startswith(sub_prefix + ".")}
+                (out), _ = functional_call(sub, sub_params, sub_bufs, out,
+                                           rng=jax.random.fold_in(key, i))
+            return out
+
+        def pure_loss(params, buffers, key, inputs, labels):
+            sid = lax.axis_index(PIPE_AXIS)
+            mb = inputs.shape[0] // M
+            micro_in = inputs.reshape((M, mb) + inputs.shape[1:])
+            micro_lb = labels.reshape((M, mb) + labels.shape[1:])
+
+            # probe the carry shape: trace stage0 on microbatch 0
+            h_shape = jax.eval_shape(
+                lambda: stage_forward(0, params, buffers,
+                                      micro_in[0], key)).shape
+            h_dtype = jax.eval_shape(
+                lambda: stage_forward(0, params, buffers,
+                                      micro_in[0], key)).dtype
+
+            def apply_stage(s, h_in, m, key):
+                """Branch for stage s; every branch returns (h, loss)."""
+                def branch(h):
+                    x0 = micro_in[m] if s == 0 else h
+                    out = stage_forward(s, params, buffers, x0, key)
+                    if s == S - 1:
+                        l = loss_fn(out, micro_lb[m])
+                        return out.astype(h_dtype) if out.shape == h_shape \
+                            else jnp.zeros(h_shape, h_dtype), l
+                    return out, jnp.zeros((), jnp.float32)
+                return branch
+
+            def tick(carry, t):
+                h_recv, loss_acc = carry
+                m = jnp.clip(t - sid, 0, M - 1)
+                valid = (t - sid >= 0) & (t - sid < M)
+                k_t = jax.random.fold_in(key, t)
+                branches = [_remat_branch(apply_stage(s, h_recv, m, k_t))
+                            for s in range(S)]
+                h_out, l = lax.switch(sid, branches, h_recv)
+                l = jnp.where(valid, l, 0.0)
+                loss_acc = loss_acc + l
+                h_next = lax.ppermute(
+                    h_out, PIPE_AXIS, [(i, (i + 1) % S) for i in range(S)])
+                return (h_next, loss_acc), None
+
+            h0 = jnp.zeros(h_shape, h_dtype)
+            (h_last, loss_acc), _ = lax.scan(
+                tick, (h0, jnp.zeros((), jnp.float32)), jnp.arange(M + S - 1))
+            # only the last stage accumulated loss; broadcast it
+            total = lax.psum(loss_acc, PIPE_AXIS)
+            return total / M
+
+        def _remat_branch(branch):
+            return jax.checkpoint(branch)
+
+        return pure_loss
+
+    # passthrough
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, *args, **kwargs):
+        return self._layers.set_state_dict(sd, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)

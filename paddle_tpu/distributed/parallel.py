@@ -1,0 +1,75 @@
+"""DataParallel wrapper (reference: fluid/dygraph/parallel.py:382
+DataParallel + the C++ Reducer imperative/reducer.cc).
+
+TPU-native design: there is no bucketed-allreduce Reducer — gradients are
+averaged with a single lax.pmean over the "data" mesh axis inside the jitted
+step (XLA fuses and overlaps the collective with backward compute via its
+latency-hiding scheduler, which is what reducer.cc:798 hand-implements).
+``DataParallel`` therefore only 1) marks the module for DP, 2) installs the
+grad-sync hook used by the training engine, and 3) keeps API parity
+(scale_loss, no_sync, state_dict passthrough).
+"""
+from __future__ import annotations
+
+import contextlib
+
+from jax import lax
+
+from ..nn.layer import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.axis_name = group.axis_name if group is not None else "data"
+        self._grad_sync_enabled = True
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Skip grad sync (gradient accumulation, reference parallel.py:563)."""
+        self._grad_sync_enabled = False
+        try:
+            yield
+        finally:
+            self._grad_sync_enabled = True
+
+    def sync_gradients(self, grads: dict) -> dict:
+        """Average grads over the data axis — called by the training engine
+        inside the jitted/shard_mapped step."""
+        if not self._grad_sync_enabled:
+            return grads
+        try:
+            lax.axis_index(self.axis_name)
+        except Exception:
+            return grads
+        return {k: None if g is None else lax.pmean(g, self.axis_name)
+                for k, g in grads.items()}
+
+    # passthrough API parity
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+
+def sync_params_buffers(model, comm_group=None, src_rank=0):
+    """Broadcast params from src (reference: parallel.py sync_params_buffers).
+    Under SPMD replication this is implicit; kept for API parity."""
+    return model

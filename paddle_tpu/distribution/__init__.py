@@ -1,0 +1,97 @@
+"""Probability distributions (reference: python/paddle/distribution.py —
+Distribution, Uniform, Normal, Categorical)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.random import get_rng_key
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return jnp.exp(self.log_prob(value))
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = jnp.asarray(low, dtype=jnp.float32)
+        self.high = jnp.asarray(high, dtype=jnp.float32)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.low.shape, self.high.shape)
+        key = jax.random.key(seed) if seed else get_rng_key()
+        u = jax.random.uniform(key, shape)
+        return self.low + u * (self.high - self.low)
+
+    def log_prob(self, value):
+        inside = (value >= self.low) & (value < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return jnp.where(inside, lp, -jnp.inf)
+
+    def entropy(self):
+        return jnp.log(self.high - self.low)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, dtype=jnp.float32)
+        self.scale = jnp.asarray(scale, dtype=jnp.float32)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        key = jax.random.key(seed) if seed else get_rng_key()
+        return self.loc + self.scale * jax.random.normal(key, shape)
+
+    def log_prob(self, value):
+        var = jnp.square(self.scale)
+        return (-jnp.square(value - self.loc) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+
+    def kl_divergence(self, other: "Normal"):
+        var_ratio = jnp.square(self.scale / other.scale)
+        t1 = jnp.square((self.loc - other.loc) / other.scale)
+        return 0.5 * (var_ratio + t1 - 1.0 - jnp.log(var_ratio))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = jnp.asarray(logits, dtype=jnp.float32)
+
+    def sample(self, shape=(), seed=0):
+        key = jax.random.key(seed) if seed else get_rng_key()
+        return jax.random.categorical(key, self.logits, shape=tuple(shape) +
+                                      self.logits.shape[:-1])
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        value = value.astype(jnp.int32)
+        return jnp.take_along_axis(logp, value[..., None], axis=-1)[..., 0]
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        p = jnp.exp(logp)
+        return -jnp.sum(p * logp, axis=-1)
+
+    def kl_divergence(self, other: "Categorical"):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        logq = jax.nn.log_softmax(other.logits, axis=-1)
+        p = jnp.exp(logp)
+        return jnp.sum(p * (logp - logq), axis=-1)

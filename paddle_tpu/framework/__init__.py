@@ -1,0 +1,53 @@
+"""Core framework: dtypes, RNG, naming, device helpers.
+
+TPU-native replacement for the reference's platform/framework layers (L0–L2
+in SURVEY.md): Place/DeviceContext dissolve into jax.Device, ProgramDesc into
+jaxprs, the executor stack into jax.jit.
+"""
+from . import dtype  # noqa: F401
+from .dtype import get_default_dtype, set_default_dtype  # noqa: F401
+from .naming import unique_name  # noqa: F401
+from .random import get_rng_key, get_rng_state_tracker, rng_guard, seed  # noqa: F401
+
+
+def get_device() -> str:
+    """Reference: python/paddle/device.py get_device."""
+    import jax
+    d = jax.devices()[0]
+    plat = d.platform
+    if plat == "cpu":
+        return "cpu"
+    return f"{plat}:{d.id}"
+
+
+def set_device(device: str):
+    import jax
+    plat = device.split(":")[0]
+    if plat in ("cuda", "gpu"):
+        plat = "gpu"
+    try:
+        jax.config.update("jax_default_device",
+                          jax.devices(plat)[int(device.split(":")[1]) if ":" in device else 0])
+    except RuntimeError:
+        pass
+    return get_device()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    import jax
+    try:
+        return any(d.platform == "tpu" for d in jax.devices())
+    except RuntimeError:
+        return False

@@ -1,0 +1,72 @@
+"""Dtype registry.
+
+TPU-native equivalent of the reference's VarType dtype enum
+(reference: paddle/fluid/framework/framework.proto:106) plus the float types in
+platform/float16.h, bfloat16.h, complex.h. On TPU, dtypes are plain
+``jnp.dtype`` objects; we expose paddle-style names and a default-dtype switch
+(reference: python/paddle/framework/framework.py set_default_dtype).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Public dtype aliases (paddle.<name>)
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR2DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_default_dtype = jnp.float32
+
+
+def set_default_dtype(d):
+    """Set the default floating dtype used by layer parameter creation."""
+    global _default_dtype
+    _default_dtype = convert_dtype_to_jax(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def convert_dtype_to_jax(dtype):
+    """Normalize str/np/jnp dtype specs to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _STR2DTYPE:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+        return _STR2DTYPE[dtype]
+    return jnp.dtype(dtype).type if isinstance(dtype, np.dtype) else dtype
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)

@@ -1,0 +1,22 @@
+"""Unique-name generator (reference: python/paddle/fluid/unique_name.py)."""
+from __future__ import annotations
+
+import threading
+
+
+class _Namer(threading.local):
+    def __init__(self):
+        self.counters = {}
+
+
+_namer = _Namer()
+
+
+def unique_name(prefix: str = "tmp") -> str:
+    idx = _namer.counters.get(prefix, 0)
+    _namer.counters[prefix] = idx + 1
+    return f"{prefix}_{idx}"
+
+
+def reset():
+    _namer.counters = {}

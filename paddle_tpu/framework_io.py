@@ -1,0 +1,41 @@
+"""paddle.save / paddle.load equivalents (reference:
+python/paddle/framework/io.py:550 save, :766 load).
+
+Format: a pickle of the object tree with jax/numpy arrays converted to
+numpy (portable, no jax needed to read). For sharded/async checkpoints of
+large distributed models use paddle_tpu.distributed.checkpoint (orbax-style);
+this path covers the reference's single-file state_dict workflow.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import numpy as np
+
+
+def _to_saveable(obj):
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    if hasattr(obj, "value") and hasattr(obj, "trainable"):  # Parameter
+        return np.asarray(obj.value)
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj) if type(obj) in (list, tuple) else list
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return pickle.load(f)

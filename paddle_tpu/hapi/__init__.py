@@ -1,0 +1,3 @@
+"""paddle_tpu.hapi — high-level Model API (reference: python/paddle/hapi/)."""
+from . import callbacks, model_summary  # noqa: F401
+from .model import Model  # noqa: F401

@@ -1,0 +1,400 @@
+"""High-level Model API (reference: python/paddle/hapi/model.py:878 Model,
+fit :1523, evaluate :1753, predict :1855, prepare :1450).
+
+The reference keeps two adapters (DynamicGraphAdapter / StaticGraphAdapter).
+On TPU the duality collapses: there is ONE path — a pure jitted step built
+from the functionalized network. State (params, buffers, optimizer slots)
+lives on-device between steps; Parameters are synced back lazily (at
+save/epoch end), so the hot loop is a single compiled XLA program per step —
+the TPU-native answer to the reference's per-op dygraph overhead (CS-4).
+"""
+from __future__ import annotations
+
+import os
+import warnings
+from collections import OrderedDict
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.random import get_rng_key
+from ..jit.functionalization import functional_call, state_of
+from ..metric import Metric
+from . import callbacks as callbacks_mod
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._loss = None
+        self._optimizer = None
+        self._metrics = []
+        self._amp_level = "O0"
+        self._train_step_fn = None
+        self._eval_step_fn = None
+        self._pred_step_fn = None
+        self._state = None  # (params, buffers, opt_state)
+        self.stop_training = False
+
+    # -- prepare -----------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+            for m in metrics:
+                assert isinstance(m, Metric), "metrics must be paddle_tpu.metric.Metric"
+            self._metrics = list(metrics)
+        if isinstance(amp_configs, str):
+            self._amp_level = amp_configs
+        elif isinstance(amp_configs, dict):
+            self._amp_level = amp_configs.get("level", "O1")
+        self._build_steps()
+        return self
+
+    # -- state management --------------------------------------------------
+    def _device_state(self):
+        if self._state is None:
+            params, buffers = state_of(self.network)
+            trainable = OrderedDict(
+                (n, p.trainable) for n, p in self.network.named_parameters())
+            opt_state = (self._optimizer.init_state(
+                OrderedDict((k, v) for k, v in params.items() if trainable[k]))
+                if self._optimizer is not None else None)
+            self._state = {"params": params, "buffers": buffers,
+                           "opt": opt_state, "trainable": trainable}
+        return self._state
+
+    def _sync_to_network(self):
+        """Write device state back into the imperative Parameters."""
+        if self._state is None:
+            return
+        boxes = OrderedDict(self.network.named_parameters())
+        for n, v in self._state["params"].items():
+            if n in boxes:
+                boxes[n].value = v
+        owners = {}
+        for lp, sub in self.network.named_sublayers(include_self=True):
+            for name in sub._buffers:
+                owners[lp + ("." if lp else "") + name] = (sub, name)
+        for n, v in self._state["buffers"].items():
+            if n in owners:
+                sub, name = owners[n]
+                sub._buffers[name] = v
+
+    def _invalidate_state(self):
+        self._state = None
+
+    # -- compiled steps ----------------------------------------------------
+    def _split_batch(self, data):
+        if not isinstance(data, (list, tuple)):
+            data = (data,)
+        data = tuple(jnp.asarray(d) for d in data)
+        n_labels = len(self._labels) if self._labels else (1 if self._loss else 0)
+        if n_labels == 0:
+            return data, ()
+        return data[:-n_labels], data[-n_labels:]
+
+    def _compute_loss(self, outputs, labels):
+        outs = outputs if isinstance(outputs, (list, tuple)) else (outputs,)
+        if self._loss is None:
+            raise RuntimeError("loss not set; call prepare(loss=...)")
+        loss = self._loss(*outs, *labels)
+        if isinstance(loss, (list, tuple)):
+            loss = sum(jnp.sum(l) for l in loss)
+        return loss
+
+    def _build_steps(self):
+        net = self.network
+        opt = self._optimizer
+        amp_level = self._amp_level
+        lr_scales = {n: p.optimize_attr.get("learning_rate", 1.0)
+                     for n, p in net.named_parameters()}
+
+        def train_step(params, buffers, opt_state, key, trainable, lr, *data):
+            inputs, labels = self._split_batch(data)
+
+            def loss_fn(tparams):
+                merged = dict(params)
+                merged.update(tparams)
+                from ..amp import auto_cast
+                if amp_level in ("O1", "O2"):
+                    with auto_cast(True, level=amp_level):
+                        out, new_buffers = functional_call(
+                            net, merged, buffers, *inputs, rng=key)
+                else:
+                    out, new_buffers = functional_call(
+                        net, merged, buffers, *inputs, rng=key)
+                loss = self._compute_loss(out, labels)
+                return loss, (out, new_buffers)
+
+            tparams = {k: v for k, v in params.items() if trainable[k]}
+            (loss, (out, new_buffers)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(tparams)
+            new_t, new_opt = opt.apply_gradients(tparams, grads, opt_state,
+                                                 lr=lr, lr_scales=lr_scales)
+            new_params = dict(params)
+            new_params.update(new_t)
+            metric_outs = tuple(
+                m.compute(out if not isinstance(out, (list, tuple)) else out[0],
+                          *labels) for m in self._metrics)
+            return loss, new_params, new_buffers, new_opt, metric_outs
+
+        def eval_step(params, buffers, *data):
+            inputs, labels = self._split_batch(data)
+            out, _ = functional_call(net, params, buffers, *inputs)
+            loss = (self._compute_loss(out, labels)
+                    if self._loss is not None else jnp.zeros(()))
+            metric_outs = tuple(
+                m.compute(out if not isinstance(out, (list, tuple)) else out[0],
+                          *labels) for m in self._metrics)
+            return loss, metric_outs
+
+        def pred_step(params, buffers, *inputs):
+            out, _ = functional_call(net, params, buffers, *inputs)
+            return out
+
+        donate = (0, 1, 2)  # params/buffers/opt_state buffers are reused
+        self._train_step_fn = jax.jit(train_step, static_argnums=(4,),
+                                      donate_argnums=donate)
+        self._eval_step_fn = jax.jit(eval_step)
+        self._pred_step_fn = jax.jit(pred_step)
+
+    # -- batch-level API ---------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        if self._train_step_fn is None:
+            self._build_steps()
+        st = self._device_state()
+        data = self._pack(inputs, labels)
+        key = get_rng_key()
+        trainable = tuple(sorted((k, v) for k, v in st["trainable"].items()))
+        lr = self._optimizer.get_lr()
+        loss, new_params, new_buffers, new_opt, metric_outs = self._train_step_fn(
+            st["params"], st["buffers"], st["opt"], key,
+            _Hashable(dict(trainable)), lr, *data)
+        st["params"], st["buffers"], st["opt"] = new_params, new_buffers, new_opt
+        if isinstance(self._optimizer._lr, object) and hasattr(self._optimizer._lr, "step"):
+            pass  # scheduler stepping left to callbacks/epoch logic
+        metrics = []
+        for m, mo in zip(self._metrics, metric_outs):
+            metrics.append(m.update(*(mo if isinstance(mo, tuple) else (mo,))))
+        loss_val = float(loss)
+        return ([loss_val] + metrics) if metrics else [loss_val]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        if self._eval_step_fn is None:
+            self._build_steps()
+        st = self._device_state()
+        data = self._pack(inputs, labels)
+        loss, metric_outs = self._eval_step_fn(st["params"], st["buffers"], *data)
+        metrics = []
+        for m, mo in zip(self._metrics, metric_outs):
+            metrics.append(m.update(*(mo if isinstance(mo, tuple) else (mo,))))
+        return ([float(loss)] + metrics) if metrics else [float(loss)]
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        if self._pred_step_fn is None:
+            self._build_steps()
+        st = self._device_state()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
+        inputs = tuple(jnp.asarray(i) for i in inputs)
+        out = self._pred_step_fn(st["params"], st["buffers"], *inputs)
+        return out
+
+    @staticmethod
+    def _pack(inputs, labels):
+        ins = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
+        if labels is None:
+            return tuple(jnp.asarray(i) for i in ins)
+        lbs = labels if isinstance(labels, (list, tuple)) else (labels,)
+        return tuple(jnp.asarray(x) for x in (*ins, *lbs))
+
+    # -- loops -------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbks = callbacks_mod.config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps, verbose=verbose,
+            log_freq=log_freq, save_dir=save_dir, save_freq=save_freq,
+            metrics=self._metrics_name())
+        cbks.on_begin("train")
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            logs = self._run_one_epoch(train_loader, cbks, "train",
+                                       num_iters=num_iters)
+            cbks.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+            if eval_loader is not None and epoch % eval_freq == 0:
+                eval_logs = self._run_one_epoch(eval_loader, cbks, "eval")
+                cbks.on_end("eval", eval_logs)
+        cbks.on_end("train", logs)
+        self._sync_to_network()
+        return self
+
+    def _run_one_epoch(self, loader, cbks, mode, num_iters=None):
+        logs = {}
+        for m in self._metrics:
+            m.reset()
+        for step, data in enumerate(loader):
+            cbks.on_batch_begin(mode, step, logs)
+            data = list(data) if isinstance(data, (list, tuple)) else [data]
+            if mode == "train":
+                outs = self.train_batch(data)
+            elif mode == "eval":
+                outs = self.eval_batch(data)
+            else:
+                outs = [self.predict_batch(data)]
+            metrics_names = self._metrics_name()
+            logs = dict(zip(metrics_names, _flatten_outs(outs)))
+            try:
+                logs["batch_size"] = data[0].shape[0]
+            except Exception:
+                pass
+            logs["step"] = step
+            cbks.on_batch_end(mode, step, logs)
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        # final accumulated metrics
+        i = 1
+        for m in self._metrics:
+            res = m.accumulate()
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = res if isinstance(res, list) else [res]
+            for n, v in zip(names, vals):
+                logs[n] = v
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        from ..io import DataLoader, Dataset
+        loader = DataLoader(eval_data, batch_size=batch_size,
+                            num_workers=num_workers) \
+            if isinstance(eval_data, Dataset) else eval_data
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks = callbacks_mod.config_callbacks(
+            callbacks, model=self, steps=steps, verbose=verbose,
+            log_freq=log_freq, metrics=self._metrics_name())
+        cbks.on_begin("eval")
+        logs = self._run_one_epoch(loader, cbks, "eval", num_iters=num_iters)
+        cbks.on_end("eval", logs)
+        return {k: v for k, v in logs.items() if k not in ("step", "batch_size")}
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+        loader = DataLoader(test_data, batch_size=batch_size,
+                            num_workers=num_workers) \
+            if isinstance(test_data, Dataset) else test_data
+        outputs = []
+        for data in loader:
+            data = data if isinstance(data, (list, tuple)) else [data]
+            out = self.predict_batch(list(data))
+            outputs.append(np.asarray(out))
+        if stack_outputs:
+            return [np.concatenate(outputs, axis=0)]
+        return [outputs]
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework_io import save as _save
+        self._sync_to_network()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer_state_for_save(), path + ".pdopt")
+
+    def _optimizer_state_for_save(self):
+        st = self._state
+        opt_sd = self._optimizer.state_dict() if self._optimizer else {}
+        if st is not None and st.get("opt") is not None:
+            opt_sd = dict(opt_sd)
+            opt_sd["state"] = jax.tree_util.tree_map(np.asarray, st["opt"])
+        return opt_sd
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework_io import load as _load
+        sd = _load(path + ".pdparams")
+        missing, unexpected = self.network.set_state_dict(sd)
+        if missing and not skip_mismatch:
+            warnings.warn(f"missing keys on load: {missing}")
+        self._invalidate_state()
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            opt_sd = _load(path + ".pdopt")
+            if "state" in opt_sd:
+                st = self._device_state()
+                st["opt"] = jax.tree_util.tree_map(jnp.asarray, opt_sd["state"])
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def _metrics_name(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+class _Hashable:
+    """Hashable dict wrapper for static jit args."""
+
+    def __init__(self, d):
+        self.d = dict(d)
+        self._key = tuple(sorted(self.d.items()))
+
+    def __getitem__(self, k):
+        return self.d[k]
+
+    def __hash__(self):
+        return hash(self._key)
+
+    def __eq__(self, other):
+        return isinstance(other, _Hashable) and self._key == other._key
+
+
+def _flatten_outs(outs):
+    flat = []
+    for o in outs:
+        if isinstance(o, (list, tuple)):
+            flat.extend(o)
+        else:
+            flat.append(o)
+    return flat

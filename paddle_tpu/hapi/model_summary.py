@@ -1,0 +1,89 @@
+"""Model summary + FLOPs (reference: python/paddle/hapi/model_summary.py,
+dynamic_flops.py) — implemented via shape tracing with jax.eval_shape."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def summary(net, input_size, dtypes=None):
+    """Print a per-layer summary. input_size: tuple or list of tuples
+    (batch dim may be None/-1 → treated as 1)."""
+    if isinstance(input_size, tuple):
+        input_sizes = [input_size]
+    else:
+        input_sizes = list(input_size)
+    dtypes = dtypes or ["float32"] * len(input_sizes)
+    inputs = []
+    for shape, dt in zip(input_sizes, dtypes):
+        shape = tuple(1 if s in (None, -1) else s for s in shape)
+        inputs.append(jnp.zeros(shape, dtype=dt))
+
+    rows = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(l, inp, out):
+            try:
+                out_shape = list(out.shape) if hasattr(out, "shape") else "-"
+            except Exception:
+                out_shape = "-"
+            n_params = sum(int(np.prod(p.shape))
+                           for p in l._parameters.values() if p is not None)
+            rows.append((f"{type(l).__name__}-{len(rows) + 1}", out_shape, n_params))
+        return layer.register_forward_post_hook(hook)
+
+    for name, layer in net.named_sublayers(include_self=False):
+        if not layer._sub_layers:  # leaf layers only
+            hooks.append(make_hook(name, layer))
+    was_training = net.training
+    net.eval()
+    try:
+        net(*inputs)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable_params = sum(int(np.prod(p.shape)) for p in net.parameters()
+                           if p.trainable)
+    header = f"{'Layer (type)':<30}{'Output Shape':<25}{'Param #':<12}"
+    line = "-" * len(header)
+    print(line)
+    print(header)
+    print("=" * len(header))
+    for name, shape, n in rows:
+        print(f"{name:<30}{str(shape):<25}{n:<12}")
+    print("=" * len(header))
+    print(f"Total params: {total_params:,}")
+    print(f"Trainable params: {trainable_params:,}")
+    print(f"Non-trainable params: {total_params - trainable_params:,}")
+    print(line)
+    return {"total_params": total_params, "trainable_params": trainable_params}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough FLOPs count via XLA cost analysis of the jitted forward."""
+    from ..jit.functionalization import functional_call, state_of
+
+    shape = tuple(1 if s in (None, -1) else s for s in input_size)
+    x = jnp.zeros(shape, dtype="float32")
+    params, buffers = state_of(net)
+
+    def pure(p, b, xx):
+        out, _ = functional_call(net, p, b, xx)
+        return out
+
+    try:
+        lowered = jax.jit(pure).lower(params, buffers, x)
+        compiled = lowered.compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        return int(ca.get("flops", 0))
+    except Exception:
+        return 0

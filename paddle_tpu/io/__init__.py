@@ -1,0 +1,8 @@
+"""paddle_tpu.io (reference: python/paddle/io/__init__.py)."""
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataset import (  # noqa: F401
+    ChainDataset, ComposeDataset, ConcatDataset, Dataset, IterableDataset,
+    Subset, TensorDataset, random_split)
+from .sampler import (  # noqa: F401
+    BatchSampler, DistributedBatchSampler, RandomSampler, Sampler,
+    SequenceSampler, WeightedRandomSampler)

@@ -1,0 +1,214 @@
+"""DataLoader (reference: fluid/reader.py:146 DataLoader,
+fluid/dataloader/dataloader_iter.py:97 single-process, :248 multi-process).
+
+TPU-native design: worker *processes* (fork) pull index batches from a queue
+and push collated numpy batches back (the reference's shared-mem LoDTensor
+path is replaced by pickled numpy over pipes — fine for host→TPU feed since
+the transfer is overlapped by a device-prefetch depth of 2, which is what
+operators/reader/buffered_reader.cc achieves with CUDA streams).
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue as queue_mod
+import threading
+import traceback
+
+import numpy as np
+
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return tuple(default_collate_fn([b[i] for b in batch])
+                     for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch, axis=0)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    arr = np.asarray(batch)
+    return arr
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
+                 worker_init_fn):
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        seq, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            data_queue.put((seq, collate_fn(samples), None))
+        except Exception:
+            data_queue.put((seq, None, traceback.format_exc()))
+
+
+class _MultiProcessIter:
+    def __init__(self, loader):
+        self.loader = loader
+        self.batches = list(iter(loader.batch_sampler))
+        ctx = mp.get_context("fork")
+        self.index_queue = ctx.Queue()
+        self.data_queue = ctx.Queue()
+        self.workers = []
+        for wid in range(loader.num_workers):
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, self.index_queue, self.data_queue,
+                      loader.collate_fn, wid, loader.worker_init_fn),
+                daemon=True)
+            w.start()
+            self.workers.append(w)
+        # backpressure: keep at most num_workers * prefetch_factor batches in
+        # flight (the buffered_reader.cc double-buffer bound, host-side)
+        self.window = max(loader.num_workers * loader.prefetch_factor, 1)
+        self.dispatched = 0
+        for _ in range(min(self.window, len(self.batches))):
+            self._dispatch_next()
+        self.reorder = {}
+        self.next_seq = 0
+
+    def _dispatch_next(self):
+        if self.dispatched < len(self.batches):
+            self.index_queue.put((self.dispatched,
+                                  self.batches[self.dispatched]))
+            self.dispatched += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.next_seq >= len(self.batches):
+            self._shutdown(graceful=True)
+            raise StopIteration
+        while self.next_seq not in self.reorder:
+            seq, data, err = self.data_queue.get()
+            if err is not None:
+                self._shutdown()
+                raise RuntimeError(f"DataLoader worker failed:\n{err}")
+            self.reorder[seq] = data
+        data = self.reorder.pop(self.next_seq)
+        self.next_seq += 1
+        self._dispatch_next()
+        return self.loader._to_output(data)
+
+    def _shutdown(self, graceful=False):
+        if graceful:
+            for _ in self.workers:
+                self.index_queue.put(None)
+        for w in self.workers:
+            if w.is_alive():
+                if graceful:
+                    w.join(timeout=1.0)
+                if w.is_alive():
+                    w.terminate()
+        self.workers = []
+
+    def __del__(self):
+        self._shutdown()
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.worker_init_fn = worker_init_fn
+        self.prefetch_factor = prefetch_factor
+        self.use_buffer_reader = use_buffer_reader
+        self._is_iterable_ds = isinstance(dataset, IterableDataset)
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        elif not self._is_iterable_ds:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+            self.batch_size = batch_size
+        else:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+
+    def _to_output(self, data):
+        return data
+
+    def _iter_iterable(self):
+        it = iter(self.dataset)
+        while True:
+            batch = list(itertools.islice(it, self.batch_size))
+            if not batch:
+                return
+            if len(batch) < self.batch_size and getattr(self, "drop_last", False):
+                return
+            yield self._to_output(self.collate_fn(batch))
+
+    def _iter_single(self):
+        for indices in self.batch_sampler:
+            samples = [self.dataset[i] for i in indices]
+            yield self._to_output(self.collate_fn(samples))
+
+    def __iter__(self):
+        if self._is_iterable_ds:
+            base = self._iter_iterable()
+        elif self.num_workers > 0:
+            base = _MultiProcessIter(self)
+        else:
+            base = self._iter_single()
+        if self.use_buffer_reader:
+            return _PrefetchIter(base, depth=self.prefetch_factor)
+        return iter(base)
+
+    def __len__(self):
+        if self.batch_sampler is not None:
+            return len(self.batch_sampler)
+        raise TypeError("length of IterableDataset loader is unknown")
+
+
+class _PrefetchIter:
+    """Background-thread prefetch (the host-side analogue of
+    operators/reader/buffered_reader.cc double buffering)."""
+
+    def __init__(self, source, depth=2):
+        self.q = queue_mod.Queue(maxsize=depth)
+        self.done = object()
+        self.exc = None
+
+        def run():
+            try:
+                for item in source:
+                    self.q.put(item)
+            except BaseException as e:  # noqa: BLE001
+                self.exc = e
+            finally:
+                self.q.put(self.done)
+
+        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self.q.get()
+        if item is self.done:
+            if self.exc is not None:
+                raise self.exc
+            raise StopIteration
+        return item

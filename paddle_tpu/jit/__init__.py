@@ -1,0 +1,154 @@
+"""paddle_tpu.jit — staging + export (reference: python/paddle/jit/
+to_static, fluid/dygraph/jit.py:515 save, :876 load; dy2static AST machinery
+fluid/dygraph/dygraph_to_static/).
+
+The reference rewrites Python ASTs into a static Program. Here staging is
+jax.jit over the functionalized layer — no AST translation; Python control
+flow on traced values must use lax.cond/scan, exactly XLA's contract.
+Export is StableHLO via jax.export (replacing save_inference_model's
+serialized ProgramDesc).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .functionalization import functional_call, state_of, trainable_mask  # noqa: F401
+
+
+class InputSpec:
+    """reference: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        from ..framework import dtype as dtype_mod
+        self.shape = tuple(-1 if s is None else s for s in shape)
+        self.dtype = dtype_mod.convert_dtype_to_jax(dtype)
+        self.name = name
+
+    def to_shape_dtype(self, batch_size=1):
+        shape = tuple(batch_size if s == -1 else s for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, self.dtype)
+
+
+class TracedLayer:
+    """A Layer staged through jax.jit: callable with the same signature, pure
+    and compiled. Buffers (e.g. BN stats) are frozen at trace time in eval
+    mode (matching the reference's inference export)."""
+
+    def __init__(self, layer, input_spec=None, jit_kwargs=None):
+        self.layer = layer
+        self.input_spec = input_spec
+        params, buffers = state_of(layer)
+        self._params = params
+        self._buffers = buffers
+
+        def pure(params, buffers, *args, **kwargs):
+            out, _ = functional_call(layer, params, buffers, *args, **kwargs)
+            return out
+
+        self._pure = pure
+        self._jitted = jax.jit(pure, **(jit_kwargs or {}))
+
+    def refresh_state(self):
+        self._params, self._buffers = state_of(self.layer)
+
+    def __call__(self, *args, **kwargs):
+        return self._jitted(self._params, self._buffers, *args, **kwargs)
+
+    @property
+    def forward(self):
+        return self.__call__
+
+
+def to_static(layer_or_fn=None, input_spec=None, **jit_kwargs):
+    """Decorator/wrapper: stage a Layer or function with jax.jit
+    (reference: paddle.jit.to_static)."""
+
+    def wrap(obj):
+        from ..nn.layer import Layer
+        if isinstance(obj, Layer):
+            return TracedLayer(obj, input_spec, jit_kwargs)
+        return jax.jit(obj, **jit_kwargs)
+
+    if layer_or_fn is None:
+        return wrap
+    return wrap(layer_or_fn)
+
+
+def _example_args(layer, input_spec: Optional[Sequence[InputSpec]]):
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec for tracing")
+    return tuple(jnp.zeros(s.to_shape_dtype(1).shape, s.to_shape_dtype(1).dtype)
+                 if isinstance(s, InputSpec) else jnp.asarray(s)
+                 for s in input_spec)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export a Layer as StableHLO + params (reference: fluid/dygraph/jit.py:515
+    jit.save → __model__ + params; here: .stablehlo + .pdiparams pickle)."""
+    from jax import export as jax_export
+
+    layer.eval()
+    params, buffers = state_of(layer)
+    params, buffers = dict(params), dict(buffers)
+
+    def pure(params, buffers, *args):
+        out, _ = functional_call(layer, params, buffers, *args)
+        return out
+
+    args = _example_args(layer, input_spec)
+    exported = jax_export.export(jax.jit(pure))(
+        jax.tree_util.tree_map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params),
+        jax.tree_util.tree_map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), buffers),
+        *args)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".stablehlo", "wb") as f:
+        f.write(exported.serialize())
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump({
+            "params": {k: np.asarray(v) for k, v in params.items()},
+            "buffers": {k: np.asarray(v) for k, v in buffers.items()},
+        }, f)
+
+
+class TranslatedLayer:
+    """Loaded exported model (reference: fluid/dygraph/io.py:1082)."""
+
+    def __init__(self, exported, params, buffers):
+        self._exported = exported
+        self._params = params
+        self._buffers = buffers
+
+    def __call__(self, *args):
+        return self._exported.call(self._params, self._buffers, *args)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def parameters(self):
+        return list(self._params.values())
+
+
+def load(path, **configs) -> TranslatedLayer:
+    from jax import export as jax_export
+
+    with open(path + ".stablehlo", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path + ".pdiparams", "rb") as f:
+        blob = pickle.load(f)
+    params = {k: jnp.asarray(v) for k, v in blob["params"].items()}
+    buffers = {k: jnp.asarray(v) for k, v in blob["buffers"].items()}
+    return TranslatedLayer(exported, params, buffers)
+
+
+def not_to_static(fn):
+    return fn

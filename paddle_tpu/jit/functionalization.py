@@ -1,0 +1,106 @@
+"""Functionalization bridge: imperative Layer ⇄ pure JAX function.
+
+This is the TPU-native replacement for the reference's entire
+dygraph-to-static machinery (reference:
+python/paddle/fluid/dygraph/dygraph_to_static/program_translator.py:232) and
+for the static Program/Executor stack: instead of AST-rewriting Python into a
+ProgramDesc, we temporarily swap traced values into the Layer's Parameter
+boxes and buffers, call the unchanged Python ``forward``, and read the
+mutated buffers back out. The result is a pure function
+``(params, buffers, inputs) -> (outputs, new_buffers)`` that jax.jit / pjit
+can stage, shard, and compile.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Any, Dict, Tuple
+
+import jax
+
+from ..framework.random import rng_guard
+
+
+def state_of(layer) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Extract (trainable+frozen params, buffers) as flat name->array dicts."""
+    params = OrderedDict((n, p.value) for n, p in layer.named_parameters())
+    buffers = OrderedDict(layer.named_buffers())
+    return params, buffers
+
+
+def trainable_mask(layer) -> Dict[str, bool]:
+    return OrderedDict((n, p.trainable) for n, p in layer.named_parameters())
+
+
+@contextlib.contextmanager
+def _swapped_state(layer, params, buffers):
+    """Swap `params`/`buffers` values into the layer; restore on exit.
+
+    Yields accessor callables to read the possibly-mutated buffer values
+    before restoration.
+    """
+    param_boxes = OrderedDict(layer.named_parameters())
+    buf_owners = {}
+    for lp, sub in layer.named_sublayers(include_self=True):
+        for name in sub._buffers:
+            full = lp + ("." if lp else "") + name
+            buf_owners[full] = (sub, name)
+
+    saved_params = {n: b.value for n, b in param_boxes.items()}
+    saved_bufs = {n: owner._buffers[name] for n, (owner, name) in buf_owners.items()}
+    try:
+        for n, v in (params or {}).items():
+            if n in param_boxes:
+                param_boxes[n].value = v
+        for n, v in (buffers or {}).items():
+            if n in buf_owners:
+                owner, name = buf_owners[n]
+                owner._buffers[name] = v
+
+        def read_buffers():
+            return OrderedDict(
+                (n, buf_owners[n][0]._buffers[buf_owners[n][1]])
+                for n in (buffers if buffers is not None else buf_owners))
+
+        yield read_buffers
+    finally:
+        for n, v in saved_params.items():
+            param_boxes[n].value = v
+        for n, (owner, name) in buf_owners.items():
+            owner._buffers[name] = saved_bufs[n]
+
+
+def functional_call(layer, params, buffers, *args, rng=None, **kwargs):
+    """Run ``layer(*args, **kwargs)`` as a pure function of (params, buffers).
+
+    Returns ``(outputs, new_buffers)``. ``rng`` (a jax PRNG key) scopes all
+    implicit randomness (dropout etc.) so the call is deterministic under jit.
+    """
+    with _swapped_state(layer, params, buffers) as read_buffers:
+        if rng is not None:
+            with rng_guard(rng):
+                out = layer(*args, **kwargs)
+        else:
+            out = layer(*args, **kwargs)
+        new_buffers = read_buffers()
+    return out, new_buffers
+
+
+def value_and_grad_fn(layer, loss_fn, has_aux: bool = False):
+    """Build a pure ``(params, buffers, rng, *batch) -> ((loss, aux_buffers), grads)``.
+
+    ``loss_fn(outputs_of_layer_call)`` is the user loss; the layer call is
+    ``layer(*batch)``. The reference analogue is append_backward on a Program
+    (python/paddle/fluid/backward.py:1377) — here it is just jax.grad over the
+    functionalized call.
+    """
+
+    def pure_loss(params, buffers, rng, *batch):
+        out, new_buffers = functional_call(layer, params, buffers, *batch, rng=rng)
+        loss = loss_fn(out)
+        if has_aux:
+            loss, aux = loss
+            return loss, (new_buffers, aux)
+        return loss, (new_buffers, None)
+
+    return jax.value_and_grad(pure_loss, has_aux=True)

@@ -1,0 +1,155 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py;
+CUDA kernels in operators/activation_op.* — on TPU each is one fused XLA HLO)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def relu(x, name=None):
+    return jax.nn.relu(x)
+
+
+def relu6(x, name=None):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+def relu_(x):
+    return jax.nn.relu(x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return jax.nn.celu(x, alpha=alpha)
+
+
+def gelu(x, approximate=False, name=None):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return jax.nn.leaky_relu(x, negative_slope=negative_slope)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    w = weight
+    if w.size > 1 and x.ndim > 1:
+        ch_axis = 1 if data_format[1] == "C" else x.ndim - 1
+        shape = [1] * x.ndim
+        shape[ch_axis] = w.size
+        w = jnp.reshape(w, shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+def rrelu(x, lower=0.125, upper=1.0 / 3.0, training=False, name=None):
+    if training:
+        from ...framework.random import get_rng_key
+        slope = jax.random.uniform(get_rng_key(), x.shape, minval=lower, maxval=upper)
+    else:
+        slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, slope * x)
+
+
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hardswish(x, name=None):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return jnp.clip(x, min, max)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return jnp.sign(x) * jnp.maximum(jnp.abs(x) - threshold, 0.0)
+
+
+def softsign(x, name=None):
+    return jax.nn.soft_sign(x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x, jnp.log1p(jnp.exp(scaled)) / beta)
+
+
+def swish(x, name=None):
+    return jax.nn.silu(x)
+
+
+silu = swish
+
+
+def mish(x, name=None):
+    return x * jnp.tanh(softplus(x))
+
+
+def tanh(x, name=None):
+    return jnp.tanh(x)
+
+
+def tanhshrink(x, name=None):
+    return x - jnp.tanh(x)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+def log_sigmoid(x, name=None):
+    return jax.nn.log_sigmoid(x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    axis = axis % x.ndim
+    c = x.shape[axis]
+    new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(jnp.reshape(x, new_shape), axis=axis + 1)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def glu(x, axis=-1, name=None):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import get_rng_key
+    g = jax.random.gumbel(get_rng_key(), x.shape, dtype=x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False) \
+            if hasattr(jnp, "put_along_axis") else \
+            jax.nn.one_hot(jnp.squeeze(idx, axis), y.shape[axis], axis=axis, dtype=y.dtype)
+        y = jax.lax.stop_gradient(y_hard - y) + y
+    return y

@@ -1,0 +1,55 @@
+"""Attention functional.
+
+Replaces the reference's fused attention CUDA kernels
+(operators/fused/multihead_matmul_op.cu, math/bert_encoder_functor.cu) which
+materialize the O(S²) score matrix. Default path here is the Pallas flash
+attention kernel (paddle_tpu/ops/pallas/flash_attention.py) — blockwise,
+O(S) memory; falls back to a pure-XLA implementation off-TPU or for tiny
+shapes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _xla_attention(q, k, v, mask=None, scale=None, causal=False, dropout_p=0.0,
+                   training=True):
+    # q,k,v: (B, S, H, D)
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    logits = jnp.einsum("bshd,bthd->bhst", q, k) * scale
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((s, t), dtype=bool))
+        logits = jnp.where(cm, logits, jnp.finfo(logits.dtype).min)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training:
+        from .common import dropout as _dropout
+        probs = _dropout(probs, p=dropout_p, training=True)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """query/key/value: (batch, seq, num_heads, head_dim)."""
+    use_flash = (
+        attn_mask is None and dropout_p == 0.0 and
+        query.shape[1] >= 256 and query.shape[1] % 128 == 0 and
+        key.shape[1] % 128 == 0 and query.shape[-1] in (64, 128, 256) and
+        jax.default_backend() == "tpu"
+    )
+    if use_flash:
+        try:
+            from ...ops.pallas.flash_attention import flash_attention
+            return flash_attention(query, key, value, causal=is_causal)
+        except Exception:
+            pass
+    return _xla_attention(query, key, value, mask=attn_mask, causal=is_causal,
+                          dropout_p=dropout_p, training=training)

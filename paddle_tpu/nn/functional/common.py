@@ -1,0 +1,236 @@
+"""Common functionals: linear/dropout/pad/embedding/interpolate/one_hot…
+(reference: python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.random import get_rng_key
+
+
+def _unwrap(p):
+    return p.value if hasattr(p, "value") else p
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with reference weight layout (in, out)
+    (reference: operators/math/fc.cc; maps straight onto the MXU)."""
+    weight, bias = _unwrap(weight), _unwrap(bias)
+    from ...amp import cast_if_amp
+    x, weight = cast_if_amp("linear", x, weight)
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias.astype(out.dtype)
+    return out
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if mode == "upscale_in_train" else x * (1.0 - p)
+    if p == 1.0:
+        return jnp.zeros_like(x)
+    key = get_rng_key()
+    shape = list(x.shape)
+    if axis is not None:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+    keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    a = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
+    b = -a * alpha_p * p
+    keep = jax.random.bernoulli(get_rng_key(), 1.0 - p, x.shape)
+    return (a * jnp.where(keep, x, alpha_p) + b).astype(x.dtype)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Reference: operators/lookup_table_v2_op.*; on TPU a one-hot matmul or
+    dynamic-gather — jnp.take lowers to an XLA gather."""
+    weight = _unwrap(weight)
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+def one_hot(x, num_classes, name=None):
+    return jax.nn.one_hot(x, num_classes)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, (list, tuple)) and len(pad) == 2 * x.ndim:
+        cfg = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(x.ndim)]
+    else:
+        # paddle semantics: pad applies to spatial dims (reversed last dims,
+        # like torch) for NCHW-family formats
+        pad = list(pad)
+        n_spatial = len(pad) // 2
+        cfg = [(0, 0)] * x.ndim
+        channel_last = data_format[-1] == "C"
+        spatial_axes = (list(range(1, 1 + n_spatial)) if channel_last
+                        else list(range(x.ndim - n_spatial, x.ndim)))
+        if not channel_last:
+            # pad list is (last_dim_lo, last_dim_hi, second_last_lo, ...)? the
+            # reference uses ascending spatial order [W, H, D]; map from the end.
+            for i, ax in enumerate(reversed(spatial_axes)):
+                cfg[ax] = (int(pad[2 * i]), int(pad[2 * i + 1]))
+        else:
+            for i, ax in enumerate(reversed(spatial_axes)):
+                cfg[ax] = (int(pad[2 * i]), int(pad[2 * i + 1]))
+    mode_map = {"constant": "constant", "reflect": "reflect",
+                "replicate": "edge", "circular": "wrap"}
+    if mode == "constant":
+        return jnp.pad(x, cfg, mode="constant", constant_values=value)
+    return jnp.pad(x, cfg, mode=mode_map[mode])
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(jnp.square(x1), axis=axis))
+    n2 = jnp.sqrt(jnp.sum(jnp.square(x2), axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1.0 - epsilon) * label + epsilon * prior_dist
+    return (1.0 - epsilon) * label + epsilon / k
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    weight, bias = _unwrap(weight), _unwrap(bias)
+    # weight: (out_features, in1, in2)
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    channel_last = data_format[-1] == "C"
+    n_spatial = x.ndim - 2
+    spatial_axes = (list(range(1, 1 + n_spatial)) if channel_last
+                    else list(range(2, x.ndim)))
+    in_sizes = [x.shape[a] for a in spatial_axes]
+    if size is None:
+        if scale_factor is None:
+            raise ValueError("one of size/scale_factor required")
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * n_spatial
+        size = [int(i * s) for i, s in zip(in_sizes, sf)]
+    size = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size] * n_spatial)]
+
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    if mode == "nearest":
+        # index-map implementation (jax.image nearest differs from paddle rounding)
+        out = x
+        for ax, (i, o) in zip(spatial_axes, zip(in_sizes, size)):
+            idx = jnp.floor(jnp.arange(o) * (i / o)).astype(jnp.int32)
+            out = jnp.take(out, idx, axis=ax)
+        return out
+    new_shape = list(x.shape)
+    for ax, o in zip(spatial_axes, size):
+        new_shape[ax] = o
+    if align_corners:
+        # jax.image doesn't expose align_corners; emulate with explicit coords
+        out = x
+        for ax, (i, o) in zip(spatial_axes, zip(in_sizes, size)):
+            if o == 1 or i == 1:
+                coords = jnp.zeros(o)
+            else:
+                coords = jnp.arange(o) * ((i - 1) / (o - 1))
+            lo = jnp.floor(coords).astype(jnp.int32)
+            hi = jnp.clip(lo + 1, 0, i - 1)
+            w = (coords - lo).astype(x.dtype)
+            a = jnp.take(out, lo, axis=ax)
+            b = jnp.take(out, hi, axis=ax)
+            shape = [1] * x.ndim
+            shape[ax] = o
+            w = jnp.reshape(w, shape)
+            out = a * (1 - w) + b * w
+        return out
+    return jax.image.resize(x, tuple(new_shape), method=method)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: operators/unfold_op.cc)."""
+    from .conv import _tuplize
+    k = _tuplize(kernel_sizes, 2)
+    s = _tuplize(strides, 2)
+    p = _tuplize(paddings, 2)
+    d = _tuplize(dilations, 2)
+    n, c, h, w = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=k, window_strides=s,
+        padding=[(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: (N, C*kh*kw, oh, ow) → (N, C*kh*kw, oh*ow)
+    return jnp.reshape(patches, (n, patches.shape[1], -1))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from .conv import _tuplize
+    k = _tuplize(kernel_sizes, 2)
+    s = _tuplize(strides, 2)
+    p = _tuplize(paddings, 2)
+    d = _tuplize(dilations, 2)
+    oh, ow = _tuplize(output_sizes, 2)
+    n, ckk, l = x.shape
+    c = ckk // (k[0] * k[1])
+    # scatter-add each patch back (col2im)
+    out_h_idx = np.arange(0, oh + 2 * p[0] - d[0] * (k[0] - 1), s[0])
+    out_w_idx = np.arange(0, ow + 2 * p[1] - d[1] * (k[1] - 1), s[1])
+    nh, nw = len(out_h_idx), len(out_w_idx)
+    assert nh * nw == l, f"fold: {nh}x{nw} != {l}"
+    cols = jnp.reshape(x, (n, c, k[0], k[1], nh, nw))
+    out = jnp.zeros((n, c, oh + 2 * p[0], ow + 2 * p[1]), dtype=x.dtype)
+    for i in range(k[0]):
+        for j in range(k[1]):
+            hi = out_h_idx + i * d[0]
+            wi = out_w_idx + j * d[1]
+            out = out.at[:, :, hi[:, None], wi[None, :]].add(cols[:, :, i, j])
+    return out[:, :, p[0]:p[0] + oh, p[1]:p[1] + ow]
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample requires dynamic shapes; "
+                              "use ParallelCrossEntropy for large-class training")
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    lengths = jnp.asarray(lengths)
+    if maxlen is None:
+        maxlen = int(jnp.max(lengths))
+    row = jnp.arange(maxlen)
+    mask = row[None, :] < lengths[..., None]
+    from ...framework import dtype as dtype_mod
+    return mask.astype(dtype_mod.convert_dtype_to_jax(dtype))

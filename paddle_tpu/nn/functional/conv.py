@@ -1,0 +1,172 @@
+"""Convolutions via jax.lax.conv_general_dilated (reference:
+python/paddle/nn/functional/conv.py; cuDNN kernels operators/conv_op.* —
+on TPU XLA maps these directly onto the MXU).
+
+Weight layout follows the reference: (out_c, in_c/groups, *kernel).
+Data format defaults to the reference's channel-first; pass
+data_format="NHWC"/"NDHWC"/"NLC" for the TPU-preferred channel-last
+(XLA's layout assignment makes both fast, channel-last avoids transposes).
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _tuplize(v, n):
+    if isinstance(v, (int, float)):
+        return (int(v),) * n
+    v = tuple(int(x) for x in v)
+    return v * n if len(v) == 1 else v
+
+
+def _resolve_padding(padding, n, strides, dilations, ksize):
+    """Map paddle padding spec → lax padding list of (lo, hi)."""
+    if isinstance(padding, str):
+        p = padding.upper()
+        if p == "VALID":
+            return [(0, 0)] * n
+        if p == "SAME":
+            return "SAME"
+        raise ValueError(f"bad padding {padding!r}")
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        return [tuple(p) for p in padding]
+    raise ValueError(f"bad padding {padding!r}")
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    from ...amp import cast_if_amp
+    x, weight = cast_if_amp(f"conv{n}d", x, weight)
+    channel_last = data_format[-1] == "C"
+    stride = _tuplize(stride, n)
+    dilation = _tuplize(dilation, n)
+    ksize = weight.shape[2:]
+    pad = _resolve_padding(padding, n, stride, dilation, ksize)
+    lhs_spec, rhs_spec, out_spec = _dim_numbers(n, channel_last)
+    # weight arrives in reference layout (O, I/g, *K); lax wants per rhs_spec.
+    if channel_last:
+        perm = tuple(range(2, 2 + n)) + (1, 0)  # (K..., I, O)
+        w = jnp.transpose(weight, perm)
+    else:
+        w = weight
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=stride,
+        padding=pad,
+        rhs_dilation=dilation,
+        dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+        feature_group_count=groups,
+        preferred_element_type=None,
+    )
+    if bias is not None:
+        b = bias.value if hasattr(bias, "value") else bias
+        shape = [1] * out.ndim
+        shape[-1 if channel_last else 1] = b.size
+        out = out + jnp.reshape(b, shape).astype(out.dtype)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1,
+                    "NLC" if data_format in ("NLC", "NWC") else "NCW")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, n, data_format, output_size=None):
+    channel_last = data_format[-1] == "C"
+    stride = _tuplize(stride, n)
+    dilation = _tuplize(dilation, n)
+    ksize = weight.shape[2:]
+    pad = _resolve_padding(padding, n, stride, dilation, ksize)
+    if pad == "SAME":
+        pad = [((k - 1) // 2, k - 1 - (k - 1) // 2) for k in ksize]
+    out_pad = _tuplize(output_padding if output_padding is not None else 0, n)
+    lhs_spec, rhs_spec, out_spec = _dim_numbers(n, channel_last)
+    # Gradient-of-conv formulation: transposed conv = lhs-dilated conv with
+    # flipped, (I,O)-swapped kernel. Reference weight layout: (in_c, out_c/g, *K).
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + n)))
+    if groups > 1:
+        ic, ocg = w.shape[0], w.shape[1]
+        w = w.reshape((groups, ic // groups, ocg) + tuple(w.shape[2:]))
+        w = jnp.swapaxes(w, 1, 2)  # (g, ocg, icg, K)
+        w = w.reshape((groups * ocg, ic // groups) + tuple(w.shape[3:]))
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    if channel_last:
+        perm = tuple(range(2, 2 + n)) + (1, 0)
+        w = jnp.transpose(w, perm)
+    trans_pad = [
+        (d * (k - 1) - lo, d * (k - 1) - hi + op)
+        for (lo, hi), k, d, op in zip(pad, ksize, dilation, out_pad)
+    ]
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=(1,) * n,
+        padding=trans_pad,
+        lhs_dilation=stride,
+        rhs_dilation=dilation,
+        dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+        feature_group_count=groups,
+    )
+    if output_size is not None:
+        # Crop/pad spatial dims to requested size.
+        spatial_ax = tuple(range(1, 1 + n)) if channel_last else tuple(range(2, 2 + n))
+        slices = [slice(None)] * out.ndim
+        for ax, target in zip(spatial_ax, _tuplize(output_size, n)):
+            slices[ax] = slice(0, target)
+        out = out[tuple(slices)]
+    if bias is not None:
+        b = bias.value if hasattr(bias, "value") else bias
+        shape = [1] * out.ndim
+        shape[-1 if channel_last else 1] = b.size
+        out = out + jnp.reshape(b, shape)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 1,
+                              "NLC" if data_format in ("NLC", "NWC") else "NCW",
+                              output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 3, data_format, output_size)

@@ -1,0 +1,130 @@
+"""Pooling via lax.reduce_window (reference: python/paddle/nn/functional/pooling.py,
+operators/pool_op.*)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .conv import _tuplize, _resolve_padding
+
+
+def _window_dims(kernel, stride, pad, n, channel_last, x_ndim):
+    kernel = _tuplize(kernel, n)
+    stride = _tuplize(stride if stride is not None else kernel, n)
+    pads = _resolve_padding(pad, n, stride, (1,) * n, kernel)
+    if pads == "SAME":
+        pads = [( (k - 1) // 2, k - 1 - (k - 1) // 2) for k in kernel]
+    if channel_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        padding = [(0, 0)] + list(pads) + [(0, 0)]
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        padding = [(0, 0), (0, 0)] + list(pads)
+    return window, strides, padding
+
+
+def _max_pool(x, kernel, stride, padding, ceil_mode, n, data_format):
+    channel_last = data_format[-1] == "C"
+    window, strides, pads = _window_dims(kernel, stride, padding, n, channel_last, x.ndim)
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pads)
+
+
+def _avg_pool(x, kernel, stride, padding, ceil_mode, exclusive, n, data_format):
+    channel_last = data_format[-1] == "C"
+    window, strides, pads = _window_dims(kernel, stride, padding, n, channel_last, x.ndim)
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+    if exclusive and any(p != (0, 0) for p in pads):
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+        return summed / counts
+    return summed / float(np.prod(window))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _max_pool(x, kernel_size, stride, padding, ceil_mode, 1,
+                     "NLC" if data_format[-1] == "C" else "NCW")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _max_pool(x, kernel_size, stride, padding, ceil_mode, 2, data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _max_pool(x, kernel_size, stride, padding, ceil_mode, 3, data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive, 1,
+                     "NLC" if data_format[-1] == "C" else "NCW")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive, 2, data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive, 3, data_format)
+
+
+def _adaptive_starts_ends(in_size, out_size):
+    starts = (np.arange(out_size) * in_size) // out_size
+    ends = -(-(np.arange(1, out_size + 1) * in_size) // out_size)
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, n, data_format, op):
+    channel_last = data_format[-1] == "C"
+    out_sizes = _tuplize(output_size, n)
+    spatial_axes = tuple(range(1, 1 + n)) if channel_last else tuple(range(2, 2 + n))
+    # Fast path: input divisible by output — single reshape+reduce (XLA-friendly).
+    in_sizes = [x.shape[a] for a in spatial_axes]
+    if all(i % o == 0 for i, o in zip(in_sizes, out_sizes)):
+        shape = list(x.shape)
+        for a, o in zip(reversed(spatial_axes), reversed(out_sizes)):
+            i = shape[a]
+            shape[a:a + 1] = [o, i // o]
+        y = jnp.reshape(x, shape)
+        reduce_axes = tuple(a + 1 + k for k, a in enumerate(sorted(spatial_axes)))
+        return op(y, axis=reduce_axes)
+    # General path: per-axis segment reduction.
+    y = x
+    for k, (a, o) in enumerate(zip(spatial_axes, out_sizes)):
+        starts, ends = _adaptive_starts_ends(y.shape[a], o)
+        pieces = [op(jax.lax.slice_in_dim(y, int(s), int(e), axis=a), axis=a, keepdims=True)
+                  for s, e in zip(starts, ends)]
+        y = jnp.concatenate(pieces, axis=a)
+    return y
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", jnp.mean)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, jnp.mean)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, jnp.mean)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", jnp.max)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "NCHW", jnp.max)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "NCDHW", jnp.max)

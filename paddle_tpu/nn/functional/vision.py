@@ -1,0 +1,114 @@
+"""Vision functionals (reference: python/paddle/nn/functional/vision.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = jnp.reshape(x, (n, c // (r * r), r, r, h, w))
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return jnp.reshape(x, (n, c // (r * r), h * r, w * r))
+    n, h, w, c = x.shape
+    x = jnp.reshape(x, (n, h, w, r, r, c // (r * r)))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return jnp.reshape(x, (n, h * r, w * r, c // (r * r)))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = jnp.reshape(x, (n, c, h // r, r, w // r, r))
+        x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+        return jnp.reshape(x, (n, c * r * r, h // r, w // r))
+    n, h, w, c = x.shape
+    x = jnp.reshape(x, (n, h // r, r, w // r, r, c))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return jnp.reshape(x, (n, h // r, w // r, c * r * r))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = jnp.reshape(x, (n, groups, c // groups, h, w))
+        x = jnp.swapaxes(x, 1, 2)
+        return jnp.reshape(x, (n, c, h, w))
+    n, h, w, c = x.shape
+    x = jnp.reshape(x, (n, h, w, groups, c // groups))
+    x = jnp.swapaxes(x, 3, 4)
+    return jnp.reshape(x, (n, h, w, c))
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    n, _, h, w = out_shape if len(out_shape) == 4 else (out_shape[0], None, out_shape[1], out_shape[2])
+
+    def coords(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        return (jnp.arange(size) * 2 + 1) / size - 1.0
+
+    ys = coords(h)
+    xs = coords(w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)  # (H, W, 3)
+    grid = jnp.einsum("hwk,nqk->nhwq", base, theta)  # theta: (N, 2, 3)
+    return grid
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """x: (N,C,H,W), grid: (N,Hg,Wg,2) in [-1,1]."""
+    n, c, h, w = x.shape
+
+    def unnormalize(coord, size):
+        if align_corners:
+            return (coord + 1.0) / 2.0 * (size - 1)
+        return ((coord + 1.0) * size - 1.0) / 2.0
+
+    gx = unnormalize(grid[..., 0], w)
+    gy = unnormalize(grid[..., 1], h)
+
+    if padding_mode == "border":
+        gx = jnp.clip(gx, 0, w - 1)
+        gy = jnp.clip(gy, 0, h - 1)
+    elif padding_mode == "reflection":
+        def reflect(v, size):
+            if align_corners:
+                span = 2 * (size - 1)
+                v = jnp.abs(v) % span
+                return jnp.where(v > size - 1, span - v, v)
+            span = 2 * size
+            v = (jnp.abs(v + 0.5) % span)
+            v = jnp.where(v > size, span - v, v) - 0.5
+            return jnp.clip(v, 0, size - 1)
+        gx = reflect(gx, w)
+        gy = reflect(gy, h)
+
+    def gather_pix(ix, iy):
+        inb = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        out = x[jnp.arange(n)[:, None, None], :, iyc, ixc]  # (N,Hg,Wg,C)
+        return out * inb[..., None].astype(x.dtype)
+
+    if mode == "nearest":
+        out = gather_pix(jnp.round(gx).astype(jnp.int32), jnp.round(gy).astype(jnp.int32))
+        return jnp.moveaxis(out, -1, 1)
+
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = (gx - x0).astype(x.dtype)
+    wy = (gy - y0).astype(x.dtype)
+    v00 = gather_pix(x0, y0)
+    v01 = gather_pix(x1, y0)
+    v10 = gather_pix(x0, y1)
+    v11 = gather_pix(x1, y1)
+    out = (v00 * ((1 - wx) * (1 - wy))[..., None] + v01 * (wx * (1 - wy))[..., None]
+           + v10 * ((1 - wx) * wy)[..., None] + v11 * (wx * wy)[..., None])
+    return jnp.moveaxis(out, -1, 1)

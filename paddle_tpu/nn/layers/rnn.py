@@ -1,0 +1,309 @@
+"""RNN layers (reference: python/paddle/nn/layer/rnn.py; cuDNN kernels in
+operators/rnn_op.* — here the time loop is lax.scan, which XLA compiles into
+a single fused TPU loop; the per-step matmuls hit the MXU batched).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .. import functional as F
+from ..initializer import Uniform
+from ..layer import Layer
+from .container import LayerList
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        if isinstance(shape, (list, tuple)) and isinstance(shape[0], (list, tuple)):
+            return tuple(jnp.full((batch,) + tuple(s), init_value,
+                                  dtype=dtype or self._dtype) for s in shape)
+        return jnp.full((batch,) + tuple(shape), init_value,
+                        dtype=dtype or self._dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = Uniform(-std, std)
+        self.weight_ih = self.create_parameter((hidden_size, input_size),
+                                               attr=weight_ih_attr, initializer=u)
+        self.weight_hh = self.create_parameter((hidden_size, hidden_size),
+                                               attr=weight_hh_attr, initializer=u)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter(
+            (hidden_size,), attr=bias_ih_attr, is_bias=True, initializer=u)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter(
+            (hidden_size,), attr=bias_hh_attr, is_bias=True, initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = states
+        z = inputs @ self.weight_ih.value.T + h @ self.weight_hh.value.T
+        if self.bias_ih is not None:
+            z = z + self.bias_ih.value
+        if self.bias_hh is not None:
+            z = z + self.bias_hh.value
+        act = jnp.tanh if self.activation == "tanh" else getattr(F, self.activation)
+        h = act(z)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = Uniform(-std, std)
+        self.weight_ih = self.create_parameter((4 * hidden_size, input_size),
+                                               attr=weight_ih_attr, initializer=u)
+        self.weight_hh = self.create_parameter((4 * hidden_size, hidden_size),
+                                               attr=weight_hh_attr, initializer=u)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter(
+            (4 * hidden_size,), attr=bias_ih_attr, is_bias=True, initializer=u)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter(
+            (4 * hidden_size,), attr=bias_hh_attr, is_bias=True, initializer=u)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        gates = inputs @ self.weight_ih.value.T + h @ self.weight_hh.value.T
+        if self.bias_ih is not None:
+            gates = gates + self.bias_ih.value
+        if self.bias_hh is not None:
+            gates = gates + self.bias_hh.value
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = Uniform(-std, std)
+        self.weight_ih = self.create_parameter((3 * hidden_size, input_size),
+                                               attr=weight_ih_attr, initializer=u)
+        self.weight_hh = self.create_parameter((3 * hidden_size, hidden_size),
+                                               attr=weight_hh_attr, initializer=u)
+        self.bias_ih = None if bias_ih_attr is False else self.create_parameter(
+            (3 * hidden_size,), attr=bias_ih_attr, is_bias=True, initializer=u)
+        self.bias_hh = None if bias_hh_attr is False else self.create_parameter(
+            (3 * hidden_size,), attr=bias_hh_attr, is_bias=True, initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = states
+        x_g = inputs @ self.weight_ih.value.T
+        if self.bias_ih is not None:
+            x_g = x_g + self.bias_ih.value
+        h_g = h @ self.weight_hh.value.T
+        if self.bias_hh is not None:
+            h_g = h_g + self.bias_hh.value
+        x_r, x_z, x_c = jnp.split(x_g, 3, axis=-1)
+        h_r, h_z, h_c = jnp.split(h_g, 3, axis=-1)
+        r = jax.nn.sigmoid(x_r + h_r)
+        z = jax.nn.sigmoid(x_z + h_z)
+        c = jnp.tanh(x_c + r * h_c)
+        h = (1.0 - z) * c + z * h
+        return h, h
+
+
+def _scan_rnn(cell, inputs, init_states, time_major, reverse=False):
+    """Run `cell` over the time axis with lax.scan via the functionalization
+    bridge (cell params become scan-carried constants)."""
+    from ...jit.functionalization import state_of
+
+    params, buffers = state_of(cell)
+    xs = inputs if time_major else jnp.swapaxes(inputs, 0, 1)
+    if reverse:
+        xs = jnp.flip(xs, axis=0)
+
+    final, outs = jax.lax.scan(lambda c, x: _step_impl(cell, params, buffers, c, x),
+                               init_states, xs)
+    if reverse:
+        outs = jnp.flip(outs, axis=0)
+    if not time_major:
+        outs = jnp.swapaxes(outs, 0, 1)
+    return outs, final
+
+
+def _step_impl(cell, params, buffers, carry, x_t):
+    from ...jit.functionalization import functional_call
+    (out, new_state), _ = functional_call(cell, params, buffers, x_t, carry)
+    return new_state, out
+
+
+class RNN(Layer):
+    """Wrap a cell into a sequence-level scan (reference: nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            batch_idx = 1 if self.time_major else 0
+            initial_states = self.cell.get_initial_states(inputs,
+                                                          batch_dim_idx=batch_idx)
+        outs, final = _scan_rnn(self.cell, inputs, initial_states,
+                                self.time_major, self.is_reverse)
+        return outs, final
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw, self.cell_bw = cell_fw, cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            batch_idx = 1 if self.time_major else 0
+            init_fw = self.cell_fw.get_initial_states(inputs, batch_dim_idx=batch_idx)
+            init_bw = self.cell_bw.get_initial_states(inputs, batch_dim_idx=batch_idx)
+        else:
+            init_fw, init_bw = initial_states
+        out_fw, fin_fw = _scan_rnn(self.cell_fw, inputs, init_fw, self.time_major)
+        out_bw, fin_bw = _scan_rnn(self.cell_bw, inputs, init_bw, self.time_major,
+                                   reverse=True)
+        return jnp.concatenate([out_fw, out_bw], axis=-1), (fin_fw, fin_bw)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, **cell_kw):
+        super().__init__()
+        self.mode = mode
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if bidirect else 1
+        self.direction = direction
+
+        def make_cell(isz):
+            kw = dict(weight_ih_attr=weight_ih_attr, weight_hh_attr=weight_hh_attr,
+                      bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr, **cell_kw)
+            if mode == "LSTM":
+                return LSTMCell(isz, hidden_size, **kw)
+            if mode == "GRU":
+                return GRUCell(isz, hidden_size, **kw)
+            return SimpleRNNCell(isz, hidden_size, **kw)
+
+        rnns = []
+        for i in range(num_layers):
+            isz = input_size if i == 0 else hidden_size * self.num_directions
+            if bidirect:
+                rnns.append(BiRNN(make_cell(isz), make_cell(isz), time_major))
+            else:
+                rnns.append(RNN(make_cell(isz), is_reverse=(direction == "backward"),
+                                time_major=time_major))
+        self.rnns = LayerList(rnns)
+
+    @property
+    def state_components(self):
+        return 2 if self.mode == "LSTM" else 1
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        out = inputs
+        finals = []
+        for i, rnn_l in enumerate(self.rnns):
+            init = None
+            if initial_states is not None:
+                if self.mode == "LSTM":
+                    h_all, c_all = initial_states
+                    if self.num_directions == 2:
+                        init = ((h_all[2 * i], c_all[2 * i]),
+                                (h_all[2 * i + 1], c_all[2 * i + 1]))
+                    else:
+                        init = (h_all[i], c_all[i])
+                else:
+                    h_all = initial_states
+                    if self.num_directions == 2:
+                        init = (h_all[2 * i], h_all[2 * i + 1])
+                    else:
+                        init = h_all[i]
+            out, fin = rnn_l(out, init)
+            finals.append(fin)
+            if self.dropout > 0.0 and i < self.num_layers - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+        # stack finals: (num_layers*num_directions, B, H) [x2 for LSTM]
+        if self.mode == "LSTM":
+            hs, cs = [], []
+            for fin in finals:
+                if self.num_directions == 2:
+                    (h_f, c_f), (h_b, c_b) = fin
+                    hs += [h_f, h_b]
+                    cs += [c_f, c_b]
+                else:
+                    h, c = fin
+                    hs.append(h)
+                    cs.append(c)
+            return out, (jnp.stack(hs, 0), jnp.stack(cs, 0))
+        hs = []
+        for fin in finals:
+            if self.num_directions == 2:
+                h_f, h_b = fin
+                hs += [h_f, h_b]
+            else:
+                hs.append(fin)
+        return out, jnp.stack(hs, 0)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kw):
+        super().__init__("RNN", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation=activation, **kw)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)

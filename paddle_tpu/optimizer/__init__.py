@@ -1,0 +1,18 @@
+"""paddle_tpu.optimizer (reference: python/paddle/optimizer/__init__.py)."""
+from . import lr  # noqa: F401
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .optimizer import (  # noqa: F401
+    SGD, Adagrad, Adam, Adamax, AdamW, Lamb, Lars, LarsMomentum, Momentum,
+    Optimizer, RMSProp)
+
+
+class L2Decay:
+    """Weight-decay spec (reference: python/paddle/regularizer.py L2Decay)."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)

@@ -1,0 +1,71 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py —
+ClipGradByValue/Norm/GlobalNorm). Pure functions over grad pytrees, so they
+compose into the jitted update; the distributed engine overrides the norm
+reduction to span the whole mesh (HybridParallelClipGrad semantics,
+reference: fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:42).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def __call__(self, grads: dict) -> dict:
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, grads):
+        return {k: jnp.clip(g, self.min, self.max) if g is not None else None
+                for k, g in grads.items()}
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads):
+        out = {}
+        for k, g in grads.items():
+            if g is None:
+                out[k] = None
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out[k] = (g * scale).astype(g.dtype)
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+        # Distributed hook: set by HybridParallelOptimizer to sum squared
+        # norms across mesh axes (lax.psum) before scaling.
+        self.norm_reduce_fn = None
+
+    def global_norm_sq(self, grads):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for g in grads.values() if g is not None]
+        total = jnp.sum(jnp.stack(sq)) if sq else jnp.zeros(())
+        if self.norm_reduce_fn is not None:
+            total = self.norm_reduce_fn(total)
+        return total
+
+    def __call__(self, grads):
+        total = self.global_norm_sq(grads)
+        gnorm = jnp.sqrt(total)
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        return {k: (g * scale).astype(g.dtype) if g is not None else None
+                for k, g in grads.items()}
+
+
+def clip_grads(grads, clip):
+    if clip is None:
+        return grads
+    return clip(grads)

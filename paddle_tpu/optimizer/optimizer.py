@@ -1,0 +1,383 @@
+"""Optimizers (reference: python/paddle/optimizer/ — adam.py, adamw.py,
+momentum.py, lamb.py, …; CUDA kernels in operators/optimizers/).
+
+Design: every optimizer defines two pure functions over per-parameter pytrees
+(`init_slots`, `update`) that the jitted training step calls via
+``apply_gradients(params, grads, state, lr)``; the imperative ``step()`` API
+of the reference is a thin eager wrapper over the same path. Slot variables
+(moments etc.) are plain dicts of jax arrays → they shard with the parameters
+under pjit (ZeRO-style optimizer-state sharding falls out of NamedSharding).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Parameter
+from .clip import clip_grads
+from .lr import LRScheduler
+
+
+class Optimizer:
+    _slot_names = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        self._lr = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self.regularization = weight_decay
+        self._grad_clip = grad_clip
+        self._accumulators: Dict[str, dict] = {}
+        self._step_count = 0
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value: float):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- functional core ----------------------------------------------------
+    def _wd_coeff(self):
+        wd = self.regularization
+        if wd is None:
+            return 0.0, False
+        if isinstance(wd, (int, float)):
+            return float(wd), self._decoupled_wd
+        coeff = getattr(wd, "coeff", None)
+        if coeff is None:
+            coeff = getattr(wd, "_regularization_coeff", 0.0)
+        return float(coeff), self._decoupled_wd
+
+    _decoupled_wd = False  # True for AdamW/Lars-style decoupled decay
+
+    def init_slots(self, value):
+        """Per-parameter slot pytree (dict of arrays)."""
+        return {}
+
+    def update(self, p, g, slots, lr, step):
+        """Pure per-parameter update → (new_p, new_slots)."""
+        raise NotImplementedError
+
+    def _update_with_key(self, key, p, g, slots, lr, step):
+        """Per-key hook (Lamb/Lars use it for per-name decay exclusion)."""
+        return self.update(p, g, slots, lr, step)
+
+    def init_state(self, params: Dict[str, jax.Array]):
+        return {"step": jnp.zeros((), jnp.int32),
+                "slots": {k: self.init_slots(v) for k, v in params.items()}}
+
+    def apply_gradients(self, params: Dict[str, jax.Array],
+                        grads: Dict[str, Optional[jax.Array]],
+                        state, lr=None, lr_scales: Optional[Dict[str, float]] = None):
+        """Pure: (params, grads, state) → (new_params, new_state)."""
+        lr = self.get_lr() if lr is None else lr
+        grads = clip_grads(grads, self._grad_clip)
+        wd, decoupled = self._wd_coeff()
+        step = state["step"] + 1
+        new_params, new_slots = {}, {}
+        for k, p in params.items():
+            g = grads.get(k)
+            if g is None:
+                new_params[k] = p
+                new_slots[k] = state["slots"][k]
+                continue
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if wd and not decoupled:
+                g = g + wd * p32
+            p_lr = lr * (lr_scales.get(k, 1.0) if lr_scales else 1.0)
+            np_, ns = self._update_with_key(k, p32, g, state["slots"][k],
+                                            p_lr, step)
+            if wd and decoupled:
+                np_ = np_ - p_lr * wd * p32
+            new_params[k] = np_.astype(p.dtype)
+            new_slots[k] = ns
+        return new_params, {"step": step, "slots": new_slots}
+
+    # -- imperative API ------------------------------------------------------
+    def _ensure_eager_state(self):
+        if not hasattr(self, "_eager_state") or self._eager_state is None:
+            params = OrderedDict((p.name, p.value) for p in self._parameter_list)
+            self._eager_state = self.init_state(params)
+
+    def step(self):
+        """Eager update from Parameter.grad (reference: optimizer.step())."""
+        if self._parameter_list is None:
+            raise ValueError("optimizer created without parameters")
+        self._ensure_eager_state()
+        # include frozen params with grad=None so their slot state survives
+        # a later un-freeze (apply_gradients skips None grads).
+        params = OrderedDict((p.name, p.value) for p in self._parameter_list)
+        grads = OrderedDict(
+            (p.name, p.grad if p.trainable else None)
+            for p in self._parameter_list)
+        lr_scales = {p.name: p.optimize_attr.get("learning_rate", 1.0)
+                     for p in self._parameter_list}
+        new_params, self._eager_state = self.apply_gradients(
+            params, grads, self._eager_state, lr_scales=lr_scales)
+        for p in self._parameter_list:
+            if p.trainable and p.name in new_params:
+                p.value = new_params[p.name]
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        self.step()
+        return None, None
+
+    def clear_grad(self):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.grad = None
+
+    clear_gradients = clear_grad
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self):
+        out = {}
+        if getattr(self, "_eager_state", None) is not None:
+            out["state"] = jax.tree_util.tree_map(lambda x: x, self._eager_state)
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state_dict):
+        if "state" in state_dict:
+            self._eager_state = state_dict["state"]
+        if "LR_Scheduler" in state_dict and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state_dict["LR_Scheduler"])
+
+
+class SGD(Optimizer):
+    def update(self, p, g, slots, lr, step):
+        return p - lr * g, slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None,
+                 **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def init_slots(self, value):
+        return {"velocity": jnp.zeros(value.shape, jnp.float32)}
+
+    def update(self, p, g, slots, lr, step):
+        v = self._momentum * slots["velocity"] + g
+        if self._use_nesterov:
+            p = p - lr * (g + self._momentum * v)
+        else:
+            p = p - lr * v
+        return p, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_slots(self, value):
+        return {"moment": jnp.full(value.shape, self._init_acc, jnp.float32)}
+
+    def update(self, p, g, slots, lr, step):
+        m = slots["moment"] + jnp.square(g)
+        p = p - lr * g / (jnp.sqrt(m) + self._epsilon)
+        return p, {"moment": m}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def init_slots(self, value):
+        return {"m": jnp.zeros(value.shape, jnp.float32),
+                "v": jnp.zeros(value.shape, jnp.float32)}
+
+    def update(self, p, g, slots, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["m"] + (1 - b1) * g
+        v = b2 * slots["v"] + (1 - b2) * jnp.square(g)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        p = p - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return p, {"m": m, "v": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    _decoupled_wd = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision, name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def apply_gradients(self, params, grads, state, lr=None, lr_scales=None):
+        if self._apply_decay_param_fun is None:
+            return super().apply_gradients(params, grads, state, lr, lr_scales)
+        # split decay/no-decay groups per the user predicate on param name
+        fn = self._apply_decay_param_fun
+        saved = self.regularization
+        decay_keys = {k for k in params if fn(k)}
+        lr = self.get_lr() if lr is None else lr
+        grads = clip_grads(grads, self._grad_clip)
+        wd, _ = self._wd_coeff()
+        step = state["step"] + 1
+        new_params, new_slots = {}, {}
+        for k, p in params.items():
+            g = grads.get(k)
+            if g is None:
+                new_params[k], new_slots[k] = p, state["slots"][k]
+                continue
+            p32, g = p.astype(jnp.float32), g.astype(jnp.float32)
+            p_lr = lr * (lr_scales.get(k, 1.0) if lr_scales else 1.0)
+            np_, ns = self.update(p32, g, state["slots"][k], p_lr, step)
+            if wd and k in decay_keys:
+                np_ = np_ - p_lr * wd * p32
+            new_params[k] = np_.astype(p.dtype)
+            new_slots[k] = ns
+        self.regularization = saved
+        return new_params, {"step": step, "slots": new_slots}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def init_slots(self, value):
+        return {"m": jnp.zeros(value.shape, jnp.float32),
+                "u": jnp.zeros(value.shape, jnp.float32)}
+
+    def update(self, p, g, slots, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["m"] + (1 - b1) * g
+        u = jnp.maximum(b2 * slots["u"], jnp.abs(g))
+        t = step.astype(jnp.float32)
+        p = p - (lr / (1 - b1 ** t)) * m / (u + self._epsilon)
+        return p, {"m": m, "u": u}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def init_slots(self, value):
+        s = {"mean_square": jnp.zeros(value.shape, jnp.float32),
+             "momentum": jnp.zeros(value.shape, jnp.float32)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros(value.shape, jnp.float32)
+        return s
+
+    def update(self, p, g, slots, lr, step):
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * jnp.square(g)
+        out = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+            out["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * slots["momentum"] + lr * g / denom
+        out["momentum"] = mom
+        return p - mom, out
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments (reference: python/paddle/optimizer/lamb.py,
+    operators/optimizers/lamb_op.h)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_slots(self, value):
+        return {"m": jnp.zeros(value.shape, jnp.float32),
+                "v": jnp.zeros(value.shape, jnp.float32)}
+
+    def _update_with_key(self, key, p, g, slots, lr, step):
+        wd = self._wd
+        if self._exclude_fn is not None and self._exclude_fn(key):
+            wd = 0.0
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["m"] + (1 - b1) * g
+        v = b2 * slots["v"] + (1 - b2) * jnp.square(g)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + wd * p
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        return p - lr * trust * r, {"m": m, "v": v}
+
+    def update(self, p, g, slots, lr, step):
+        return self._update_with_key("", p, g, slots, lr, step)
+
+
+class Lars(Momentum):
+    """LARS momentum (reference: operators/optimizers/lars_momentum_op.cc)."""
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0, name=None):
+        super().__init__(learning_rate, momentum, parameters, False, None,
+                         grad_clip, name)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._exclude_list = list(exclude_from_weight_decay or [])
+        self._eps = epsilon
+
+    def _update_with_key(self, key, p, g, slots, lr, step):
+        wd = self._lars_wd
+        if any(sub in key for sub in self._exclude_list):
+            wd = 0.0
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            lr * self._lars_coeff * p_norm /
+            (g_norm + wd * p_norm + self._eps),
+            lr)
+        v = self._momentum * slots["velocity"] + local_lr * (g + wd * p)
+        return p - v, {"velocity": v}
+
+    def update(self, p, g, slots, lr, step):
+        return self._update_with_key("", p, g, slots, lr, step)
+
+
+LarsMomentum = Lars

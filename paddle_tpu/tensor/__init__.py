@@ -1,0 +1,10 @@
+"""paddle_tpu.tensor — tensor op namespace (reference: python/paddle/tensor/)."""
+from . import creation, linalg, logic, manipulation, math, random, stat  # noqa: F401
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .stat import (  # noqa: F401
+    median, nanmean, nanmedian, nanquantile, nansum, quantile, std, var)

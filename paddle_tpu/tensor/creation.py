@@ -1,0 +1,119 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py).
+
+A Tensor in this framework IS a ``jax.Array`` — there is no wrapper class.
+The reference's LoDTensor ragged metadata is deliberately not replicated:
+variable-length sequences are handled with padding+masks (TPU/XLA requires
+static shapes; see SURVEY.md §5 long-context notes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+
+Tensor = jax.Array
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    dt = dtype_mod.convert_dtype_to_jax(dtype)
+    x = jnp.asarray(data, dtype=dt)
+    if place is not None:
+        x = jax.device_put(x, place)
+    return x
+
+
+def zeros(shape, dtype=None, name=None):
+    return jnp.zeros(shape, dtype=dtype_mod.convert_dtype_to_jax(dtype) or dtype_mod.get_default_dtype())
+
+
+def ones(shape, dtype=None, name=None):
+    return jnp.ones(shape, dtype=dtype_mod.convert_dtype_to_jax(dtype) or dtype_mod.get_default_dtype())
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    return jnp.full(shape, fill_value, dtype=dtype_mod.convert_dtype_to_jax(dtype))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return jnp.zeros_like(x, dtype=dtype_mod.convert_dtype_to_jax(dtype))
+
+
+def ones_like(x, dtype=None, name=None):
+    return jnp.ones_like(x, dtype=dtype_mod.convert_dtype_to_jax(dtype))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return jnp.full_like(x, fill_value, dtype=dtype_mod.convert_dtype_to_jax(dtype))
+
+
+def empty_like(x, dtype=None, name=None):
+    return jnp.zeros_like(x, dtype=dtype_mod.convert_dtype_to_jax(dtype))
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step, dtype=dtype_mod.convert_dtype_to_jax(dtype))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return jnp.linspace(start, stop, int(num), dtype=dtype_mod.convert_dtype_to_jax(dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return jnp.eye(num_rows, num_columns, dtype=dtype_mod.convert_dtype_to_jax(dtype) or dtype_mod.get_default_dtype())
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = jnp.asarray(x)
+    if x.ndim == 1 and padding_value != 0:
+        n = x.shape[0] + abs(offset)
+        base = jnp.full((n, n), padding_value, dtype=x.dtype)
+        return base + jnp.diag(x - padding_value, k=offset)
+    return jnp.diag(x, k=offset)
+
+
+def diagflat(x, offset=0, name=None):
+    return jnp.diagflat(x, k=offset)
+
+
+def tril(x, diagonal=0, name=None):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal=0, name=None):
+    return jnp.triu(x, k=diagonal)
+
+
+def meshgrid(*args, **kwargs):
+    return jnp.meshgrid(*args, indexing="ij")
+
+
+def assign(x, output=None):
+    return jnp.asarray(x)
+
+
+def clone(x, name=None):
+    return jnp.array(x, copy=True)
+
+
+def numel(x, name=None):
+    return jnp.asarray(x.size, dtype=jnp.int64 if False else jnp.int32)
+
+
+def shape(x):
+    return jnp.asarray(x.shape, dtype=jnp.int32)
+
+
+def tolist(x):
+    return np.asarray(x).tolist()
+
+
+def complex(real, imag, name=None):
+    return jax.lax.complex(real, imag)

@@ -1,0 +1,128 @@
+"""Linear algebra (reference: python/paddle/tensor/linalg.py; cuBLAS/cuSOLVER
+kernels in operators/math/ — on TPU these are XLA MXU matmuls / host-offloaded
+decompositions)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    if p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.linalg.norm(x, ord="fro" if isinstance(axis, (list, tuple)) else None,
+                               axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis,
+                               keepdims=keepdim)
+    if p == float("inf") or p == "inf":
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf") or p == "-inf":
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.sum(jnp.abs(x) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+
+def dist(x, y, p=2, name=None):
+    return norm(x - y, p=p)
+
+
+def cross(x, y, axis=9, name=None):
+    if axis == 9:  # paddle default: first axis of size 3
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return jnp.cross(x, y, axis=axis)
+
+
+def cholesky(x, upper=False, name=None):
+    out = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(out, -1, -2).conj() if upper else out
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+def inverse(x, name=None):
+    return jnp.linalg.inv(x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def det(x, name=None):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x, name=None):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabs])
+
+
+def svd(x, full_matrices=False, name=None):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def qr(x, mode="reduced", name=None):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def eig(x, name=None):
+    return jnp.linalg.eig(x)
+
+
+def eigh(x, UPLO="L", name=None):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigvals(x, name=None):
+    return jnp.linalg.eigvals(x)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def matrix_power(x, n, name=None):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def solve(x, y, name=None):
+    return jnp.linalg.solve(x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+def multi_dot(x, name=None):
+    return jnp.linalg.multi_dot(x)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    import numpy as np
+    arr = np.asarray(input)
+    if min == 0 and max == 0:
+        min, max = float(arr.min()), float(arr.max())
+    hist, _ = np.histogram(arr, bins=bins, range=(min, max))
+    return jnp.asarray(hist)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)

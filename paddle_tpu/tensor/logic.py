@@ -1,0 +1,81 @@
+"""Comparison / logical ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def equal(x, y, name=None):
+    return jnp.equal(x, y)
+
+
+def not_equal(x, y, name=None):
+    return jnp.not_equal(x, y)
+
+
+def greater_than(x, y, name=None):
+    return jnp.greater(x, y)
+
+
+def greater_equal(x, y, name=None):
+    return jnp.greater_equal(x, y)
+
+
+def less_than(x, y, name=None):
+    return jnp.less(x, y)
+
+
+def less_equal(x, y, name=None):
+    return jnp.less_equal(x, y)
+
+
+def logical_and(x, y, out=None, name=None):
+    return jnp.logical_and(x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return jnp.logical_or(x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return jnp.logical_xor(x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return jnp.logical_not(x)
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return jnp.bitwise_and(x, y)
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return jnp.bitwise_or(x, y)
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return jnp.bitwise_xor(x, y)
+
+
+def bitwise_not(x, out=None, name=None):
+    return jnp.bitwise_not(x)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def equal_all(x, y, name=None):
+    return jnp.array_equal(x, y)
+
+
+def is_empty(x, name=None):
+    return jnp.asarray(x.size == 0)
+
+
+def is_tensor(x):
+    import jax
+    return isinstance(x, jax.Array)

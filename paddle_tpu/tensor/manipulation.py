@@ -1,0 +1,323 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py).
+
+All static-shape; the reference's LoD/sequence ops are covered by mask-based
+equivalents in nn.functional (TPU requires static shapes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+
+
+def reshape(x, shape, name=None):
+    return jnp.reshape(x, tuple(int(s) for s in shape))
+
+
+def transpose(x, perm, name=None):
+    return jnp.transpose(x, axes=perm)
+
+
+def t(x, name=None):
+    if x.ndim <= 1:
+        return x
+    return jnp.swapaxes(x, -1, -2)
+
+
+def moveaxis(x, source, destination, name=None):
+    return jnp.moveaxis(x, source, destination)
+
+
+def concat(x, axis=0, name=None):
+    return jnp.concatenate(list(x), axis=int(axis))
+
+
+def stack(x, axis=0, name=None):
+    return jnp.stack(list(x), axis=axis)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num if num is not None else x.shape[axis]
+    return [jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis)]
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if any(s in (-1, None) for s in sections):
+        known = sum(s for s in sections if s not in (-1, None))
+        sections = [total - known if s in (-1, None) else s for s in sections]
+    idx = np.cumsum(sections)[:-1]
+    return jnp.split(x, idx, axis=axis)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return jnp.array_split(x, chunks, axis=axis)
+
+
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    axes = tuple(a for a in axes if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axes) if axes else x
+
+
+def unsqueeze(x, axis, name=None):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.expand_dims(x, axis=axes)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    ndim = x.ndim
+    start = start_axis % ndim if ndim else 0
+    stop = stop_axis % ndim if ndim else 0
+    new_shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+def flip(x, axis, name=None):
+    return jnp.flip(x, axis=axis)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def tile(x, repeat_times, name=None):
+    return jnp.tile(x, tuple(repeat_times))
+
+
+def expand(x, shape, name=None):
+    shape = tuple(x.shape[i - (len(shape) - x.ndim)] if s in (-1, None) and i >= len(shape) - x.ndim
+                  else s for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    shape = np.broadcast_shapes(*[tuple(i.shape) for i in inputs])
+    return [jnp.broadcast_to(i, shape) for i in inputs]
+
+
+def cast(x, dtype):
+    return x.astype(dtype_mod.convert_dtype_to_jax(dtype))
+
+
+def slice(x, axes, starts, ends, name=None):
+    idx = [jnp.s_[:]] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = jnp.s_[st:en]
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    idx = [jnp.s_[:]] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = jnp.s_[st:en:sd]
+    return x[tuple(idx)]
+
+
+def gather(x, index, axis=0, name=None):
+    index = jnp.reshape(index, (-1,))
+    return jnp.take(x, index, axis=axis)
+
+
+def gather_nd(x, index, name=None):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    index = jnp.reshape(index, (-1,))
+    if overwrite:
+        return x.at[index].set(updates)
+    # accumulate semantics: zero out target rows then add
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    x = jnp.zeros(tuple(shape), dtype=updates.dtype)
+    return scatter_nd_add(x, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return jnp.take(x, jnp.reshape(index, (-1,)), axis=axis)
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return jnp.take_along_axis(arr, indices, axis=axis)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    values = jnp.broadcast_to(jnp.asarray(values, dtype=arr.dtype), indices.shape)
+    dims = [jnp.arange(s).reshape([-1 if i == d else 1 for i in range(arr.ndim)])
+            for d, s in enumerate(indices.shape)]
+    idx = tuple(indices if d == (axis % arr.ndim) else jnp.broadcast_to(dims[d], indices.shape)
+                for d in range(arr.ndim))
+    if reduce == "assign":
+        return arr.at[idx].set(values)
+    if reduce == "add":
+        return arr.at[idx].add(values)
+    if reduce == "multiply" or reduce == "mul":
+        return arr.at[idx].multiply(values)
+    raise ValueError(f"unknown reduce {reduce!r}")
+
+
+def masked_select(x, mask, name=None):
+    # Dynamic output size — host-side only (not jittable), like np.
+    return jnp.asarray(np.asarray(x)[np.asarray(mask)])
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    res = jnp.unique(np.asarray(x), return_index=return_index,
+                     return_inverse=return_inverse, return_counts=return_counts,
+                     axis=axis)
+    return res
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    xs = np.asarray(x)
+    out = []
+    if axis is None:
+        xs = xs.reshape(-1)
+    keep = np.ones(xs.shape[0], dtype=bool)
+    keep[1:] = np.any(xs[1:] != xs[:-1], axis=tuple(range(1, xs.ndim))) if xs.ndim > 1 \
+        else xs[1:] != xs[:-1]
+    vals = xs[keep]
+    out.append(jnp.asarray(vals))
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        out.append(jnp.asarray(inv))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, xs.shape[0]))
+        out.append(jnp.asarray(counts))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis=axis)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    return jnp.where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    res = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(np.stack(res, axis=1))
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    out = jnp.sort(x, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    out = jnp.argsort(x, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if axis is None:
+        axis = -1
+    x_m = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(x_m, k)
+    else:
+        vals, idx = jax.lax.top_k(-x_m, k)
+        vals = -vals
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(dtype_mod.convert_dtype_to_jax(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return out.astype(dtype_mod.convert_dtype_to_jax(dtype))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            sorted_sequence.reshape(-1, sorted_sequence.shape[-1]),
+            values.reshape(-1, values.shape[-1]))
+        out = out.reshape(values.shape)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    offsets = offsets or [0] * x.ndim
+    shape = shape or list(x.shape)
+    idx = tuple(jnp.s_[o:o + s] for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ..nn.functional.common import pad as _pad
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def as_complex(x, name=None):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_real(x, name=None):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def view(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def tensordot(x, y, axes=2, name=None):
+    return jnp.tensordot(x, y, axes=axes)

@@ -1,0 +1,408 @@
+"""Elementwise / reduction / misc math ops.
+
+Reference: python/paddle/tensor/math.py (+ operators/elementwise/,
+operators/reduce_ops/ kernels). On TPU these all lower to single XLA HLOs;
+XLA fuses elementwise chains automatically (replacing the reference's
+fused_elemwise_activation etc.).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtype_mod
+
+
+def _axis(a):
+    return None if a is None else a
+
+
+# -- binary elementwise ----------------------------------------------------
+def add(x, y, name=None):
+    return jnp.add(x, y)
+
+
+def subtract(x, y, name=None):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y, name=None):
+    return jnp.multiply(x, y)
+
+
+def divide(x, y, name=None):
+    return jnp.divide(x, y)
+
+
+def floor_divide(x, y, name=None):
+    return jnp.floor_divide(x, y)
+
+
+def remainder(x, y, name=None):
+    return jnp.remainder(x, y)
+
+
+mod = remainder
+floor_mod = remainder
+
+
+def pow(x, y, name=None):
+    return jnp.power(x, y)
+
+
+def maximum(x, y, name=None):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y, name=None):
+    return jnp.minimum(x, y)
+
+
+def fmax(x, y, name=None):
+    return jnp.fmax(x, y)
+
+
+def fmin(x, y, name=None):
+    return jnp.fmin(x, y)
+
+
+def atan2(x, y, name=None):
+    return jnp.arctan2(x, y)
+
+
+def logaddexp(x, y, name=None):
+    return jnp.logaddexp(x, y)
+
+
+def inner(x, y, name=None):
+    return jnp.inner(x, y)
+
+
+def outer(x, y, name=None):
+    return jnp.outer(x, y)
+
+
+# -- unary elementwise -----------------------------------------------------
+def sqrt(x, name=None):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x, name=None):
+    return jax.lax.rsqrt(x)
+
+
+def exp(x, name=None):
+    return jnp.exp(x)
+
+
+def expm1(x, name=None):
+    return jnp.expm1(x)
+
+
+def log(x, name=None):
+    return jnp.log(x)
+
+
+def log2(x, name=None):
+    return jnp.log2(x)
+
+
+def log10(x, name=None):
+    return jnp.log10(x)
+
+
+def log1p(x, name=None):
+    return jnp.log1p(x)
+
+
+def abs(x, name=None):
+    return jnp.abs(x)
+
+
+def ceil(x, name=None):
+    return jnp.ceil(x)
+
+
+def floor(x, name=None):
+    return jnp.floor(x)
+
+
+def round(x, name=None):
+    return jnp.round(x)
+
+
+def trunc(x, name=None):
+    return jnp.trunc(x)
+
+
+def sin(x, name=None):
+    return jnp.sin(x)
+
+
+def cos(x, name=None):
+    return jnp.cos(x)
+
+
+def tan(x, name=None):
+    return jnp.tan(x)
+
+
+def asin(x, name=None):
+    return jnp.arcsin(x)
+
+
+def acos(x, name=None):
+    return jnp.arccos(x)
+
+
+def atan(x, name=None):
+    return jnp.arctan(x)
+
+
+def sinh(x, name=None):
+    return jnp.sinh(x)
+
+
+def cosh(x, name=None):
+    return jnp.cosh(x)
+
+
+def tanh(x, name=None):
+    return jnp.tanh(x)
+
+
+def asinh(x, name=None):
+    return jnp.arcsinh(x)
+
+
+def acosh(x, name=None):
+    return jnp.arccosh(x)
+
+
+def atanh(x, name=None):
+    return jnp.arctanh(x)
+
+
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(x)
+
+
+def square(x, name=None):
+    return jnp.square(x)
+
+
+def reciprocal(x, name=None):
+    return jnp.reciprocal(x)
+
+
+def sign(x, name=None):
+    return jnp.sign(x)
+
+
+def neg(x, name=None):
+    return jnp.negative(x)
+
+
+def erf(x, name=None):
+    return jax.scipy.special.erf(x)
+
+
+def erfinv(x, name=None):
+    return jax.scipy.special.erfinv(x)
+
+
+def digamma(x, name=None):
+    return jax.scipy.special.digamma(x)
+
+
+def lgamma(x, name=None):
+    return jax.scipy.special.gammaln(x)
+
+
+def conj(x, name=None):
+    return jnp.conj(x)
+
+
+def angle(x, name=None):
+    return jnp.angle(x)
+
+
+def real(x, name=None):
+    return jnp.real(x)
+
+
+def imag(x, name=None):
+    return jnp.imag(x)
+
+
+def frac(x, name=None):
+    return x - jnp.trunc(x)
+
+
+def rad2deg(x, name=None):
+    return jnp.rad2deg(x)
+
+
+def deg2rad(x, name=None):
+    return jnp.deg2rad(x)
+
+
+def isnan(x, name=None):
+    return jnp.isnan(x)
+
+
+def isinf(x, name=None):
+    return jnp.isinf(x)
+
+
+def isfinite(x, name=None):
+    return jnp.isfinite(x)
+
+
+def clip(x, min=None, max=None, name=None):
+    return jnp.clip(x, min, max)
+
+
+def lerp(x, y, weight, name=None):
+    return x + weight * (y - x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+# -- reductions ------------------------------------------------------------
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return jnp.sum(x, axis=_axis(axis), dtype=dtype_mod.convert_dtype_to_jax(dtype),
+                   keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return jnp.prod(x, axis=_axis(axis), dtype=dtype_mod.convert_dtype_to_jax(dtype),
+                    keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtype_mod.convert_dtype_to_jax(dtype))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return jnp.cumprod(x, axis=dim, dtype=dtype_mod.convert_dtype_to_jax(dtype))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+def kron(x, y, name=None):
+    return jnp.kron(x, y)
+
+
+# -- matmul family ---------------------------------------------------------
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    from ..amp import cast_if_amp
+    x, y = cast_if_amp("matmul", x, y)
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+def mm(x, y, name=None):
+    return jnp.matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return jnp.matmul(x, y)
+
+
+def dot(x, y, name=None):
+    return jnp.sum(x * y, axis=-1)
+
+
+def mv(x, vec, name=None):
+    return jnp.matmul(x, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def multiplex(inputs, index, name=None):
+    stacked = jnp.stack(inputs, axis=0)  # (num_candidates, batch, ...)
+    idx = jnp.reshape(index, (-1,))
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+# -- misc ------------------------------------------------------------------
+def increment(x, value=1.0, name=None):
+    return x + value
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as np
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def gcd(x, y, name=None):
+    return jnp.gcd(x, y)
+
+
+def lcm(x, y, name=None):
+    return jnp.lcm(x, y)

@@ -1,0 +1,81 @@
+"""Random sampling ops (reference: python/paddle/tensor/random.py).
+
+Draw from the global stateful-looking RNG (paddle_tpu.seed); inside a jitted
+functional step they consume deterministic folds of the scoped key
+(see framework/random.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtype_mod
+from ..framework.random import get_rng_key
+
+
+def _float_dt(dtype):
+    return dtype_mod.convert_dtype_to_jax(dtype) or dtype_mod.get_default_dtype()
+
+
+def rand(shape, dtype=None, name=None):
+    return jax.random.uniform(get_rng_key(), tuple(shape), dtype=_float_dt(dtype))
+
+
+def randn(shape, dtype=None, name=None):
+    return jax.random.normal(get_rng_key(), tuple(shape), dtype=_float_dt(dtype))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if shape is None:
+        shape = jnp.shape(mean) if hasattr(mean, "shape") else ()
+    return mean + std * jax.random.normal(get_rng_key(), tuple(shape))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else get_rng_key()
+    return jax.random.uniform(key, tuple(shape), dtype=_float_dt(dtype),
+                              minval=min, maxval=max)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(get_rng_key(), tuple(shape), low, high,
+                              dtype=dtype_mod.convert_dtype_to_jax(dtype))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    dt = dtype_mod.convert_dtype_to_jax(dtype) or x.dtype
+    return randint(low, high, x.shape, dt)
+
+
+def randperm(n, dtype="int64", name=None):
+    return jax.random.permutation(get_rng_key(), n).astype(
+        dtype_mod.convert_dtype_to_jax(dtype))
+
+
+def bernoulli(x, name=None):
+    return jax.random.bernoulli(get_rng_key(), x).astype(x.dtype)
+
+
+def poisson(x, name=None):
+    return jax.random.poisson(get_rng_key(), x).astype(x.dtype)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = get_rng_key()
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if replacement:
+        return jax.random.categorical(key, logits, shape=(*x.shape[:-1], num_samples) if x.ndim > 1 else (num_samples,), axis=-1)
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(key, x.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx
+
+
+def exponential_(x, lam=1.0, name=None):
+    return jax.random.exponential(get_rng_key(), x.shape).astype(x.dtype) / lam

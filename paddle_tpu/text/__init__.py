@@ -1,0 +1,2 @@
+"""paddle_tpu.text — NLP models & datasets (reference: python/paddle/text/)."""
+from . import models  # noqa: F401

@@ -1,0 +1,3 @@
+from .gpt import (  # noqa: F401
+    GPTBlock, GPTForPretraining, GPTLMHead, GPTModel, gpt_1p3b,
+    gpt_pipeline_descs, gpt_tiny)

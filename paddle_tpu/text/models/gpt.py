@@ -1,0 +1,242 @@
+"""GPT model family — the flagship hybrid-parallel transformer.
+
+Capability target: the reference trains GPT/ERNIE-class models with
+DP×TP×PP×sharding (BASELINE.md configs 2-4; TP layers
+fleet/meta_parallel/parallel_layers/mp_layers.py, PP pp_layers.py).
+This implementation is TPU-first:
+- attention goes through F.scaled_dot_product_attention → Pallas flash
+  attention on TPU (O(S) memory, no S×S materialization);
+- QKV/MLP matmuls are Column/RowParallelLinear (model-axis sharding on MXU);
+- a LayerDesc factory (`gpt_pipeline_descs`) exposes the same network as a
+  PipelineLayer for the pipe axis;
+- weights default to master-fp32 with bf16 compute via amp.auto_cast.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ... import nn
+from ...nn import functional as F
+from ...nn.initializer import Normal
+from ...nn.layer import Layer
+from ...distributed.meta_parallel.parallel_layers.mp_layers import (
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding)
+from ...distributed.meta_parallel.parallel_layers.pp_layers import (
+    LayerDesc, PipelineLayer)
+
+
+class GPTEmbeddings(Layer):
+    def __init__(self, vocab_size, hidden_size, max_position_embeddings=1024,
+                 hidden_dropout_prob=0.1, initializer_range=0.02,
+                 tensor_parallel=True):
+        super().__init__()
+        emb_cls = VocabParallelEmbedding if tensor_parallel else nn.Embedding
+        self.word_embeddings = emb_cls(vocab_size, hidden_size)
+        self.position_embeddings = nn.Embedding(
+            max_position_embeddings, hidden_size,
+            weight_attr=None)
+        self.dropout = nn.Dropout(hidden_dropout_prob)
+
+    def forward(self, input_ids, position_ids=None):
+        if position_ids is None:
+            s = input_ids.shape[-1]
+            position_ids = jnp.arange(s, dtype=jnp.int32)[None, :]
+        w = self.word_embeddings(input_ids)
+        p = self.position_embeddings(position_ids)
+        return self.dropout(w + p)
+
+
+class GPTAttention(Layer):
+    def __init__(self, hidden_size, num_heads, attn_dropout=0.1,
+                 resid_dropout=0.1, tensor_parallel=True, mp_degree=1,
+                 use_flash=True):
+        super().__init__()
+        self.num_heads = num_heads
+        self.head_dim = hidden_size // num_heads
+        self.mp_degree = mp_degree if tensor_parallel else 1
+        self.local_heads = num_heads // max(self.mp_degree, 1)
+        if tensor_parallel:
+            self.qkv_proj = ColumnParallelLinear(hidden_size, 3 * hidden_size,
+                                                 gather_output=False)
+            self.out_proj = RowParallelLinear(hidden_size, hidden_size,
+                                              input_is_parallel=True)
+        else:
+            self.qkv_proj = nn.Linear(hidden_size, 3 * hidden_size)
+            self.out_proj = nn.Linear(hidden_size, hidden_size)
+        self.attn_dropout = attn_dropout
+        self.resid_dropout = nn.Dropout(resid_dropout)
+
+    def forward(self, x, attn_mask=None):
+        b, s, _ = x.shape
+        qkv = self.qkv_proj(x)  # (b, s, 3*h/mp)
+        local_h = qkv.shape[-1] // 3
+        heads = local_h // self.head_dim
+        qkv = jnp.reshape(qkv, (b, s, heads, 3 * self.head_dim))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout,
+            is_causal=attn_mask is None, training=self.training)
+        out = jnp.reshape(out, (b, s, local_h))
+        return self.resid_dropout(self.out_proj(out))
+
+
+class GPTMLP(Layer):
+    def __init__(self, hidden_size, intermediate_size, dropout=0.1,
+                 tensor_parallel=True):
+        super().__init__()
+        if tensor_parallel:
+            self.fc_in = ColumnParallelLinear(hidden_size, intermediate_size,
+                                              gather_output=False)
+            self.fc_out = RowParallelLinear(intermediate_size, hidden_size,
+                                            input_is_parallel=True)
+        else:
+            self.fc_in = nn.Linear(hidden_size, intermediate_size)
+            self.fc_out = nn.Linear(intermediate_size, hidden_size)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc_out(F.gelu(self.fc_in(x), approximate=True)))
+
+
+class GPTBlock(Layer):
+    def __init__(self, hidden_size, num_heads, intermediate_size=None,
+                 attn_dropout=0.1, resid_dropout=0.1, layer_norm_epsilon=1e-5,
+                 tensor_parallel=True, mp_degree=1):
+        super().__init__()
+        intermediate_size = intermediate_size or 4 * hidden_size
+        self.ln_1 = nn.LayerNorm(hidden_size, epsilon=layer_norm_epsilon)
+        self.attn = GPTAttention(hidden_size, num_heads, attn_dropout,
+                                 resid_dropout, tensor_parallel, mp_degree)
+        self.ln_2 = nn.LayerNorm(hidden_size, epsilon=layer_norm_epsilon)
+        self.mlp = GPTMLP(hidden_size, intermediate_size, resid_dropout,
+                          tensor_parallel)
+
+    def forward(self, x, attn_mask=None):
+        x = x + self.attn(self.ln_1(x), attn_mask)
+        x = x + self.mlp(self.ln_2(x))
+        return x
+
+
+class GPTModel(Layer):
+    """Decoder-only transformer trunk."""
+
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=None,
+                 max_position_embeddings=1024, attn_dropout=0.1,
+                 hidden_dropout=0.1, layer_norm_epsilon=1e-5,
+                 tensor_parallel=True, mp_degree=1):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.embeddings = GPTEmbeddings(vocab_size, hidden_size,
+                                        max_position_embeddings,
+                                        hidden_dropout,
+                                        tensor_parallel=tensor_parallel)
+        self.h = nn.LayerList([
+            GPTBlock(hidden_size, num_heads, intermediate_size, attn_dropout,
+                     hidden_dropout, layer_norm_epsilon, tensor_parallel,
+                     mp_degree)
+            for _ in range(num_layers)])
+        self.ln_f = nn.LayerNorm(hidden_size, epsilon=layer_norm_epsilon)
+
+    def forward(self, input_ids, attn_mask=None):
+        x = self.embeddings(input_ids)
+        for block in self.h:
+            x = block(x, attn_mask)
+        return self.ln_f(x)
+
+
+class GPTLMHead(Layer):
+    """Projection to (sharded) vocab logits; optionally tied to the word
+    embedding (SharedLayerDesc semantics in the PP variant)."""
+
+    def __init__(self, hidden_size, vocab_size, embedding_weight=None,
+                 tensor_parallel=True):
+        super().__init__()
+        if embedding_weight is not None:
+            self.weight = embedding_weight  # tied Parameter (vocab, hidden)
+            self._tied = True
+        else:
+            self.weight = self.create_parameter(
+                (vocab_size, hidden_size), initializer=Normal(0.0, 0.02))
+            self._tied = False
+            if tensor_parallel:
+                from jax.sharding import PartitionSpec as P
+                self.weight.pspec = P("model", None)
+
+    def forward(self, x):
+        return jnp.matmul(x, jnp.swapaxes(self.weight.value, 0, 1))
+
+
+class GPTForPretraining(Layer):
+    """Trunk + tied LM head + parallel CE loss (BASELINE.md config 3)."""
+
+    def __init__(self, gpt: GPTModel = None, tensor_parallel=True, **kwargs):
+        super().__init__()
+        self.gpt = gpt or GPTModel(tensor_parallel=tensor_parallel, **kwargs)
+        self.lm_head = GPTLMHead(
+            self.gpt.hidden_size, 0,
+            embedding_weight=self.gpt.embeddings.word_embeddings.weight)
+        self.parallel_loss = ParallelCrossEntropy()
+        self.tensor_parallel = tensor_parallel
+
+    def forward(self, input_ids, attn_mask=None):
+        h = self.gpt(input_ids, attn_mask)
+        return self.lm_head(h)
+
+    def loss(self, logits, labels):
+        per_tok = self.parallel_loss(logits, labels)
+        return jnp.mean(per_tok)
+
+
+# -- pipeline variant --------------------------------------------------------
+class _EmbeddingPipe(GPTEmbeddings):
+    def forward(self, input_ids):
+        return super().forward(input_ids)
+
+
+class _LNHeadPipe(Layer):
+    """Final LN + untied head for the PP build (tying across stages uses
+    SharedLayerDesc; untied here keeps the dry-run simple)."""
+
+    def __init__(self, hidden_size, vocab_size, epsilon=1e-5,
+                 tensor_parallel=True):
+        super().__init__()
+        self.ln_f = nn.LayerNorm(hidden_size, epsilon=epsilon)
+        self.head = GPTLMHead(hidden_size, vocab_size,
+                              tensor_parallel=tensor_parallel)
+
+    def forward(self, x):
+        return self.head(self.ln_f(x))
+
+
+def gpt_pipeline_descs(vocab_size=50304, hidden_size=768, num_layers=12,
+                       num_heads=12, max_position_embeddings=1024,
+                       dropout=0.1, tensor_parallel=True):
+    """LayerDesc list for PipelineLayer (reference pp_layers.py usage)."""
+    descs = [LayerDesc(_EmbeddingPipe, vocab_size, hidden_size,
+                       max_position_embeddings, dropout,
+                       tensor_parallel=tensor_parallel)]
+    for _ in range(num_layers):
+        descs.append(LayerDesc(GPTBlock, hidden_size, num_heads,
+                               tensor_parallel=tensor_parallel))
+    descs.append(LayerDesc(_LNHeadPipe, hidden_size, vocab_size,
+                           tensor_parallel=tensor_parallel))
+    return descs
+
+
+def gpt_tiny(**kw):
+    cfg = dict(vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
+               max_position_embeddings=256)
+    cfg.update(kw)
+    return cfg
+
+
+def gpt_1p3b(**kw):
+    """GPT-3 1.3B config (BASELINE.json configs[3])."""
+    cfg = dict(vocab_size=50304, hidden_size=2048, num_layers=24,
+               num_heads=16, max_position_embeddings=1024)
+    cfg.update(kw)
+    return cfg

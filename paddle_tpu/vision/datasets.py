@@ -1,0 +1,152 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/ — MNIST, CIFAR,
+FashionMNIST; legacy python/paddle/dataset/).
+
+This environment has zero egress, so the download path is gated: datasets
+read local files in the reference's formats (IDX for MNIST, pickled batches
+for CIFAR) and FakeData provides deterministic synthetic samples for tests
+and benchmarks.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+DATA_HOME = os.path.expanduser(os.environ.get("PADDLE_TPU_DATA_HOME",
+                                              "~/.cache/paddle_tpu/datasets"))
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic dataset for tests/benchmarks."""
+
+    def __init__(self, size=1024, image_shape=(1, 28, 28), num_classes=10,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = np.asarray(rng.randint(0, self.num_classes), dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.size
+
+
+def _read_idx_images(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(n, rows, cols)
+
+
+def _read_idx_labels(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data
+
+
+class MNIST(Dataset):
+    """reference: python/paddle/vision/datasets/mnist.py. Reads local IDX
+    files; pass image_path/label_path or place files under DATA_HOME/mnist."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        base = os.path.join(DATA_HOME, self.NAME)
+        stem = "train" if self.mode == "train" else "t10k"
+        if image_path is None:
+            for suffix in ("-images-idx3-ubyte.gz", "-images-idx3-ubyte"):
+                cand = os.path.join(base, stem + suffix)
+                if os.path.exists(cand):
+                    image_path = cand
+                    break
+        if label_path is None:
+            for suffix in ("-labels-idx1-ubyte.gz", "-labels-idx1-ubyte"):
+                cand = os.path.join(base, stem + suffix)
+                if os.path.exists(cand):
+                    label_path = cand
+                    break
+        if image_path is None or label_path is None:
+            raise FileNotFoundError(
+                f"MNIST files not found under {base}; this environment has no "
+                f"network egress — place IDX files there or use "
+                f"paddle_tpu.vision.datasets.FakeData for tests")
+        self.images = _read_idx_images(image_path)
+        self.labels = _read_idx_labels(label_path)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None, :, :] / 255.0
+        label = np.asarray(self.labels[idx], dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """reference: python/paddle/vision/datasets/cifar.py — reads the original
+    python-pickle tar from a local path."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        mode = mode.lower()
+        data_file = data_file or os.path.join(DATA_HOME, "cifar",
+                                              "cifar-10-python.tar.gz")
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"{data_file} not found; no network egress — place the CIFAR "
+                f"archive locally or use FakeData")
+        names = ([f"data_batch_{i}" for i in range(1, 6)] if mode == "train"
+                 else ["test_batch"])
+        xs, ys = [], []
+        with tarfile.open(data_file, "r:*") as tar:
+            for member in tar.getmembers():
+                if any(member.name.endswith(n) for n in names):
+                    batch = pickle.load(tar.extractfile(member), encoding="bytes")
+                    xs.append(batch[b"data"])
+                    ys.extend(batch.get(b"labels", batch.get(b"fine_labels")))
+        self.data = np.concatenate(xs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(ys, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.data[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        data_file = data_file or os.path.join(DATA_HOME, "cifar",
+                                              "cifar-100-python.tar.gz")
+        super().__init__(data_file, mode, transform, download, backend)

@@ -1,0 +1,17 @@
+"""Test config: force CPU backend with 8 virtual devices so distributed
+(DP/TP/PP/sharding) logic is testable without TPUs — the SURVEY.md §4
+translation of the reference's subprocess-on-localhost TestDistBase."""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags +
+                               " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Numeric tests verify math, not precision policy: pin fp32-exact matmuls
+# (prod default keeps the fast MXU path).
+import jax  # noqa: E402
+
+# The axon TPU plugin ignores the JAX_PLATFORMS env var — force via config.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,109 @@
+"""End-to-end Model.fit tests (reference: python/paddle/tests/test_model.py;
+the MNIST-LeNet config is BASELINE.md config[0])."""
+import numpy as np
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.vision.datasets import FakeData
+from paddle_tpu.vision.models import LeNet
+
+
+def test_lenet_fit_learns():
+    paddle.seed(123)
+
+    class EasyData(FakeData):
+        """Labels derivable from the image → learnable."""
+
+        def __getitem__(self, idx):
+            rng = np.random.RandomState(self.seed + idx)
+            label = rng.randint(0, self.num_classes)
+            img = np.zeros(self.image_shape, dtype=np.float32)
+            img[0, label * 2:(label * 2 + 2), :] = 1.0
+            img += rng.rand(*self.image_shape).astype(np.float32) * 0.1
+            return img, np.asarray(label, dtype=np.int64)
+
+    net = LeNet()
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(1e-3, parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    train = EasyData(size=256)
+    model.fit(train, epochs=3, batch_size=32, verbose=0)
+    res = model.evaluate(EasyData(size=64, seed=999), batch_size=32, verbose=0)
+    assert res["acc"] > 0.8, res
+
+
+def test_model_save_load(tmp_path):
+    net = LeNet()
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    ds = FakeData(size=32)
+    model.fit(ds, epochs=1, batch_size=16, verbose=0)
+    path = str(tmp_path / "ckpt" / "model")
+    model.save(path)
+
+    net2 = LeNet()
+    model2 = paddle.Model(net2)
+    model2.prepare(paddle.optimizer.SGD(0.1, parameters=net2.parameters()),
+                   nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    model2.load(path)
+    x = jnp.ones((2, 1, 28, 28))
+    np.testing.assert_allclose(np.asarray(model.predict_batch(x)),
+                               np.asarray(model2.predict_batch(x)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_early_stopping_and_checkpoint(tmp_path):
+    net = LeNet()
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.SGD(0.0, parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    ds = FakeData(size=32)
+    cb = paddle.hapi.callbacks.EarlyStopping(monitor="loss", patience=0,
+                                             save_best_model=False)
+    model.fit(ds, eval_data=ds, epochs=4, batch_size=16, verbose=0,
+              callbacks=[cb])
+    assert model.stop_training
+
+
+def test_dataloader_multiprocess():
+    ds = FakeData(size=40)
+    loader = paddle.io.DataLoader(ds, batch_size=8, num_workers=2)
+    batches = list(loader)
+    assert len(batches) == 5
+    assert batches[0][0].shape == (8, 1, 28, 28)
+    # determinism: same data as single-process
+    loader1 = paddle.io.DataLoader(ds, batch_size=8, num_workers=0)
+    b1 = list(loader1)
+    np.testing.assert_allclose(b1[0][0], batches[0][0])
+
+
+def test_jit_save_load(tmp_path):
+    from paddle_tpu.jit import InputSpec
+    net = LeNet()
+    net.eval()
+    path = str(tmp_path / "exported" / "lenet")
+    paddle.jit.save(net, path, input_spec=[InputSpec([1, 1, 28, 28])])
+    loaded = paddle.jit.load(path)
+    x = jnp.ones((1, 1, 28, 28))
+    np.testing.assert_allclose(np.asarray(net(x)), np.asarray(loaded(x)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_to_static_traced_layer():
+    net = LeNet()
+    net.eval()
+    traced = paddle.jit.to_static(net)
+    x = jnp.ones((2, 1, 28, 28))
+    np.testing.assert_allclose(np.asarray(traced(x)), np.asarray(net(x)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_summary_and_flops():
+    net = LeNet()
+    info = paddle.summary(net, (1, 1, 28, 28))
+    assert info["total_params"] > 0
+    f = paddle.flops(net, (1, 1, 28, 28))
+    assert f >= 0

@@ -1,0 +1,214 @@
+"""Layer-system tests (reference: test_imperative_* family —
+parameters/sublayers/state_dict/hooks)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestLayerSystem:
+    def test_parameter_registration(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(self.fc1(x))
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert set(names) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+
+    def test_state_dict_roundtrip(self):
+        net1 = nn.Linear(3, 3)
+        net2 = nn.Linear(3, 3)
+        net2.set_state_dict(net1.state_dict())
+        x = jnp.ones((2, 3))
+        np.testing.assert_allclose(np.asarray(net1(x)), np.asarray(net2(x)))
+
+    def test_save_load(self, tmp_path):
+        net = nn.Linear(3, 3)
+        path = str(tmp_path / "model.pdparams")
+        paddle.save(net.state_dict(), path)
+        sd = paddle.load(path)
+        net2 = nn.Linear(3, 3)
+        net2.set_state_dict(sd)
+        x = jnp.ones((1, 3))
+        np.testing.assert_allclose(np.asarray(net(x)), np.asarray(net2(x)))
+
+    def test_train_eval_mode_propagates(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_forward_hooks(self):
+        net = nn.Linear(2, 2)
+        calls = []
+        h = net.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(out.shape))
+        net(jnp.ones((3, 2)))
+        assert calls == [(3, 2)]
+        h.remove()
+        net(jnp.ones((3, 2)))
+        assert len(calls) == 1
+
+    def test_sequential_and_layerlist(self):
+        seq = nn.Sequential(nn.Linear(2, 4), nn.ReLU(), nn.Linear(4, 1))
+        assert len(seq) == 3
+        y = seq(jnp.ones((2, 2)))
+        assert y.shape == (2, 1)
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4
+        assert len(nn.Sequential(*ll).parameters()) == 8
+
+    def test_astype_bfloat16(self):
+        net = nn.Linear(3, 3)
+        net.bfloat16()
+        assert net.weight.dtype == jnp.bfloat16
+
+    def test_parameter_stop_gradient(self):
+        net = nn.Linear(2, 2)
+        net.bias.stop_gradient = True
+        assert not net.bias.trainable
+
+
+class TestDropoutRNG:
+    def test_dropout_deterministic_under_guard(self):
+        from paddle_tpu.framework.random import rng_guard
+        import jax
+        d = nn.Dropout(0.5)
+        key = jax.random.key(7)
+        with rng_guard(key):
+            a = d(jnp.ones((4, 4)))
+        with rng_guard(key):
+            b = d(jnp.ones((4, 4)))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+    def test_dropout_eval_identity(self):
+        d = nn.Dropout(0.9)
+        d.eval()
+        x = jnp.ones((4, 4))
+        np.testing.assert_allclose(np.asarray(d(x)), 1.0)
+
+
+class TestTransformer:
+    def test_encoder_shapes(self):
+        enc_layer = nn.TransformerEncoderLayer(32, 4, 64, dropout=0.0)
+        enc = nn.TransformerEncoder(enc_layer, 2)
+        x = jnp.ones((2, 10, 32))
+        y = enc(x)
+        assert y.shape == (2, 10, 32)
+
+    def test_full_transformer(self):
+        model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32,
+                               dropout=0.0)
+        src = jnp.ones((2, 6, 16))
+        tgt = jnp.ones((2, 4, 16))
+        out = model(src, tgt)
+        assert out.shape == (2, 4, 16)
+
+    def test_causal_mask_matches_full(self):
+        """Attention with causal flag == attention with explicit mask."""
+        from paddle_tpu.nn import functional as F
+        rs = np.random.RandomState(0)
+        q = jnp.asarray(rs.randn(1, 8, 2, 4), dtype=jnp.float32)
+        k = jnp.asarray(rs.randn(1, 8, 2, 4), dtype=jnp.float32)
+        v = jnp.asarray(rs.randn(1, 8, 2, 4), dtype=jnp.float32)
+        causal = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        mask = jnp.tril(jnp.ones((8, 8), dtype=bool))[None, None]
+        masked = F.scaled_dot_product_attention(q, k, v, attn_mask=mask)
+        np.testing.assert_allclose(np.asarray(causal), np.asarray(masked),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_decode_cache(self):
+        mha = nn.MultiHeadAttention(16, 2)
+        mha.eval()
+        x = jnp.ones((1, 4, 16))
+        cache = mha.gen_cache(x)
+        out, cache = mha(x, x, x, None, cache)
+        assert out.shape == (1, 4, 16)
+        assert cache[0].shape[1] == 4
+
+
+class TestOptimizers:
+    @pytest.mark.parametrize("opt_cls,kwargs", [
+        ("SGD", {}), ("Momentum", {}), ("Adam", {}), ("AdamW", {}),
+        ("Adagrad", {}), ("RMSProp", {}), ("Lamb", {}), ("Adamax", {}),
+    ])
+    def test_optimizer_decreases_loss(self, opt_cls, kwargs):
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        opt = getattr(paddle.optimizer, opt_cls)(
+            learning_rate=0.1, parameters=net.parameters(), **kwargs)
+        x = jnp.asarray(np.random.RandomState(0).randn(16, 4), dtype=jnp.float32)
+        y = jnp.sum(x, axis=1, keepdims=True)
+
+        def loss_closure():
+            return jnp.mean(jnp.square(net(x) - y))
+
+        from paddle_tpu.autograd import backward
+        l0 = float(loss_closure())
+        for _ in range(20):
+            backward(net, loss_closure)
+            opt.step()
+            opt.clear_grad()
+        l1 = float(loss_closure())
+        assert l1 < l0, f"{opt_cls}: {l0} -> {l1}"
+
+    def test_global_norm_clip(self):
+        from paddle_tpu.optimizer import ClipGradByGlobalNorm
+        clip = ClipGradByGlobalNorm(1.0)
+        grads = {"a": jnp.ones((10,)) * 10, "b": jnp.ones((10,)) * 10}
+        out = clip(grads)
+        total = np.sqrt(sum(float(jnp.sum(jnp.square(g)))
+                            for g in out.values()))
+        np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+    def test_lr_scheduler_cosine(self):
+        from paddle_tpu.optimizer.lr import CosineAnnealingDecay
+        s = CosineAnnealingDecay(1.0, T_max=10)
+        assert abs(s() - 1.0) < 1e-6
+        for _ in range(10):
+            s.step()
+        assert s() < 1e-6
+
+    def test_lr_warmup(self):
+        from paddle_tpu.optimizer.lr import LinearWarmup
+        s = LinearWarmup(0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+        vals = []
+        for _ in range(12):
+            vals.append(s())
+            s.step()
+        assert vals[0] == 0.0
+        assert abs(vals[-1] - 0.1) < 1e-9
+
+
+class TestAmp:
+    def test_autocast_linear_bf16(self):
+        net = nn.Linear(4, 4)
+        x = jnp.ones((2, 4))
+        with paddle.amp.auto_cast():
+            y = net(x)
+        assert y.dtype == jnp.bfloat16
+        y2 = net(x)
+        assert y2.dtype == jnp.float32
+
+    def test_grad_scaler_dynamics(self):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0,
+                                       incr_every_n_steps=2,
+                                       decr_every_n_nan_or_inf=1)
+        grads = {"w": jnp.ones(3) * 8.0}
+        unscaled, found = scaler.unscale_(grads)
+        assert not bool(found)
+        np.testing.assert_allclose(np.asarray(unscaled["w"]), 1.0)
+        scaler.update(True)  # nan step → halve
+        assert scaler.get_loss_scaling() == 4.0

@@ -1,0 +1,169 @@
+"""OpTest-style numeric-gradient harness (reference:
+fluid/tests/unittests/op_test.py:270 OpTest — check_output vs reference impl,
+check_grad vs finite differences :110,:1409)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    """Central finite differences of scalar fn at x."""
+    x = np.asarray(x, dtype=np.float64)
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        f1 = float(fn(jnp.asarray(x, dtype=jnp.float32)))
+        flat[i] = orig - eps
+        f0 = float(fn(jnp.asarray(x, dtype=jnp.float32)))
+        flat[i] = orig
+        gf[i] = (f1 - f0) / (2 * eps)
+    return g
+
+
+def check_grad(fn, x, rtol=5e-2, atol=5e-3):
+    analytic = np.asarray(jax.grad(lambda v: fn(v).sum())(jnp.asarray(x)))
+    numeric = numeric_grad(lambda v: fn(v).sum(), x)
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)
+
+
+class TestActivationGrads:
+    @pytest.mark.parametrize("name", ["relu", "gelu", "sigmoid", "tanh",
+                                      "softplus", "silu", "mish", "hardswish",
+                                      "elu", "selu"])
+    def test_grad_matches_numeric(self, name):
+        from paddle_tpu.nn import functional as F
+        fn = getattr(F, name)
+        x = np.random.RandomState(0).randn(4, 5).astype(np.float32) + 0.3
+        check_grad(fn, x)
+
+
+class TestLossGrads:
+    def test_cross_entropy_grad(self):
+        from paddle_tpu.nn import functional as F
+        rs = np.random.RandomState(1)
+        logits = rs.randn(6, 4).astype(np.float32)
+        label = rs.randint(0, 4, (6,))
+        check_grad(lambda v: F.cross_entropy(v, jnp.asarray(label)), logits)
+
+    def test_mse_matches_numpy(self):
+        from paddle_tpu.nn import functional as F
+        rs = np.random.RandomState(2)
+        a, b = rs.randn(8, 3), rs.randn(8, 3)
+        got = float(F.mse_loss(jnp.asarray(a, dtype=jnp.float32),
+                               jnp.asarray(b, dtype=jnp.float32)))
+        np.testing.assert_allclose(got, ((a - b) ** 2).mean(), rtol=1e-5)
+
+
+class TestConvAgainstReference:
+    def test_conv2d_matches_manual(self):
+        """conv2d vs direct im2col computation."""
+        from paddle_tpu.nn import functional as F
+        rs = np.random.RandomState(3)
+        x = rs.randn(2, 3, 8, 8).astype(np.float32)
+        w = rs.randn(4, 3, 3, 3).astype(np.float32)
+        out = np.asarray(F.conv2d(jnp.asarray(x), jnp.asarray(w), padding=1))
+        # manual reference
+        xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+        ref = np.zeros((2, 4, 8, 8), dtype=np.float32)
+        for n in range(2):
+            for o in range(4):
+                for i in range(8):
+                    for j in range(8):
+                        ref[n, o, i, j] = np.sum(
+                            xp[n, :, i:i + 3, j:j + 3] * w[o])
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_conv2d_grad(self):
+        from paddle_tpu.nn import functional as F
+        rs = np.random.RandomState(4)
+        x = rs.randn(1, 2, 5, 5).astype(np.float32)
+        w = jnp.asarray(rs.randn(3, 2, 3, 3).astype(np.float32))
+        check_grad(lambda v: F.conv2d(v, w, padding=1), x)
+
+    def test_conv2d_transpose_shape_inverts(self):
+        from paddle_tpu.nn import functional as F
+        x = jnp.ones((2, 4, 7, 7))
+        w = jnp.ones((4, 5, 3, 3))  # (in, out, kh, kw)
+        y = F.conv2d_transpose(x, w, stride=2, padding=1, output_padding=1)
+        assert y.shape == (2, 5, 14, 14)
+
+
+class TestNormOps:
+    def test_layer_norm_stats(self):
+        from paddle_tpu.nn import functional as F
+        rs = np.random.RandomState(5)
+        x = rs.randn(4, 16).astype(np.float32)
+        y = np.asarray(F.layer_norm(jnp.asarray(x), 16))
+        np.testing.assert_allclose(y.mean(-1), 0, atol=1e-5)
+        np.testing.assert_allclose(y.std(-1), 1, atol=1e-2)
+
+    def test_batch_norm_train_updates_stats(self):
+        import paddle_tpu as paddle
+        bn = paddle.nn.BatchNorm2D(3, momentum=0.5)
+        x = jnp.asarray(np.random.RandomState(6).randn(4, 3, 5, 5),
+                        dtype=jnp.float32)
+        bn.train()
+        _ = bn(x)
+        assert not np.allclose(np.asarray(bn._mean), 0.0)
+
+    def test_group_norm(self):
+        from paddle_tpu.nn import functional as F
+        x = jnp.asarray(np.random.RandomState(7).randn(2, 8, 4, 4),
+                        dtype=jnp.float32)
+        y = F.group_norm(x, num_groups=4)
+        grouped = np.asarray(y).reshape(2, 4, 2, 4, 4)
+        np.testing.assert_allclose(grouped.mean(axis=(2, 3, 4)), 0, atol=1e-4)
+
+
+class TestPooling:
+    def test_max_pool2d(self):
+        from paddle_tpu.nn import functional as F
+        x = jnp.arange(16.0).reshape(1, 1, 4, 4)
+        y = F.max_pool2d(x, 2, 2)
+        np.testing.assert_allclose(np.asarray(y)[0, 0],
+                                   [[5, 7], [13, 15]])
+
+    def test_adaptive_avg_pool(self):
+        from paddle_tpu.nn import functional as F
+        x = jnp.ones((2, 3, 8, 8))
+        y = F.adaptive_avg_pool2d(x, 1)
+        assert y.shape == (2, 3, 1, 1)
+        np.testing.assert_allclose(np.asarray(y), 1.0)
+
+
+class TestRNN:
+    def test_lstm_forward_shapes(self):
+        import paddle_tpu as paddle
+        lstm = paddle.nn.LSTM(4, 8, num_layers=2)
+        x = jnp.ones((3, 5, 4))
+        out, (h, c) = lstm(x)
+        assert out.shape == (3, 5, 8)
+        assert h.shape == (2, 3, 8)
+        assert c.shape == (2, 3, 8)
+
+    def test_bidirectional_gru(self):
+        import paddle_tpu as paddle
+        gru = paddle.nn.GRU(4, 6, direction="bidirect")
+        x = jnp.ones((2, 7, 4))
+        out, h = gru(x)
+        assert out.shape == (2, 7, 12)
+        assert h.shape == (2, 2, 6)
+
+    def test_lstm_grad_flows(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.jit.functionalization import state_of, functional_call
+        lstm = paddle.nn.LSTM(3, 4)
+        params, buffers = state_of(lstm)
+        x = jnp.ones((2, 5, 3))
+
+        def loss(p):
+            (out, _), _ = functional_call(lstm, p, buffers, x)
+            return jnp.sum(out)
+
+        g = jax.grad(loss)(params)
+        assert all(np.isfinite(np.asarray(v)).all() for v in g.values())
