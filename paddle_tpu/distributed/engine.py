@@ -78,9 +78,11 @@ class ParallelTrainer:
         tparams = OrderedDict((k, v) for k, v in params.items()
                               if self.trainable[k])
         opt_state = self.optimizer.init_state(tparams)
-        # place params/opt on the mesh
+        # place params/opt on the mesh. Copy: the step donates these buffers,
+        # and the Layer's Parameters (or another trainer) may alias them.
         def put(v, spec):
-            return jax.device_put(v, NamedSharding(self.mesh, spec))
+            return jax.device_put(jnp.array(v, copy=True),
+                                  NamedSharding(self.mesh, spec))
 
         params = OrderedDict((k, put(v, self.param_specs[k]))
                              for k, v in params.items())

@@ -1,0 +1,3 @@
+"""paddle_tpu.incubate — experimental features (reference:
+python/paddle/incubate/)."""
+from .moe import ExpertFFN, MoELayer, top2_gating  # noqa: F401

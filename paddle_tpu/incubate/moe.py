@@ -1,0 +1,164 @@
+"""Mixture-of-Experts layer with expert parallelism over a mesh axis.
+
+The reference has no MoE (SURVEY.md §2 EP row: absent; only
+operators/collective/alltoall_op.cc exists as the building block). To meet
+"same capabilities" the framework ships the capability class: top-k gated
+MoE whose experts are sharded over the "model" (or a dedicated) mesh axis,
+with lax.all_to_all dispatch/combine — the TPU-native version of what
+alltoall_op.cc enables.
+
+Design (static shapes, MXU-friendly): capacity-based dispatch. Each device
+routes its tokens to per-expert buffers of fixed capacity C (drop+pad, like
+GShard/Switch), all_to_all's them over the expert axis, applies its local
+experts batched, and all_to_all's back.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.layers.common import Linear
+
+EXPERT_AXIS = "model"
+
+
+def _in_axis(axis):
+    try:
+        lax.axis_index(axis)
+        return True
+    except Exception:
+        return False
+
+
+def top2_gating(logits, capacity):
+    """Top-2 gating with load-balancing aux loss (GShard-style).
+
+    logits: (T, E). Returns (combine (T, E, C), dispatch bool (T, E, C),
+    aux_loss scalar).
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    g1 = jnp.max(probs, axis=-1)
+    e1 = jnp.argmax(probs, axis=-1)
+    probs_wo1 = probs * (1.0 - jax.nn.one_hot(e1, E))
+    g2 = jnp.max(probs_wo1, axis=-1)
+    e2 = jnp.argmax(probs_wo1, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    # aux loss: mean prob per expert × fraction of tokens routed to it
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(e1, E), axis=0)
+    aux = jnp.sum(me * ce) * E
+
+    def positions(e_idx):
+        onehot = jax.nn.one_hot(e_idx, E, dtype=jnp.int32)  # (T, E)
+        pos = jnp.cumsum(onehot, axis=0) - 1                # position in expert
+        return onehot, pos
+
+    oh1, pos1 = positions(e1)
+    # second choice queues behind first-choice tokens of the same expert
+    oh2, pos2_raw = positions(e2)
+    counts1 = jnp.sum(oh1, axis=0, keepdims=True)
+    pos2 = pos2_raw + counts1
+
+    def build(onehot, pos, gate):
+        keep = (jnp.sum(onehot * pos, axis=-1) < capacity) & (gate > 0)
+        slot = jnp.sum(onehot * pos, axis=-1)
+        disp = (onehot.astype(bool) & keep[:, None])[..., None] & \
+            (jax.nn.one_hot(slot, capacity, dtype=jnp.int32)[:, None, :] > 0)
+        comb = disp.astype(jnp.float32) * gate[:, None, None]
+        return comb, disp
+
+    c1, d1 = build(oh1, pos1, g1)
+    c2, d2 = build(oh2, pos2, g2)
+    return c1 + c2, d1 | d2, aux
+
+
+class ExpertFFN(Layer):
+    def __init__(self, d_model, d_hidden):
+        super().__init__()
+        self.fc1 = Linear(d_model, d_hidden)
+        self.fc2 = Linear(d_hidden, d_model)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x), approximate=True))
+
+
+class MoELayer(Layer):
+    """Top-2 MoE with expert parallelism.
+
+    num_experts must be divisible by the expert-axis size; each device holds
+    num_experts / n local experts. Outside shard_map (single device) all
+    experts run locally — same numerics.
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, capacity_factor=2.0,
+                 axis_name=EXPERT_AXIS, gate_weight_attr=None):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.axis_name = axis_name
+        self.gate = Linear(d_model, num_experts, bias_attr=False)
+        from ..nn.layers.container import LayerList
+        self.experts = LayerList([ExpertFFN(d_model, d_hidden)
+                                  for _ in range(num_experts)])
+        self.aux_loss = 0.0
+
+    def _apply_experts(self, buf, expert_ids):
+        """buf: (E_local, C, D) through the listed local experts."""
+        outs = []
+        for slot, eid in enumerate(expert_ids):
+            outs.append(self.experts[eid](buf[slot]))
+        return jnp.stack(outs, axis=0)
+
+    def forward(self, x):
+        b, s, d = x.shape
+        tokens = jnp.reshape(x, (b * s, d))
+        T = tokens.shape[0]
+        E = self.num_experts
+        in_spmd = _in_axis(self.axis_name)
+        n = lax.axis_size(self.axis_name) if in_spmd else 1
+        cap = int(self.capacity_factor * T * 2 / E) or 1
+        # round capacity to a lane-friendly size
+        cap = max(8, ((cap + 7) // 8) * 8)
+
+        logits = self.gate(tokens)
+        combine, dispatch, aux = top2_gating(logits, cap)
+        self.aux_loss = aux
+
+        # dispatch: (T, E, C) x (T, D) → (E, C, D)
+        expert_in = jnp.einsum("tec,td->ecd",
+                               dispatch.astype(tokens.dtype), tokens)
+        if in_spmd and n > 1:
+            # (E, C, D) → all_to_all over expert axis: every device keeps its
+            # E/n experts' buffers from ALL devices → (E/n, n*C, D)
+            expert_in = lax.all_to_all(expert_in, self.axis_name,
+                                       split_axis=0, concat_axis=1,
+                                       tiled=True)
+            local = E // n
+            my = lax.axis_index(self.axis_name)
+            ids = [i for i in range(local)]  # trace-time local slots
+            # local expert params are selected statically per shard via
+            # lax.switch over the expert list
+            outs = []
+            for slot in range(local):
+                branches = [
+                    (lambda e: (lambda xx: self.experts[e](xx)))(e)
+                    for e in range(E)]
+                eid = my * local + slot
+                outs.append(lax.switch(eid, branches, expert_in[slot]))
+            expert_out = jnp.stack(outs, axis=0)  # (E/n, n*C, D)
+            expert_out = lax.all_to_all(expert_out, self.axis_name,
+                                        split_axis=1, concat_axis=0,
+                                        tiled=True)  # (E, C, D)
+        else:
+            expert_out = self._apply_experts(expert_in, list(range(E)))
+
+        out = jnp.einsum("tec,ecd->td", combine.astype(tokens.dtype),
+                         expert_out)
+        return jnp.reshape(out, (b, s, d))

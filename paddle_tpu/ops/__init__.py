@@ -1,0 +1,2 @@
+"""paddle_tpu.ops — Pallas TPU kernels (replacing the reference's
+operators/fused/ CUDA library) + ring collective kernels."""

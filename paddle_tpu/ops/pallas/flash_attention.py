@@ -1,0 +1,301 @@
+"""Flash attention — Pallas TPU kernel.
+
+Replaces the reference's fused attention CUDA kernels
+(operators/fused/multihead_matmul_op.cu, math/bert_encoder_functor.cu), which
+materialize the full S×S probability matrix (O(S²) HBM). This kernel is
+blockwise-online-softmax: O(S) memory, MXU matmuls with fp32 accumulators,
+causal block skipping. Forward + custom-VJP backward (dq and dk/dv passes) so
+long-context training works end-to-end.
+
+TPU layout notes: per-row stats (m, l, lse, delta) are carried at LANE=8
+width (last dim equal to the array dim satisfies Mosaic's tiling rule);
+VMEM scratch uses full (block, 128) tiles.
+
+Public API: flash_attention(q, k, v, causal=False, sm_scale=None)
+with q/k/v: (batch, seq, heads, head_dim).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+LANES = 128
+STAT_LANES = 8
+NEG_INF = -1e30
+
+
+def _causal_mask(s, iq, ik, block_q, block_k):
+    rows = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(rows >= cols, s, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref,      # (1,Bq,D), (1,Bk,D), (1,Bk,D)
+                o_ref, lse_ref,           # (1,Bq,D), (1,Bq,STAT_LANES)
+                m_scr, l_scr, acc_scr,    # (Bq,LANES),(Bq,LANES),(Bq,D)
+                *, sm_scale, causal, block_q, block_k, num_k_blocks):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run = (ik * block_k < (iq + 1) * block_q) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, iq, ik, block_q, block_k)
+        m_prev = m_scr[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0].astype(jnp.float32)
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == num_k_blocks - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse = m_scr[:, :1] + jnp.log(l_safe)
+        lse_ref[0] = jnp.broadcast_to(lse, (block_q, STAT_LANES))
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret=False):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(sk, block_k)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, num_k_blocks=nk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, STAT_LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, STAT_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr,
+                   *, sm_scale, causal, block_q, block_k, num_k_blocks):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = (ik * block_k < (iq + 1) * block_q) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, iq, ik, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        do = do_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])
+        dq_scr[:] += sm_scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, sm_scale, causal, block_q, block_k, num_q_blocks):
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = ((iq + 1) * block_q > ik * block_k) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q_raw = q_ref[0].astype(jnp.float32)
+        q = q_raw * sm_scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, iq, ik, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0][:, :1])          # (Bq, Bk)
+        do = do_ref[0].astype(jnp.float32)          # (Bq, D)
+        dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])         # (Bq, Bk)
+        dk_scr[:] += sm_scale * jax.lax.dot_general(
+            ds, q_raw, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(sk, block_k)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)               # (bh, sq, 1)
+    delta = jnp.broadcast_to(delta, (bh, sq, STAT_LANES))
+
+    stat_spec = pl.BlockSpec((1, block_q, STAT_LANES), lambda b, i, j: (b, i, 0))
+    stat_spec_kv = pl.BlockSpec((1, block_q, STAT_LANES),
+                                lambda b, j, i: (b, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_k_blocks=nk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            stat_spec,
+            stat_spec,
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_q_blocks=nq),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            stat_spec_kv,
+            stat_spec_kv,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhsd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret, res, do):
+    return _bwd(sm_scale, causal, block_q, block_k, interpret, res, do)
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    interpret=False):
+    """q/k/v: (batch, seq, num_heads, head_dim) → same-shaped output."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+
+    def to_bhsd(x):
+        return jnp.reshape(jnp.swapaxes(x, 1, 2), (b * h, x.shape[1], d))
+
+    out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), float(sm_scale),
+                      bool(causal), int(block_q), int(block_k),
+                      bool(interpret))
+    return jnp.swapaxes(jnp.reshape(out, (b, h, sq, d)), 1, 2)

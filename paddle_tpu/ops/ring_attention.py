@@ -1,0 +1,115 @@
+"""Ring attention — context/sequence parallelism over the "sep" mesh axis.
+
+Capability class the reference lacks (SURVEY.md §5: no sequence/context
+parallelism anywhere in that tree — long sequences were handled only by
+recompute + TP + the O(S²) fused attention). This is the idiomatic TPU
+version: shard the sequence over a mesh axis, keep Q local, rotate K/V
+shards around the ring with lax.ppermute, and merge per-shard attention
+partials with online-softmax statistics. Peak activation memory is O(S/n)
+per device; the neighbor hops ride ICI and overlap with the previous
+block's compute (XLA latency-hiding scheduler).
+
+Math: each ring step j produces the *normalized* partial
+  ô_j = softmax_j(QK_jᵀ) V_j      and      lse_j = log Σ_t exp(logit_t)
+Merging two partials with weights exp(lse − logaddexp) is exact:
+  out = Σ_j ô_j · exp(lse_j − LSE),   LSE = log Σ_j exp(lse_j).
+
+Causal handling across shards: block (i=q_shard, j=kv_shard) is
+  full attention if j < i; causal diagonal if j == i; skipped if j > i.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .pallas.flash_attention import flash_attention
+
+SEP_AXIS = "sep"
+_NEG = -1e30
+
+
+def _partial_attn(q, k, v, sm_scale, causal):
+    """Normalized per-shard attention + logsumexp.
+
+    q/k/v: (B, S, H, D). Returns (out (B,S,H,D) fp32, lse (B,H,Sq) fp32).
+    """
+    logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * sm_scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool))
+        logits = jnp.where(mask, logits, _NEG)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhst,bthd->bshd", p / jnp.maximum(l, 1e-30),
+                     v.astype(jnp.float32))
+    lse = (m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]  # (B,H,Sq)
+    return out, lse
+
+
+def _merge(o1, lse1, o2, lse2):
+    lse_new = jnp.logaddexp(lse1, lse2)
+    w1 = jnp.swapaxes(jnp.exp(lse1 - lse_new), 1, 2)[..., None]  # (B,S,H,1)
+    w2 = jnp.swapaxes(jnp.exp(lse2 - lse_new), 1, 2)[..., None]
+    return o1 * w1 + o2 * w2, lse_new
+
+
+def _flash_ok(q, k):
+    return (jax.default_backend() == "tpu" and q.shape[1] >= 128 and
+            q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 and
+            q.shape[-1] in (64, 128, 256))
+
+
+def ring_flash_attention(q, k, v, axis_name: str = SEP_AXIS, causal=False,
+                         sm_scale=None):
+    """q/k/v: (B, S_local, H, D) — local sequence shards inside shard_map
+    over `axis_name`. Returns (B, S_local, H, D)."""
+    d = q.shape[-1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    try:
+        n = lax.axis_size(axis_name)
+    except Exception:
+        n = 1
+    if n == 1:
+        if _flash_ok(q, k):
+            return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+        out, _ = _partial_attn(q, k, v, sm_scale, causal)
+        return out.astype(q.dtype)
+
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, j):
+        o_acc, lse_acc, (k_j, v_j) = carry
+        src = (my - j) % n  # owner shard of the kv currently held
+
+        def do_full(_):
+            return _partial_attn(q, k_j, v_j, sm_scale, False)
+
+        def do_causal(_):
+            return _partial_attn(q, k_j, v_j, sm_scale, True)
+
+        def do_skip(_):
+            return (jnp.zeros(q.shape, jnp.float32),
+                    jnp.full((q.shape[0], q.shape[2], q.shape[1]), _NEG,
+                             jnp.float32))
+
+        if causal:
+            branch = jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
+            o_j, lse_j = lax.switch(branch, [do_full, do_causal, do_skip], None)
+        else:
+            o_j, lse_j = do_full(None)
+        o_acc, lse_acc = _merge(o_acc, lse_acc, o_j, lse_j)
+        kv_next = jax.tree_util.tree_map(
+            lambda x: lax.ppermute(x, axis_name, perm), (k_j, v_j))
+        return (o_acc, lse_acc, kv_next), None
+
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full((q.shape[0], q.shape[2], q.shape[1]), _NEG, jnp.float32)
+    (o, _, _), _ = lax.scan(step, (o0, lse0, (k, v)), jnp.arange(n))
+    return o.astype(q.dtype)

@@ -28,6 +28,14 @@ from ...distributed.meta_parallel.parallel_layers.pp_layers import (
     LayerDesc, PipelineLayer)
 
 
+def _sep_axis_bound() -> bool:
+    import jax.lax as lax
+    try:
+        return lax.axis_size("sep") > 1
+    except Exception:
+        return False
+
+
 class GPTEmbeddings(Layer):
     def __init__(self, vocab_size, hidden_size, max_position_embeddings=1024,
                  hidden_dropout_prob=0.1, initializer_range=0.02,
@@ -76,9 +84,15 @@ class GPTAttention(Layer):
         heads = local_h // self.head_dim
         qkv = jnp.reshape(qkv, (b, s, heads, 3 * self.head_dim))
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout,
-            is_causal=attn_mask is None, training=self.training)
+        if _sep_axis_bound() and attn_mask is None and self.attn_dropout == 0.0:
+            # context parallelism: sequence sharded over the "sep" axis →
+            # ring attention (SURVEY.md §5 long-context capability)
+            from ...ops.ring_attention import ring_flash_attention
+            out = ring_flash_attention(q, k, v, causal=True)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout,
+                is_causal=attn_mask is None, training=self.training)
         out = jnp.reshape(out, (b, s, local_h))
         return self.resid_dropout(self.out_proj(out))
 

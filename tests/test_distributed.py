@@ -1,0 +1,345 @@
+"""Distributed tests on the 8-device virtual CPU mesh — the SURVEY.md §4
+translation of the reference's TestDistBase subprocess simulation
+(tests/unittests/test_dist_base.py:744): verify DP/TP/PP/sharding logic
+without real TPUs, asserting parallel == single-device numerics.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.mesh import (CommunicateTopology,
+                                         HybridCommunicateGroup, build_mesh)
+
+
+def make_mesh(**degrees):
+    return build_mesh(degrees)
+
+
+class TestTopology:
+    def test_communicate_topology(self):
+        topo = CommunicateTopology(("data", "pipe", "sharding", "model"),
+                                   (2, 2, 1, 2))
+        assert topo.world_size() == 8
+        assert topo.get_dim("model") == 2
+        coord = topo.get_coord(5)
+        assert topo.get_rank(data=coord[0], pipe=coord[1],
+                             sharding=coord[2], model=coord[3]) == 5
+        groups = topo.get_comm_list("model")
+        assert len(groups) == 4 and all(len(g) == 2 for g in groups)
+
+    def test_hybrid_group_queries(self):
+        topo = CommunicateTopology(("data", "pipe", "sharding", "model"),
+                                   (2, 2, 1, 2))
+        hcg = HybridCommunicateGroup(topo, global_rank=3)
+        assert hcg.get_data_parallel_world_size() == 2
+        assert hcg.get_pipe_parallel_world_size() == 2
+        assert hcg.get_model_parallel_world_size() == 2
+        nxt = hcg.get_p2p_next_rank()
+        assert nxt != 3
+
+
+class TestCollectives:
+    def test_allreduce_psum_in_shard_map(self):
+        from paddle_tpu.distributed import all_reduce
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        x = jnp.arange(8.0)
+
+        f = jax.shard_map(lambda v: all_reduce(v),
+                          mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+                          check_vma=False)
+        out = f(x)
+        assert float(out[0]) == float(jnp.sum(x))
+
+    def test_allgather_and_reduce_scatter(self):
+        from paddle_tpu.distributed.collective import (all_gather_concat,
+                                                       reduce_scatter)
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        x = jnp.arange(8.0)
+        g = jax.shard_map(lambda v: all_gather_concat(v),
+                          mesh=mesh, in_specs=P("data"),
+                          out_specs=P(None), check_vma=False)
+        out = g(x)
+        np.testing.assert_allclose(np.asarray(out[:8]), np.asarray(x))
+        rs = jax.shard_map(lambda v: reduce_scatter(v),
+                           mesh=mesh, in_specs=P(None), out_specs=P("data"),
+                           check_vma=False)
+        out2 = rs(jnp.ones(8))
+        np.testing.assert_allclose(np.asarray(out2), 8.0)
+
+    def test_alltoall(self):
+        from paddle_tpu.distributed.collective import alltoall
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        x = jnp.arange(64.0 * 8).reshape(64, 8)
+        f = jax.shard_map(lambda v: alltoall(v, split_axis=0, concat_axis=0),
+                          mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+                          check_vma=False)
+        out = f(x)
+        # all_to_all of row-shards = transpose of the block structure
+        assert out.shape == (64, 8)
+
+
+class TestTPLayers:
+    def test_column_row_equivalence_with_dense(self):
+        """Col+Row parallel MLP inside shard_map == dense MLP."""
+        from paddle_tpu.distributed.meta_parallel import (ColumnParallelLinear,
+                                                          RowParallelLinear)
+        from paddle_tpu.jit.functionalization import functional_call, state_of
+        paddle.seed(0)
+        make_mesh(model=8)
+        col = ColumnParallelLinear(16, 32, gather_output=False)
+        row = RowParallelLinear(32, 8, input_is_parallel=True)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.col, self.row = col, row
+
+            def forward(self, x):
+                return self.row(nn.functional.relu(self.col(x)))
+
+        net = Net()
+        x = jnp.asarray(np.random.RandomState(0).randn(4, 16),
+                        dtype=jnp.float32)
+        # dense reference using the same (full) weights
+        h = nn.functional.relu(x @ col.weight.value)
+        if col.bias is not None:
+            h = nn.functional.relu(x @ col.weight.value + col.bias.value)
+        ref = h @ row.weight.value + row.bias.value
+
+        params, buffers = state_of(net)
+        mesh = Mesh(np.array(jax.devices()), ("model",))
+        specs = {"col.weight": P(None, "model"), "col.bias": P("model"),
+                 "row.weight": P("model", None), "row.bias": P()}
+
+        def f(params, x):
+            out, _ = functional_call(net, params, {}, x)
+            return out
+
+        fm = jax.shard_map(f, mesh=mesh, in_specs=(specs, P()),
+                           out_specs=P(), check_vma=False)
+        out = fm(dict(params), x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_vocab_parallel_embedding(self):
+        from paddle_tpu.distributed.meta_parallel import VocabParallelEmbedding
+        from paddle_tpu.jit.functionalization import functional_call, state_of
+        paddle.seed(1)
+        make_mesh(model=8)
+        emb = VocabParallelEmbedding(64, 16)
+        ids = jnp.asarray(np.random.RandomState(1).randint(0, 64, (4, 7)))
+        ref = jnp.take(emb.weight.value, ids, axis=0)
+        params, _ = state_of(emb)
+        mesh = Mesh(np.array(jax.devices()), ("model",))
+
+        def f(params, ids):
+            out, _ = functional_call(emb, params, {}, ids)
+            return out
+
+        fm = jax.shard_map(f, mesh=mesh,
+                           in_specs=({"weight": P("model", None)}, P()),
+                           out_specs=P(), check_vma=False)
+        out = fm(dict(params), ids)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_parallel_cross_entropy(self):
+        from paddle_tpu.distributed.meta_parallel import ParallelCrossEntropy
+        paddle.seed(2)
+        make_mesh(model=8)
+        rs = np.random.RandomState(2)
+        logits = jnp.asarray(rs.randn(6, 64), dtype=jnp.float32)
+        labels = jnp.asarray(rs.randint(0, 64, (6,)))
+        ref = nn.functional.cross_entropy(logits, labels, reduction="none")
+        pce = ParallelCrossEntropy()
+        mesh = Mesh(np.array(jax.devices()), ("model",))
+        fm = jax.shard_map(lambda lg, lb: pce(lg, lb), mesh=mesh,
+                           in_specs=(P(None, "model"), P()),
+                           out_specs=P(), check_vma=False)
+        out = fm(logits, labels)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestEngine:
+    def _data(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 16).astype("float32")
+        y = (x.sum(1) > 0).astype("int64") * 2
+        return x, y
+
+    def _net(self):
+        paddle.seed(0)
+        return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+
+    def test_dp_matches_single_device(self):
+        from paddle_tpu.distributed.engine import ParallelTrainer
+        x, y = self._data()
+        loss_fn = lambda o, l: nn.functional.cross_entropy(o, l)  # noqa: E731
+
+        # single device
+        make_mesh(data=1)
+        net1 = self._net()
+        tr1 = ParallelTrainer(net1, paddle.optimizer.SGD(
+            0.1, parameters=net1.parameters()), loss_fn)
+        # 8-way DP
+        make_mesh(data=8)
+        paddle.seed(0)
+        net8 = self._net()
+        net8.set_state_dict(net1.state_dict())
+        tr8 = ParallelTrainer(net8, paddle.optimizer.SGD(
+            0.1, parameters=net8.parameters()), loss_fn)
+        for _ in range(5):
+            l1 = float(tr1.train_step(x, y))
+            l8 = float(tr8.train_step(x, y))
+        np.testing.assert_allclose(l1, l8, rtol=1e-4)
+
+    def test_zero_sharding_specs(self):
+        from paddle_tpu.distributed.meta_parallel.sharding_parallel import (
+            shard_spec_for)
+        v = jnp.zeros((64, 128))
+        spec = shard_spec_for(v, n_shards=8, min_size=16)
+        assert "sharding" in str(spec)
+        tiny = jnp.zeros((4,))
+        assert shard_spec_for(tiny, n_shards=8, min_size=1024) == P()
+
+    def test_pp_loss_matches_single_device(self):
+        from paddle_tpu.distributed.engine import ParallelTrainer
+        from paddle_tpu.distributed.meta_parallel import (LayerDesc,
+                                                          PipelineLayer,
+                                                          PipelineParallel)
+        paddle.seed(3)
+        x, y = self._data()
+        loss_fn = lambda o, l: nn.functional.cross_entropy(o, l)  # noqa: E731
+        descs = [LayerDesc(nn.Linear, 16, 16) for _ in range(3)] + \
+            [LayerDesc(nn.Linear, 16, 4)]
+        pl_ = PipelineLayer(descs, num_stages=4)
+        # single-device forward loss
+        out_ref = pl_(jnp.asarray(x))
+        ref_loss = float(loss_fn(out_ref, jnp.asarray(y)))
+
+        make_mesh(pipe=4, data=2)
+        topo = CommunicateTopology(("data", "pipe", "sharding", "model"),
+                                   (2, 4, 1, 1))
+        hcg = HybridCommunicateGroup(topo, 0)
+
+        class Strat:
+            pipeline_configs = {"accumulate_steps": 4}
+
+        pp = PipelineParallel(pl_, hcg, Strat())
+        tr = ParallelTrainer(pp, paddle.optimizer.SGD(
+            0.0, parameters=pp.parameters()), loss_fn, micro_batches=4)
+        l = float(tr.train_step(x, y))
+        np.testing.assert_allclose(l, ref_loss, rtol=1e-4)
+
+
+class TestRingAttention:
+    def test_matches_full_attention(self):
+        from paddle_tpu.ops.ring_attention import ring_flash_attention
+        from paddle_tpu.nn.functional.attention import _xla_attention
+        rs = np.random.RandomState(0)
+        q = jnp.asarray(rs.randn(2, 64, 2, 16), dtype=jnp.float32)
+        k = jnp.asarray(rs.randn(2, 64, 2, 16), dtype=jnp.float32)
+        v = jnp.asarray(rs.randn(2, 64, 2, 16), dtype=jnp.float32)
+        mesh = Mesh(np.array(jax.devices()), ("sep",))
+        for causal in (False, True):
+            f = jax.shard_map(
+                lambda a, b, c: ring_flash_attention(a, b, c, causal=causal),
+                mesh=mesh, in_specs=(P(None, "sep"),) * 3,
+                out_specs=P(None, "sep"), check_vma=False)
+            out = f(q, k, v)
+            ref = _xla_attention(q, k, v, causal=causal)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=1e-4, atol=1e-4)
+
+
+class TestMoE:
+    def test_expert_parallel_matches_local(self):
+        from paddle_tpu.incubate import MoELayer
+        from paddle_tpu.jit.functionalization import functional_call, state_of
+        paddle.seed(0)
+        moe = MoELayer(d_model=16, d_hidden=32, num_experts=8,
+                       axis_name="model")
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 16),
+                        dtype=jnp.float32)
+        y_local = moe(x)
+        params, _ = state_of(moe)
+        mesh = Mesh(np.array(jax.devices()), ("model",))
+
+        def f(p, xx):
+            out, _ = functional_call(moe, p, {}, xx)
+            return out
+
+        fm = jax.shard_map(f, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+                           check_vma=False)
+        y_ep = fm(dict(params), x)
+        np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_local),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestFlashAttentionInterpret:
+    """Kernel correctness via the pallas interpreter (runs on CPU)."""
+
+    def test_fwd_matches_xla(self):
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention
+        from paddle_tpu.nn.functional.attention import _xla_attention
+        rs = np.random.RandomState(0)
+        q = jnp.asarray(rs.randn(1, 256, 2, 64), dtype=jnp.float32)
+        k = jnp.asarray(rs.randn(1, 256, 2, 64), dtype=jnp.float32)
+        v = jnp.asarray(rs.randn(1, 256, 2, 64), dtype=jnp.float32)
+        for causal in (False, True):
+            out = flash_attention(q, k, v, causal=causal, interpret=True)
+            ref = _xla_attention(q, k, v, causal=causal)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_grads_match_xla(self):
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention
+        from paddle_tpu.nn.functional.attention import _xla_attention
+        rs = np.random.RandomState(1)
+        q = jnp.asarray(rs.randn(1, 128, 1, 64), dtype=jnp.float32)
+        k = jnp.asarray(rs.randn(1, 128, 1, 64), dtype=jnp.float32)
+        v = jnp.asarray(rs.randn(1, 128, 1, 64), dtype=jnp.float32)
+        gf = jax.grad(lambda a, b, c: jnp.sum(
+            flash_attention(a, b, c, causal=True, interpret=True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda a, b, c: jnp.sum(
+            _xla_attention(a, b, c, causal=True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-3)
+
+
+class TestGPTHybridSmoke:
+    def test_gpt_tp_forward(self):
+        from paddle_tpu.jit.functionalization import functional_call, state_of
+        from paddle_tpu.text.models import GPTForPretraining, gpt_tiny
+        paddle.seed(0)
+        make_mesh(model=8)
+        model = GPTForPretraining(tensor_parallel=True,
+                                  **gpt_tiny(hidden_size=64, num_heads=8))
+        model.eval()
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 1024, (2, 32)))
+        ref = model(ids)  # single-device (no axis bound → dense fallbacks)
+        params, buffers = state_of(model)
+        specs = {n: (p.pspec if p.pspec is not None else P())
+                 for n, p in model.named_parameters()}
+        mesh = Mesh(np.array(jax.devices()).reshape(1, 1, 1, 1, 8),
+                    ("data", "pipe", "sharding", "sep", "model"))
+
+        def f(params, ids):
+            out, _ = functional_call(model, params, buffers, ids)
+            return out
+
+        fm = jax.shard_map(f, mesh=mesh,
+                           in_specs=(specs, P()),
+                           out_specs=P(None, None, "model"), check_vma=False)
+        out = fm(dict(params), ids)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-2, atol=2e-2)
