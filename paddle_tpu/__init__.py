@@ -37,6 +37,8 @@ from . import amp  # noqa: F401,E402
 from . import jit  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
+from . import monitor  # noqa: F401,E402
 from .framework_io import load, save  # noqa: F401,E402
 from .autograd import grad, no_grad  # noqa: F401,E402
 from .nn.layer import Parameter  # noqa: F401,E402

@@ -91,30 +91,50 @@ class ExpertFFN(Layer):
 class MoELayer(Layer):
     """Top-2 MoE with expert parallelism.
 
-    num_experts must be divisible by the expert-axis size; each device holds
-    num_experts / n local experts. Outside shard_map (single device) all
-    experts run locally — same numerics.
+    Expert weights are STACKED along a leading (E, ...) axis — one batched
+    einsum applies all (local) experts, and sharding that axis over
+    ``axis_name`` (e.g. NamedSharding(mesh, P("model"))) shards parameter
+    memory E/n-per-device; inside shard_map the local slice is selected with
+    one dynamic_slice, not an O(E) switch. num_experts must be divisible by
+    the expert-axis size. Outside shard_map (single device) all experts run
+    locally — same numerics.
+
+    The load-balancing aux loss is written to the non-persistable buffer
+    ``aux_loss`` so it flows out of jitted functional_call as a value (read
+    it from new_buffers, or eagerly as ``moe.aux_loss``) instead of leaking
+    a tracer through a Python attribute.
     """
 
     def __init__(self, d_model, d_hidden, num_experts, capacity_factor=2.0,
                  axis_name=EXPERT_AXIS, gate_weight_attr=None):
         super().__init__()
+        from ..nn.initializer import XavierUniform
         self.d_model = d_model
+        self.d_hidden = d_hidden
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
         self.axis_name = axis_name
         self.gate = Linear(d_model, num_experts, bias_attr=False)
-        from ..nn.layers.container import LayerList
-        self.experts = LayerList([ExpertFFN(d_model, d_hidden)
-                                  for _ in range(num_experts)])
-        self.aux_loss = 0.0
+        E = num_experts
+        self.w1 = self.create_parameter(
+            (E, d_model, d_hidden),
+            initializer=XavierUniform(fan_in=d_model, fan_out=d_hidden))
+        self.b1 = self.create_parameter((E, d_hidden), is_bias=True)
+        self.w2 = self.create_parameter(
+            (E, d_hidden, d_model),
+            initializer=XavierUniform(fan_in=d_hidden, fan_out=d_model))
+        self.b2 = self.create_parameter((E, d_model), is_bias=True)
+        self.register_buffer("aux_loss", jnp.zeros((), jnp.float32),
+                             persistable=False)
 
-    def _apply_experts(self, buf, expert_ids):
-        """buf: (E_local, C, D) through the listed local experts."""
-        outs = []
-        for slot, eid in enumerate(expert_ids):
-            outs.append(self.experts[eid](buf[slot]))
-        return jnp.stack(outs, axis=0)
+    def _run_experts(self, buf, w1, b1, w2, b2):
+        """buf: (e, C, D) through e stacked experts → (e, C, D)."""
+        dt = buf.dtype
+        h = jnp.einsum("ecd,edh->ech", buf, w1.astype(dt)) + \
+            b1.astype(dt)[:, None, :]
+        h = F.gelu(h, approximate=True)
+        return jnp.einsum("ech,ehd->ecd", h, w2.astype(dt)) + \
+            b2.astype(dt)[:, None, :]
 
     def forward(self, x):
         b, s, d = x.shape
@@ -131,6 +151,8 @@ class MoELayer(Layer):
         combine, dispatch, aux = top2_gating(logits, cap)
         self.aux_loss = aux
 
+        w1, b1 = self.w1.value, self.b1.value
+        w2, b2 = self.w2.value, self.b2.value
         # dispatch: (T, E, C) x (T, D) → (E, C, D)
         expert_in = jnp.einsum("tec,td->ecd",
                                dispatch.astype(tokens.dtype), tokens)
@@ -141,23 +163,18 @@ class MoELayer(Layer):
                                        split_axis=0, concat_axis=1,
                                        tiled=True)
             local = E // n
-            my = lax.axis_index(self.axis_name)
-            ids = [i for i in range(local)]  # trace-time local slots
-            # local expert params are selected statically per shard via
-            # lax.switch over the expert list
-            outs = []
-            for slot in range(local):
-                branches = [
-                    (lambda e: (lambda xx: self.experts[e](xx)))(e)
-                    for e in range(E)]
-                eid = my * local + slot
-                outs.append(lax.switch(eid, branches, expert_in[slot]))
-            expert_out = jnp.stack(outs, axis=0)  # (E/n, n*C, D)
+            start = lax.axis_index(self.axis_name) * local
+            expert_out = self._run_experts(
+                expert_in,
+                lax.dynamic_slice_in_dim(w1, start, local, 0),
+                lax.dynamic_slice_in_dim(b1, start, local, 0),
+                lax.dynamic_slice_in_dim(w2, start, local, 0),
+                lax.dynamic_slice_in_dim(b2, start, local, 0))
             expert_out = lax.all_to_all(expert_out, self.axis_name,
                                         split_axis=1, concat_axis=0,
                                         tiled=True)  # (E, C, D)
         else:
-            expert_out = self._apply_experts(expert_in, list(range(E)))
+            expert_out = self._run_experts(expert_in, w1, b1, w2, b2)
 
         out = jnp.einsum("tec,ecd->td", combine.astype(tokens.dtype),
                          expert_out)

@@ -102,10 +102,37 @@ def save(layer, path, input_spec=None, **configs):
         return out
 
     args = _example_args(layer, input_spec)
+    # Dynamic dims (None/-1 in an InputSpec) export as symbolic shapes so the
+    # loaded model accepts any size there (the reference's ProgramDesc keeps
+    # -1 dims natively; StableHLO needs shape polymorphism).
+    # Symbol naming: dynamic dim 0 shares one "batch" symbol across all
+    # unnamed specs (so forward() may combine two dynamic-batch inputs —
+    # export can prove the dims equal); other dynamic dims get per-spec
+    # symbols. A named InputSpec scopes all its symbols by its name, letting
+    # the user decouple batch dims that are genuinely independent.
+    poly_specs = []
+    for i, s in enumerate(input_spec):
+        if isinstance(s, InputSpec) and any(d == -1 for d in s.shape):
+            tag = s.name if s.name else None
+            dims = []
+            for j, d in enumerate(s.shape):
+                if d != -1:
+                    dims.append("_")
+                elif j == 0:
+                    dims.append(f"{tag}_batch" if tag else "batch")
+                else:
+                    dims.append(f"{tag}_d{j}" if tag else f"d{i}_{j}")
+            poly_specs.append("(" + ", ".join(dims) + ")")
+        else:
+            poly_specs.append(None)
+    if any(p is not None for p in poly_specs):
+        arg_specs = jax_export.symbolic_args_specs(args, poly_specs)
+    else:
+        arg_specs = args
     exported = jax_export.export(jax.jit(pure))(
         jax.tree_util.tree_map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params),
         jax.tree_util.tree_map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), buffers),
-        *args)
+        *arg_specs)
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)

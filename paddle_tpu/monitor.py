@@ -1,0 +1,72 @@
+"""paddle_tpu.monitor — named int64 gauges (Prometheus-like counters).
+
+Capability map: platform/monitor.h:44 StatValue (thread-safe named gauges
+with add/sub/set/reset, registered in a global registry) exposed to Python
+via pybind/global_value_getter_setter.cc. Here the registry is pure Python;
+values are plain ints guarded by a lock — the TPU runtime has no C++ hot
+path that needs native gauges.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+__all__ = ["StatValue", "stat", "get_all_stats", "reset_all_stats"]
+
+_registry: Dict[str, "StatValue"] = {}
+_reg_lock = threading.Lock()
+
+
+class StatValue:
+    """reference: platform/monitor.h:44."""
+
+    def __init__(self, name: str, value: int = 0):
+        self.name = name
+        self._v = int(value)
+        self._lock = threading.Lock()
+
+    def increase(self, n: int = 1) -> int:
+        with self._lock:
+            self._v += n
+            return self._v
+
+    def decrease(self, n: int = 1) -> int:
+        with self._lock:
+            self._v -= n
+            return self._v
+
+    def set(self, v: int) -> int:
+        with self._lock:
+            self._v = int(v)
+            return self._v
+
+    def reset(self) -> int:
+        return self.set(0)
+
+    def get(self) -> int:
+        with self._lock:
+            return self._v
+
+    def __repr__(self):
+        return f"StatValue({self.name}={self.get()})"
+
+
+def stat(name: str) -> StatValue:
+    """Get-or-create the gauge named ``name`` (DEFINE_INT_STATUS +
+    USE_INT_STAT collapse into one call; monitor.h:154,165)."""
+    with _reg_lock:
+        sv = _registry.get(name)
+        if sv is None:
+            sv = _registry[name] = StatValue(name)
+        return sv
+
+
+def get_all_stats() -> Dict[str, int]:
+    with _reg_lock:
+        return {k: v.get() for k, v in _registry.items()}
+
+
+def reset_all_stats():
+    with _reg_lock:
+        for v in _registry.values():
+            v.reset()

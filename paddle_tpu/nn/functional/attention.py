@@ -39,15 +39,11 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
                                  name=None):
     """query/key/value: (batch, seq, num_heads, head_dim)."""
-    use_flash = (
-        attn_mask is None and dropout_p == 0.0 and
-        query.shape[1] >= 256 and query.shape[1] % 128 == 0 and
-        key.shape[1] % 128 == 0 and query.shape[-1] in (64, 128, 256) and
-        jax.default_backend() == "tpu"
-    )
+    from ...ops.pallas.flash_attention import flash_attention, flash_supported
+    use_flash = (attn_mask is None and dropout_p == 0.0 and
+                 flash_supported(query, key, min_seq=256))
     if use_flash:
         try:
-            from ...ops.pallas.flash_attention import flash_attention
             return flash_attention(query, key, value, causal=is_causal)
         except Exception:
             pass

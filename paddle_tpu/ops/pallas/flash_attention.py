@@ -281,16 +281,39 @@ def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret, res, do):
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+def flash_supported(q, k, min_seq=128):
+    """Single gate for flash-kernel eligibility, shared by every caller
+    (scaled_dot_product_attention, ring attention). The kernel has no
+    tail-block masking, so seq lengths must tile exactly."""
+    return (jax.default_backend() == "tpu" and
+            q.shape[1] >= min_seq and
+            q.shape[1] % DEFAULT_BLOCK_Q == 0 and
+            k.shape[1] % DEFAULT_BLOCK_K == 0 and
+            q.shape[-1] in (64, 128, 256))
+
+
 def flash_attention(q, k, v, causal=False, sm_scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
                     interpret=False):
-    """q/k/v: (batch, seq, num_heads, head_dim) → same-shaped output."""
+    """q/k/v: (batch, seq, num_heads, head_dim) → same-shaped output.
+
+    Sequence lengths must be multiples of (block_q, block_k): the online
+    softmax has no tail masking, so a ragged tail would silently include
+    padded K rows. Gate callers through ``flash_supported``.
+    """
     b, sq, h, d = q.shape
     sk = k.shape[1]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
+    if sq % block_q != 0 or sk % block_k != 0:
+        raise ValueError(
+            f"flash_attention requires seq lengths divisible by the block "
+            f"sizes (got q_seq={sq}, k_seq={sk}, blocks=({block_q},"
+            f"{block_k})); pad the sequence or use "
+            f"nn.functional.scaled_dot_product_attention, which falls back "
+            f"to the XLA path for ragged shapes")
 
     def to_bhsd(x):
         return jnp.reshape(jnp.swapaxes(x, 1, 2), (b * h, x.shape[1], d))

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .pallas.flash_attention import flash_attention
+from .pallas.flash_attention import flash_attention, flash_supported
 
 SEP_AXIS = "sep"
 _NEG = -1e30
@@ -58,12 +58,6 @@ def _merge(o1, lse1, o2, lse2):
     return o1 * w1 + o2 * w2, lse_new
 
 
-def _flash_ok(q, k):
-    return (jax.default_backend() == "tpu" and q.shape[1] >= 128 and
-            q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 and
-            q.shape[-1] in (64, 128, 256))
-
-
 def ring_flash_attention(q, k, v, axis_name: str = SEP_AXIS, causal=False,
                          sm_scale=None):
     """q/k/v: (B, S_local, H, D) — local sequence shards inside shard_map
@@ -76,7 +70,7 @@ def ring_flash_attention(q, k, v, axis_name: str = SEP_AXIS, causal=False,
     except Exception:
         n = 1
     if n == 1:
-        if _flash_ok(q, k):
+        if flash_supported(q, k):
             return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
         out, _ = _partial_attn(q, k, v, sm_scale, causal)
         return out.astype(q.dtype)
@@ -84,8 +78,8 @@ def ring_flash_attention(q, k, v, axis_name: str = SEP_AXIS, causal=False,
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(carry, j):
-        o_acc, lse_acc, (k_j, v_j) = carry
+    def block(j, k_j, v_j):
+        """Attention of local q against the kv shard after j hops."""
         src = (my - j) % n  # owner shard of the kv currently held
 
         def do_full(_):
@@ -101,9 +95,12 @@ def ring_flash_attention(q, k, v, axis_name: str = SEP_AXIS, causal=False,
 
         if causal:
             branch = jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
-            o_j, lse_j = lax.switch(branch, [do_full, do_causal, do_skip], None)
-        else:
-            o_j, lse_j = do_full(None)
+            return lax.switch(branch, [do_full, do_causal, do_skip], None)
+        return do_full(None)
+
+    def step(carry, j):
+        o_acc, lse_acc, (k_j, v_j) = carry
+        o_j, lse_j = block(j, k_j, v_j)
         o_acc, lse_acc = _merge(o_acc, lse_acc, o_j, lse_j)
         kv_next = jax.tree_util.tree_map(
             lambda x: lax.ppermute(x, axis_name, perm), (k_j, v_j))
@@ -111,5 +108,10 @@ def ring_flash_attention(q, k, v, axis_name: str = SEP_AXIS, causal=False,
 
     o0 = jnp.zeros(q.shape, jnp.float32)
     lse0 = jnp.full((q.shape[0], q.shape[2], q.shape[1]), _NEG, jnp.float32)
-    (o, _, _), _ = lax.scan(step, (o0, lse0, (k, v)), jnp.arange(n))
+    # n-1 compute+rotate steps in the scan; the final block is computed
+    # outside so the ring sends exactly n-1 hops (no discarded last permute).
+    (o, lse, (k_l, v_l)), _ = lax.scan(step, (o0, lse0, (k, v)),
+                                       jnp.arange(n - 1))
+    o_j, lse_j = block(jnp.asarray(n - 1, jnp.int32), k_l, v_l)
+    o, _ = _merge(o, lse, o_j, lse_j)
     return o.astype(q.dtype)

@@ -1,0 +1,226 @@
+"""paddle_tpu.profiler — host event tracing + device (XLA) profiling.
+
+Capability map (reference, not copied):
+- ``RecordEvent`` RAII host ranges     ← platform/profiler.h:127 RecordEvent
+- ``start_profiler``/``stop_profiler`` ← fluid/profiler.py:190,257 and
+  platform/profiler.h:213 EnableProfiler/DisableProfiler
+- ``profiler`` context manager         ← fluid/profiler.py:314
+- device tracing                       ← platform/device_tracer.h:43 (CUPTI);
+  here the device side is jax.profiler (XPlane/TensorBoard) — XLA already
+  correlates host/device, so no hand-rolled CUPTI analogue is needed.
+- chrome-trace export                  ← tools/timeline.py (proto → chrome);
+  here host events are written directly in the chrome://tracing JSON format.
+
+Host events nest via a thread-local stack; on TPU each event also opens a
+``jax.named_scope`` so the range shows up inside the XLA trace viewer.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Optional
+
+import jax
+
+__all__ = [
+    "RecordEvent", "record_event", "start_profiler", "stop_profiler",
+    "reset_profiler", "profiler", "is_profiler_enabled", "export_chrome_tracing",
+]
+
+_state = threading.local()
+_lock = threading.Lock()
+_enabled = False
+_events = []          # completed: (name, parent_path, start_ns, end_ns, tid)
+_trace_dir = None     # jax.profiler output dir when device tracing is on
+_start_wall_ns = 0
+
+
+def _stack():
+    if not hasattr(_state, "stack"):
+        _state.stack = []
+    return _state.stack
+
+
+def is_profiler_enabled() -> bool:
+    return _enabled
+
+
+class RecordEvent:
+    """Named host range; usable as context manager or start()/end() pair.
+
+    reference: platform/profiler.h:127 (RAII RecordEvent) and the public
+    paddle.profiler.RecordEvent of later versions.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0 = None
+        self._scope = None
+
+    def begin(self):
+        if _enabled:
+            self._t0 = time.perf_counter_ns()
+            _stack().append(self.name)
+            self._scope = jax.named_scope(self.name)
+            self._scope.__enter__()
+        return self
+
+    def end(self):
+        if self._t0 is None:
+            return
+        t1 = time.perf_counter_ns()
+        stack = _stack()
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        parent = "/".join(stack)
+        with _lock:
+            _events.append((self.name, parent, self._t0, t1,
+                            threading.get_ident()))
+        if self._scope is not None:
+            self._scope.__exit__(None, None, None)
+            self._scope = None
+        self._t0 = None
+
+    __enter__ = begin
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+@contextlib.contextmanager
+def record_event(name: str):
+    ev = RecordEvent(name)
+    ev.begin()
+    try:
+        yield ev
+    finally:
+        ev.end()
+
+
+def reset_profiler():
+    """reference: fluid/profiler.py:168."""
+    global _events
+    with _lock:
+        _events = []
+
+
+def start_profiler(state: str = "All", tracer_option: str = "Default",
+                   trace_dir: Optional[str] = None):
+    """Enable host-event recording; if ``state`` includes the device
+    ("GPU"/"TPU"/"All") also start jax.profiler device tracing.
+
+    reference: fluid/profiler.py:190 (states CPU/GPU/All).
+    """
+    global _enabled, _trace_dir, _start_wall_ns
+    if state not in ("CPU", "GPU", "TPU", "All"):
+        raise ValueError(f"state must be CPU/GPU/TPU/All, got {state}")
+    reset_profiler()
+    _start_wall_ns = time.perf_counter_ns()
+    _enabled = True
+    if state in ("GPU", "TPU", "All") and tracer_option != "HostOnly":
+        _trace_dir = trace_dir or os.path.join(
+            os.getcwd(), "profiler_output")
+        try:
+            jax.profiler.start_trace(_trace_dir)
+        except Exception:   # already tracing / backend without profiler
+            _trace_dir = None
+
+
+def stop_profiler(sorted_key: Optional[str] = None,
+                  profile_path: str = "/tmp/profile"):
+    """Disable recording; print a summary table sorted by ``sorted_key``
+    (total/calls/max/min/ave) and write chrome tracing json to
+    ``profile_path``.
+
+    reference: fluid/profiler.py:257.
+    """
+    global _enabled, _trace_dir
+    if not _enabled:
+        return
+    _enabled = False
+    if _trace_dir is not None:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _trace_dir = None
+    if profile_path:
+        try:
+            export_chrome_tracing(profile_path)
+        except OSError:
+            pass
+    _print_summary(sorted_key)
+
+
+def _aggregate():
+    agg = defaultdict(lambda: [0, 0.0, 0.0, float("inf")])  # calls,total,max,min
+    with _lock:
+        events = list(_events)
+    for name, parent, t0, t1, _tid in events:
+        ms = (t1 - t0) / 1e6
+        key = f"{parent}/{name}" if parent else name
+        a = agg[key]
+        a[0] += 1
+        a[1] += ms
+        a[2] = max(a[2], ms)
+        a[3] = min(a[3], ms)
+    return agg
+
+
+def _print_summary(sorted_key):
+    agg = _aggregate()
+    if not agg:
+        return
+    rows = [(k, c, tot, tot / c, mx, mn)
+            for k, (c, tot, mx, mn) in agg.items()]
+    order = {"total": 2, "calls": 1, "ave": 3, "max": 4, "min": 5}
+    rows.sort(key=lambda r: r[order.get(sorted_key or "total", 2)],
+              reverse=True)
+    name_w = max(len(r[0]) for r in rows)
+    name_w = max(name_w, len("Event"))
+    print(f"{'Event':<{name_w}}  {'Calls':>7} {'Total(ms)':>11} "
+          f"{'Avg(ms)':>9} {'Max(ms)':>9} {'Min(ms)':>9}")
+    for name, calls, tot, ave, mx, mn in rows:
+        print(f"{name:<{name_w}}  {calls:>7} {tot:>11.3f} {ave:>9.3f} "
+              f"{mx:>9.3f} {mn:>9.3f}")
+
+
+def export_chrome_tracing(path: str):
+    """Write completed host events as chrome://tracing JSON (the reference
+    reaches the same format via tools/timeline.py over profiler.proto)."""
+    with _lock:
+        events = list(_events)
+    trace = []
+    for name, parent, t0, t1, tid in events:
+        trace.append({
+            "name": name, "cat": "host", "ph": "X",
+            "ts": (t0 - _start_wall_ns) / 1e3,
+            "dur": (t1 - t0) / 1e3,
+            "pid": os.getpid(), "tid": tid,
+            "args": {"parent": parent} if parent else {},
+        })
+    with open(path, "w") as f:
+        json.dump({"traceEvents": trace}, f)
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: Optional[str] = None,
+             profile_path: str = "/tmp/profile", tracer_option: str = "Default"):
+    """reference: fluid/profiler.py:314 — the `with profiler(...)` guard."""
+    start_profiler(state, tracer_option=tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key=sorted_key, profile_path=profile_path)
+
+
+def get_events():
+    """Completed host events as dicts (for tests / tooling)."""
+    with _lock:
+        return [dict(name=n, parent=p, dur_ms=(t1 - t0) / 1e6, tid=tid)
+                for n, p, t0, t1, tid in _events]

@@ -84,9 +84,17 @@ class GPTAttention(Layer):
         heads = local_h // self.head_dim
         qkv = jnp.reshape(qkv, (b, s, heads, 3 * self.head_dim))
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        if _sep_axis_bound() and attn_mask is None and self.attn_dropout == 0.0:
+        if _sep_axis_bound():
             # context parallelism: sequence sharded over the "sep" axis →
-            # ring attention (SURVEY.md §5 long-context capability)
+            # ring attention (SURVEY.md §5 long-context capability). A plain
+            # attention fallback here would attend only within the local
+            # shard — silently wrong — so unsupported options must raise.
+            if attn_mask is not None or (self.attn_dropout != 0.0 and
+                                         self.training):
+                raise NotImplementedError(
+                    "sequence ('sep') parallelism requires attn_mask=None "
+                    "and attn_dropout=0.0: ring attention has no mask/"
+                    "dropout support, and local attention would be wrong")
             from ...ops.ring_attention import ring_flash_attention
             out = ring_flash_attention(q, k, v, causal=True)
         else:

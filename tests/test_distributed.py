@@ -343,3 +343,35 @@ class TestGPTHybridSmoke:
         out = fm(dict(params), ids)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-2, atol=2e-2)
+
+
+class TestReviewRegressions:
+    """Fixes from code review: aux-loss through jit, ragged flash raise."""
+
+    def test_moe_aux_loss_flows_through_jit(self):
+        from paddle_tpu.incubate import MoELayer
+        from paddle_tpu.jit.functionalization import functional_call, state_of
+        paddle.seed(0)
+        moe = MoELayer(d_model=16, d_hidden=32, num_experts=4)
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 16),
+                        dtype=jnp.float32)
+        y_eager = moe(x)
+        aux_eager = float(moe.aux_loss)
+        params, buffers = state_of(moe)
+
+        @jax.jit
+        def f(p, b, xx):
+            out, nb = functional_call(moe, p, b, xx)
+            return out, nb["aux_loss"]
+
+        out, aux = f(dict(params), dict(buffers), x)
+        assert abs(float(aux) - aux_eager) < 1e-6
+        np.testing.assert_allclose(np.asarray(out), np.asarray(y_eager),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_flash_attention_ragged_seq_raises(self):
+        import pytest
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention
+        z = jnp.zeros((1, 200, 2, 64))
+        with pytest.raises(ValueError, match="divisible"):
+            flash_attention(z, z, z, interpret=True)

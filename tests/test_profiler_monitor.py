@@ -1,0 +1,65 @@
+"""Profiler (C5) + monitor (C6) tests — host event recording, summary,
+chrome-trace export, gauges. (reference test analogues:
+fluid/tests/unittests/test_profiler.py, test_monitor.py)."""
+import json
+import threading
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor, profiler
+
+
+def test_record_event_and_summary(tmp_path, capsys):
+    profiler.start_profiler("CPU")
+    with profiler.RecordEvent("step"):
+        with profiler.RecordEvent("forward"):
+            jnp.ones((8, 8)) @ jnp.ones((8, 8))
+        with profiler.RecordEvent("backward"):
+            pass
+    events = profiler.get_events()
+    names = {e["name"] for e in events}
+    assert {"step", "forward", "backward"} <= names
+    fwd = next(e for e in events if e["name"] == "forward")
+    assert fwd["parent"] == "step"
+    out = tmp_path / "trace.json"
+    profiler.stop_profiler(sorted_key="total", profile_path=str(out))
+    captured = capsys.readouterr().out
+    assert "forward" in captured and "Calls" in captured
+    trace = json.loads(out.read_text())
+    assert any(ev["name"] == "step" for ev in trace["traceEvents"])
+
+
+def test_profiler_context_and_disabled():
+    # outside profiling, RecordEvent is a no-op
+    profiler.reset_profiler()
+    with profiler.RecordEvent("ignored"):
+        pass
+    assert profiler.get_events() == []
+    with profiler.profiler(state="CPU", profile_path=""):
+        with profiler.record_event("inner"):
+            pass
+        assert profiler.is_profiler_enabled()
+    assert not profiler.is_profiler_enabled()
+
+
+def test_monitor_gauges():
+    g = monitor.stat("STAT_test_mem")
+    g.reset()
+    g.increase(10)
+    g.decrease(3)
+    assert g.get() == 7
+    assert monitor.stat("STAT_test_mem") is g   # registry returns same gauge
+    assert monitor.get_all_stats()["STAT_test_mem"] == 7
+
+    # thread safety smoke
+    def bump():
+        for _ in range(1000):
+            g.increase()
+
+    ts = [threading.Thread(target=bump) for _ in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert g.get() == 7 + 4000
+    g.reset()
+    assert g.get() == 0
