@@ -39,6 +39,7 @@ from . import vision  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import monitor  # noqa: F401,E402
+from . import static  # noqa: F401,E402
 from .framework_io import load, save  # noqa: F401,E402
 from .autograd import grad, no_grad  # noqa: F401,E402
 from .nn.layer import Parameter  # noqa: F401,E402
@@ -73,5 +74,6 @@ def disable_static():
 
 def enable_static():
     raise NotImplementedError(
-        "paddle_tpu has no static-graph mode: jax.jit staging replaces it. "
-        "Use paddle_tpu.jit.to_static(layer_or_fn).")
+        "paddle_tpu has no global static-graph mode switch: jax.jit staging "
+        "replaces it. Use paddle_tpu.jit.to_static(layer_or_fn) or the "
+        "paddle_tpu.static namespace (Program.trace / Executor).")

@@ -11,3 +11,4 @@ from .collective import (  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
 from .spawn import spawn  # noqa: F401
+from . import checkpoint  # noqa: F401

@@ -51,7 +51,7 @@ class ParallelTrainer:
 
     def __init__(self, model, optimizer, loss_fn: Callable, mesh=None,
                  micro_batches: int = 1, remat: bool = False,
-                 zero_stage: int = 0):
+                 zero_stage: int = 0, accumulate_steps: int = 1):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -59,6 +59,10 @@ class ParallelTrainer:
         self.micro_batches = micro_batches
         self.remat = remat
         self.zero_stage = zero_stage
+        # GradientMerge (reference: fleet/meta_optimizers
+        # gradient_merge_optimizer + DistributedStrategy.gradient_merge):
+        # split each batch into k chunks, accumulate grads, one optimizer step
+        self.accumulate_steps = accumulate_steps
         self._step = None
         self.state = None
         self._init_state()
@@ -157,9 +161,29 @@ class ParallelTrainer:
 
         opt = self.optimizer
 
+        K = self.accumulate_steps
+
         def train_step(params, buffers, opt_state, key, lr, inputs, labels):
-            loss, grads = sharded_grads(dict(params), dict(buffers), key,
-                                        inputs, labels)
+            if K > 1:
+                # gradient merge: grads averaged over K sequential chunks
+                # (activation memory is 1/K; same numerics as the big batch)
+                ins = jnp.reshape(inputs, (K, inputs.shape[0] // K)
+                                  + inputs.shape[1:])
+                lbs = jnp.reshape(labels, (K, labels.shape[0] // K)
+                                  + labels.shape[1:])
+                keys = jax.random.split(key, K)
+                loss = 0.0
+                grads = None
+                for i in range(K):
+                    l_i, g_i = sharded_grads(dict(params), dict(buffers),
+                                             keys[i], ins[i], lbs[i])
+                    loss = loss + l_i / K
+                    grads = g_i if grads is None else jax.tree_util.tree_map(
+                        lambda a, b: a + b, grads, g_i)
+                grads = jax.tree_util.tree_map(lambda g: g / K, grads)
+            else:
+                loss, grads = sharded_grads(dict(params), dict(buffers), key,
+                                            inputs, labels)
             tparams = {k: v for k, v in params.items() if self.trainable[k]}
             new_t, new_opt = opt.apply_gradients(tparams, grads, opt_state,
                                                  lr=lr)
@@ -179,6 +203,12 @@ class ParallelTrainer:
     def train_step(self, inputs, labels, lr: Optional[float] = None):
         key = get_rng_key()
         lr = self.optimizer.get_lr() if lr is None else lr
+        if self.accumulate_steps > 1 and \
+                len(jnp.shape(inputs)) and \
+                jnp.shape(inputs)[0] % self.accumulate_steps != 0:
+            raise ValueError(
+                f"batch size {jnp.shape(inputs)[0]} is not divisible by "
+                f"accumulate_steps={self.accumulate_steps}")
         inputs = jax.device_put(jnp.asarray(inputs), self._data_sharding)
         labels = jax.device_put(jnp.asarray(labels), self._data_sharding)
         loss, new_params, new_opt = self._step(
@@ -193,3 +223,17 @@ class ParallelTrainer:
         for n, v in self.state["params"].items():
             if n in boxes:
                 boxes[n].value = v
+
+    # -- checkpoint ---------------------------------------------------------
+    def save_checkpoint(self, path: str, use_async: bool = False):
+        """Sharded save of {params, buffers, opt} — each shard written from
+        the device/host holding it (reference capability: per-rank sharded
+        save, dist_sharding_save.py test)."""
+        from .checkpoint import save_checkpoint as _save
+        return _save(path, self.state, use_async=use_async)
+
+    def load_checkpoint(self, path: str):
+        """Restore state with the trainer's own shardings (mesh-keyed)."""
+        from .checkpoint import load_checkpoint as _load
+        self.state = _load(path, template=self.state)
+        return self.state

@@ -157,6 +157,8 @@ class Fleet:
         raise NotImplementedError("use paddle_tpu.save / distributed.checkpoint")
 
 
+from . import utils  # noqa: F401,E402
+
 fleet = Fleet()
 
 # Module-level API mirroring `from paddle.distributed import fleet`

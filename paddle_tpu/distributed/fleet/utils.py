@@ -1,0 +1,77 @@
+"""fleet.utils — recompute (activation checkpointing) + hybrid-parallel grad
+helpers.
+
+Capability map (reference):
+- ``recompute``             ← fleet/utils/recompute.py:63 RecomputeFunction /
+  :171 recompute — a PyLayer that saves RNG state, drops activations, and
+  re-runs forward inside backward. Here it is jax.checkpoint: XLA
+  rematerializes the wrapped computation in the backward pass. RNG
+  determinism is free — randomness comes from explicit functional PRNG keys,
+  so the recomputed forward sees the same keys (no CUDA RNG state
+  save/restore dance needed).
+- ``fused_allreduce_gradients`` ← fleet/utils/hybrid_parallel_util.py:117 —
+  bucketed NCCL allreduce over the DP axis. Here one pmean per gradient
+  tree: XLA fuses/schedules collectives itself (no manual bucketing).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+from jax import lax
+
+__all__ = ["recompute", "checkpoint_policy", "fused_allreduce_gradients"]
+
+_POLICIES = {
+    None: None,
+    "full": None,  # save nothing extra — recompute everything
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims_saveable":
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    "everything_saveable": jax.checkpoint_policies.everything_saveable,
+}
+
+
+def checkpoint_policy(name: Optional[str]):
+    """Resolve a policy name to a jax.checkpoint_policies entry. Policies
+    refine the memory/FLOPs trade (e.g. save matmul outputs, recompute
+    elementwise) — the knob the reference lacks (it always recomputes the
+    whole segment)."""
+    try:
+        return _POLICIES[name]
+    except KeyError:
+        raise ValueError(f"unknown checkpoint policy {name!r}; "
+                         f"one of {sorted(k for k in _POLICIES if k)}") from None
+
+
+def recompute(function: Callable, *args, preserve_rng_state: bool = True,
+              policy: Optional[str] = None, **kwargs):
+    """Run ``function(*args)`` with activation rematerialization: outputs are
+    computed now, intermediates are NOT kept for backward — they are
+    recomputed when gradients flow (reference: fleet/utils/recompute.py:171).
+
+    ``function`` may be a Layer or any callable; closed-over parameters are
+    treated as saved residuals (weights are live anyway), only the wrapped
+    segment's intermediates are dropped.
+    """
+    pol = checkpoint_policy(policy) if isinstance(policy, (str, type(None))) \
+        else policy
+    wrapped = jax.checkpoint(lambda *a: function(*a, **kwargs), policy=pol)
+    return wrapped(*args)
+
+
+def fused_allreduce_gradients(grads, hcg=None, axes=("data", "sharding")):
+    """Average a gradient pytree over the data-parallel axes. Valid inside
+    shard_map/pmap where the axes are bound; outside (single device or pure
+    pjit/GSPMD, where XLA inserts the collectives itself) it is a no-op."""
+    live = []
+    for ax in axes:
+        try:
+            lax.axis_index(ax)
+            live.append(ax)
+        except Exception:
+            pass
+    for ax in live:
+        grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, ax), grads)
+    return grads

@@ -88,28 +88,20 @@ def _example_args(layer, input_spec: Optional[Sequence[InputSpec]]):
                  for s in input_spec)
 
 
-def save(layer, path, input_spec=None, **configs):
-    """Export a Layer as StableHLO + params (reference: fluid/dygraph/jit.py:515
-    jit.save → __model__ + params; here: .stablehlo + .pdiparams pickle)."""
+def poly_arg_specs(input_spec, args):
+    """Export-time arg specs: dynamic dims (None/-1 in an InputSpec) become
+    symbolic shapes so the loaded model accepts any size there (the
+    reference's ProgramDesc keeps -1 dims natively; StableHLO needs shape
+    polymorphism). Shared by jit.save and static.save_inference_model.
+
+    Symbol naming: dynamic dim 0 shares one "batch" symbol across all
+    unnamed specs (so forward() may combine two dynamic-batch inputs —
+    export can prove the dims equal); other dynamic dims get per-spec
+    symbols. A named InputSpec scopes all its symbols by its name, letting
+    the user decouple batch dims that are genuinely independent.
+    """
     from jax import export as jax_export
 
-    layer.eval()
-    params, buffers = state_of(layer)
-    params, buffers = dict(params), dict(buffers)
-
-    def pure(params, buffers, *args):
-        out, _ = functional_call(layer, params, buffers, *args)
-        return out
-
-    args = _example_args(layer, input_spec)
-    # Dynamic dims (None/-1 in an InputSpec) export as symbolic shapes so the
-    # loaded model accepts any size there (the reference's ProgramDesc keeps
-    # -1 dims natively; StableHLO needs shape polymorphism).
-    # Symbol naming: dynamic dim 0 shares one "batch" symbol across all
-    # unnamed specs (so forward() may combine two dynamic-batch inputs —
-    # export can prove the dims equal); other dynamic dims get per-spec
-    # symbols. A named InputSpec scopes all its symbols by its name, letting
-    # the user decouple batch dims that are genuinely independent.
     poly_specs = []
     for i, s in enumerate(input_spec):
         if isinstance(s, InputSpec) and any(d == -1 for d in s.shape):
@@ -126,9 +118,25 @@ def save(layer, path, input_spec=None, **configs):
         else:
             poly_specs.append(None)
     if any(p is not None for p in poly_specs):
-        arg_specs = jax_export.symbolic_args_specs(args, poly_specs)
-    else:
-        arg_specs = args
+        return jax_export.symbolic_args_specs(args, poly_specs)
+    return args
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export a Layer as StableHLO + params (reference: fluid/dygraph/jit.py:515
+    jit.save → __model__ + params; here: .stablehlo + .pdiparams pickle)."""
+    from jax import export as jax_export
+
+    layer.eval()
+    params, buffers = state_of(layer)
+    params, buffers = dict(params), dict(buffers)
+
+    def pure(params, buffers, *args):
+        out, _ = functional_call(layer, params, buffers, *args)
+        return out
+
+    args = _example_args(layer, input_spec)
+    arg_specs = poly_arg_specs(input_spec, args)
     exported = jax_export.export(jax.jit(pure))(
         jax.tree_util.tree_map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params),
         jax.tree_util.tree_map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), buffers),

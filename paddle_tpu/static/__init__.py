@@ -1,0 +1,261 @@
+"""paddle_tpu.static — declarative (static-graph) facade.
+
+Capability map (reference):
+- ``Program`` / ProgramDesc            ← fluid/framework.py:4017 Program,
+  framework/framework.proto:202 — here a Program IS a captured jaxpr
+  (SURVEY.md §7: jaxprs + XLA replace ProgramDesc/Graph; no new IR).
+- ``Executor.run(feed/fetch)``         ← fluid/executor.py:475,916 — here a
+  cached jax.jit executable; the per-op interpreter loop
+  (framework/executor.cc:166) dissolves into one XLA program.
+- ``append_backward``                  ← fluid/backward.py:1377 — jax.grad.
+- ``save/load_inference_model``        ← fluid/io.py:1246,1459 — StableHLO
+  export via paddle_tpu.jit.
+- ``CompiledProgram``                  ← fluid/compiler.py — pjit over a mesh
+  replaces the multi-device ParallelExecutor build.
+
+Design note: the reference builds programs *imperatively* — layer calls
+append OpDescs to a global block. On TPU the same declarative capability is
+reached by TRACING: the network is an ordinary Python function (eager
+semantics, same code as dygraph — the dual-mode split collapses), and
+``Program.trace(fn, specs)`` stages it once into a jaxpr. ``static.data``
+declares the feed placeholders; names bind feeds at run time.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..jit import InputSpec
+
+__all__ = [
+    "InputSpec", "data", "Program", "Executor", "CompiledProgram",
+    "default_main_program", "program_guard", "append_backward", "gradients",
+    "save_inference_model", "load_inference_model", "name_scope", "cpu_places",
+    "device_count",
+]
+
+
+def data(name: str, shape, dtype="float32") -> InputSpec:
+    """Declare a named feed placeholder (reference: paddle.static.data,
+    fluid/layers/io.py data). Returns an InputSpec consumed by
+    ``Program.trace``; the name binds ``feed={name: value}`` at run time."""
+    return InputSpec(shape, dtype=dtype, name=name)
+
+
+class Program:
+    """A staged computation: ordered feed specs + traced pure function.
+
+    reference: fluid/framework.py:4017. ``trace`` is the only constructor
+    that populates it; an empty Program exists for program_guard parity.
+    """
+
+    def __init__(self):
+        self._fn: Optional[Callable] = None
+        self._specs: "OrderedDict[str, InputSpec]" = OrderedDict()
+        self._jaxpr = None
+        self._fetch_names: List[str] = []
+        self._compiled: Optional[Callable] = None  # set by Executor
+
+    @classmethod
+    def trace(cls, fn: Callable, *specs: InputSpec, fetch_names=None,
+              static_batch: Optional[int] = None) -> "Program":
+        """Capture ``fn(*arrays) -> output(s)`` as a Program. ``specs`` come
+        from ``static.data`` (order = positional argument order)."""
+        prog = cls()
+        prog._fn = fn
+        for i, s in enumerate(specs):
+            name = s.name or f"x{i}"
+            prog._specs[name] = s
+        shapes = [s.to_shape_dtype(static_batch or 1) for s in specs]
+        prog._jaxpr = jax.make_jaxpr(fn)(*shapes)
+        outs = jax.eval_shape(fn, *shapes)
+        n_out = len(outs) if isinstance(outs, (tuple, list)) else 1
+        prog._fetch_names = list(fetch_names or
+                                 [f"fetch_{i}" for i in range(n_out)])
+        return prog
+
+    # -- introspection (ProgramDesc analogues) ----------------------------
+    @property
+    def feed_names(self) -> List[str]:
+        return list(self._specs)
+
+    @property
+    def fetch_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def num_ops(self) -> int:
+        return 0 if self._jaxpr is None else len(self._jaxpr.jaxpr.eqns)
+
+    def to_string(self, throw_on_error=True, with_details=False) -> str:
+        return "<empty Program>" if self._jaxpr is None else str(self._jaxpr)
+
+    __str__ = to_string
+
+    def clone(self, for_test: bool = False) -> "Program":
+        import copy
+        return copy.copy(self)
+
+
+_default_main = Program()
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    """Parameter init is eager here (initializers run at Layer construction);
+    the startup program (fluid/framework.py default_startup_program) has no
+    work left to do — returned for API parity."""
+    return Program()
+
+
+class program_guard:
+    """reference: fluid/framework.py program_guard. Swaps the default main
+    program; network code inside the guard should be wrapped into a function
+    and staged with ``Program.trace`` (see module docstring)."""
+
+    def __init__(self, main_program: Program, startup_program: Optional[Program] = None):
+        self._prog = main_program
+
+    def __enter__(self):
+        global _default_main
+        self._saved = _default_main
+        _default_main = self._prog
+        return self._prog
+
+    def __exit__(self, *exc):
+        global _default_main
+        _default_main = self._saved
+        return False
+
+
+def append_backward(loss_fn: Callable, wrt=0) -> Callable:
+    """Given a scalar-valued ``loss_fn``, return ``grad_fn`` computing
+    d loss / d args[wrt] (reference: fluid/backward.py:1377 — which walks the
+    ProgramDesc emitting grad ops; jax.grad derives the same from the jaxpr)."""
+    return jax.grad(loss_fn, argnums=wrt)
+
+
+def gradients(loss_fn: Callable, wrt=0) -> Callable:
+    return append_backward(loss_fn, wrt)
+
+
+class Executor:
+    """Session-style runner (reference: fluid/executor.py:475 Executor,
+    :916 run). Compiles (once, cached per Program + shapes) and executes."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
+            fetch_list: Optional[Sequence] = None, return_numpy: bool = True):
+        program = program or default_main_program()
+        if program._fn is None:
+            raise ValueError("Program is empty — build it with Program.trace "
+                             "(see paddle_tpu.static docstring)")
+        feed = feed or {}
+        try:
+            args = [jnp.asarray(feed[name]) for name in program.feed_names]
+        except KeyError as e:
+            raise KeyError(f"missing feed {e} (program feeds: "
+                           f"{program.feed_names})") from None
+        # compiled executable lives on the Program (an id()-keyed cache here
+        # could alias a new Program at a recycled address)
+        if program._compiled is None:
+            program._compiled = jax.jit(program._fn)
+        outs = program._compiled(*args)
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        if fetch_list:
+            name_to_i = {n: i for i, n in enumerate(program.fetch_names)}
+            sel = []
+            for f in fetch_list:
+                if isinstance(f, str) and f in name_to_i:
+                    sel.append(outs[name_to_i[f]])
+                elif isinstance(f, int):
+                    sel.append(outs[f])
+                else:
+                    raise KeyError(f"unknown fetch {f!r} (have "
+                                   f"{program.fetch_names})")
+            outs = sel
+        if return_numpy:
+            outs = [np.asarray(o) for o in outs]
+        return list(outs)
+
+    def close(self):
+        pass
+
+
+class CompiledProgram:
+    """reference: fluid/compiler.py CompiledProgram → ParallelExecutor.
+    On TPU multi-device execution is pjit/GSPMD: wrap a Program and it runs
+    jitted over the active mesh with sharded feeds handled by XLA."""
+
+    def __init__(self, program: Program, build_strategy=None):
+        self._program = program
+        self.build_strategy = build_strategy
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
+                         program: Optional[Program] = None, **kwargs):
+    """Export a traced Program (StableHLO + empty params blob) —
+    reference: fluid/io.py:1246 save_inference_model."""
+    from jax import export as jax_export
+    import os
+    import pickle
+
+    program = program or default_main_program()
+    if program._fn is None:
+        raise ValueError("Program is empty")
+    from ..jit import poly_arg_specs
+    specs = list(program._specs.values())
+    args = [s.to_shape_dtype(1) for s in specs]
+    exported = jax_export.export(jax.jit(program._fn))(
+        *poly_arg_specs(specs, args))
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path_prefix + ".stablehlo", "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump({"feed_names": program.feed_names,
+                     "fetch_names": program.fetch_names}, f)
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    """Returns (program_like_callable, feed_names, fetch_names)
+    (reference: fluid/io.py:1459)."""
+    from jax import export as jax_export
+    import pickle
+
+    with open(path_prefix + ".stablehlo", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+
+    def run(*args):
+        return exported.call(*args)
+
+    return run, meta["feed_names"], meta["fetch_names"]
+
+
+def name_scope(prefix: str):
+    return jax.named_scope(prefix)
+
+
+def cpu_places(device_count: Optional[int] = None):
+    devs = jax.devices("cpu") if any(
+        d.platform == "cpu" for d in jax.devices()) else []
+    return devs[:device_count] if device_count else devs
+
+
+def device_count() -> int:
+    return jax.device_count()

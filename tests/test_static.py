@@ -1,0 +1,81 @@
+"""Static namespace (C37/C38) tests — Program.trace, Executor.run feed/fetch,
+append_backward, save/load_inference_model. (reference test analogues:
+fluid/tests/unittests/test_executor_*.py, test_inference_model_io.py)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+
+
+def _build_net():
+    paddle.seed(0)
+    net = nn.Linear(4, 3)
+    net.eval()
+    return net
+
+
+def test_program_trace_and_executor_run():
+    net = _build_net()
+
+    def fwd(x):
+        return net(x)
+
+    x_spec = static.data("x", [None, 4], "float32")
+    prog = static.Program.trace(fwd, x_spec, fetch_names=["y"])
+    assert prog.feed_names == ["x"]
+    assert prog.num_ops() > 0
+    assert "lambda" in str(prog) or "let" in str(prog)   # jaxpr text
+
+    exe = static.Executor()
+    x = np.random.RandomState(0).rand(2, 4).astype("float32")
+    (y,) = exe.run(prog, feed={"x": x}, fetch_list=["y"])
+    ref = np.asarray(net(jnp.asarray(x)))
+    np.testing.assert_allclose(y, ref, rtol=1e-6)
+
+    with pytest.raises(KeyError):
+        exe.run(prog, feed={}, fetch_list=["y"])
+    with pytest.raises(KeyError):
+        exe.run(prog, feed={"x": x}, fetch_list=["nope"])
+
+
+def test_program_guard_and_default_program():
+    prog = static.Program()
+    with static.program_guard(prog):
+        assert static.default_main_program() is prog
+    assert static.default_main_program() is not prog
+
+
+def test_append_backward():
+    def loss_fn(w, x):
+        return jnp.mean((x @ w) ** 2)
+
+    grad_fn = static.append_backward(loss_fn)
+    w = jnp.ones((3, 2))
+    x = jnp.ones((4, 3))
+    g = grad_fn(w, x)
+    assert g.shape == w.shape
+    # finite-difference check on one element
+    eps = 1e-3
+    w2 = w.at[0, 0].add(eps)
+    num = (loss_fn(w2, x) - loss_fn(w, x)) / eps
+    assert abs(float(g[0, 0]) - float(num)) < 1e-2
+
+
+def test_save_load_inference_model(tmp_path):
+    net = _build_net()
+
+    def fwd(x):
+        return net(x)
+
+    prog = static.Program.trace(fwd, static.data("x", [None, 4]))
+    path = str(tmp_path / "inf" / "model")
+    static.save_inference_model(path, None, None, program=prog)
+    run, feeds, fetches = static.load_inference_model(path)
+    # dynamic batch dim survives export
+    x = np.random.RandomState(1).rand(5, 4).astype("float32")
+    y = np.asarray(run(jnp.asarray(x)))
+    ref = np.asarray(net(jnp.asarray(x)))
+    np.testing.assert_allclose(y, ref, rtol=1e-6)
+    assert feeds == ["x"]
