@@ -1,0 +1,194 @@
+// Multi-threaded MultiSlot-format ingest — the TPU-native equivalent of the
+// reference's C++ DataFeed/Dataset tier (reference behavior modeled:
+// framework/data_feed.h:757 MultiSlotDataFeed text parsing, data_set.h:43
+// in-memory dataset; NOT a port: fresh mmap-free design that parses line
+// ranges in parallel into CSR-style (offsets, values) arrays per slot,
+// exposed over a C ABI so Python reads them zero-copy via ctypes/numpy).
+//
+// Format (one example per line, slots in fixed order):
+//   <n0> v0_1 ... v0_n0  <n1> v1_1 ... v1_n1  ...
+// Sparse slots carry int64 feature ids, dense slots carry floats.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct SlotData {
+  bool dense;
+  std::vector<int64_t> offsets;  // per line, CSR; size = lines+1 (merged)
+  std::vector<int64_t> ids;      // sparse payload
+  std::vector<float> vals;       // dense payload
+};
+
+struct Feed {
+  int64_t num_lines = 0;
+  std::vector<SlotData> slots;
+};
+
+struct ChunkResult {
+  std::vector<SlotData> slots;
+  int64_t lines = 0;
+};
+
+// Parse [begin, end) — a whole number of lines — into per-slot buffers.
+// Each line is tokenized against a null-terminated copy so strtol/strtof
+// can never walk past its newline (they treat '\n' as skippable whitespace)
+// into the next line or, at a chunk boundary, into another thread's chunk.
+void ParseChunk(const char* begin, const char* end, int num_slots,
+                const int* is_dense, ChunkResult* out) {
+  out->slots.resize(num_slots);
+  for (int s = 0; s < num_slots; ++s) out->slots[s].dense = is_dense[s] != 0;
+  const char* p = begin;
+  std::string line;
+  while (p < end) {
+    const char* eol = static_cast<const char*>(
+        std::memchr(p, '\n', end - p));
+    if (!eol) eol = end;
+    line.assign(p, eol);
+    const char* q = line.c_str();
+    // snapshot sizes so a malformed line rolls back fully — a partial line
+    // must not shift the CSR alignment of every later example
+    std::vector<size_t> save_ids(num_slots), save_vals(num_slots);
+    for (int s = 0; s < num_slots; ++s) {
+      save_ids[s] = out->slots[s].ids.size();
+      save_vals[s] = out->slots[s].vals.size();
+    }
+    bool ok = true;
+    for (int s = 0; s < num_slots && ok; ++s) {
+      SlotData& sd = out->slots[s];
+      char* next = nullptr;
+      long cnt = std::strtol(q, &next, 10);
+      if (next == q || cnt < 0) { ok = false; break; }
+      q = next;
+      for (long i = 0; i < cnt; ++i) {
+        if (sd.dense) {
+          float v = std::strtof(q, &next);
+          if (next == q) { ok = false; break; }
+          sd.vals.push_back(v);
+        } else {
+          long long v = std::strtoll(q, &next, 10);
+          if (next == q) { ok = false; break; }
+          sd.ids.push_back(v);
+        }
+        q = next;
+      }
+    }
+    if (ok) {
+      for (int s = 0; s < num_slots; ++s) {
+        SlotData& sd = out->slots[s];
+        sd.offsets.push_back(sd.dense
+                                 ? static_cast<int64_t>(sd.vals.size())
+                                 : static_cast<int64_t>(sd.ids.size()));
+      }
+      ++out->lines;
+    } else {
+      // malformed lines are dropped (the reference's DataFeed logs & drops)
+      for (int s = 0; s < num_slots; ++s) {
+        out->slots[s].ids.resize(save_ids[s]);
+        out->slots[s].vals.resize(save_vals[s]);
+      }
+    }
+    p = eol + 1;
+  }
+}
+
+Feed* ParseFile(const char* path, int num_slots, const int* is_dense,
+                int nthreads) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::string buf(static_cast<size_t>(size), '\0');
+  if (size > 0 && std::fread(&buf[0], 1, size, f) != static_cast<size_t>(size)) {
+    std::fclose(f);
+    return nullptr;
+  }
+  std::fclose(f);
+
+  if (nthreads < 1) nthreads = 1;
+  if (size < (1 << 16)) nthreads = 1;
+  // split at line boundaries
+  std::vector<const char*> cuts{buf.data()};
+  for (int t = 1; t < nthreads; ++t) {
+    const char* guess = buf.data() + size * t / nthreads;
+    const char* nl = static_cast<const char*>(
+        std::memchr(guess, '\n', buf.data() + size - guess));
+    cuts.push_back(nl ? nl + 1 : buf.data() + size);
+  }
+  cuts.push_back(buf.data() + size);
+
+  std::vector<ChunkResult> results(nthreads);
+  std::vector<std::thread> workers;
+  for (int t = 0; t < nthreads; ++t) {
+    workers.emplace_back(ParseChunk, cuts[t], cuts[t + 1], num_slots,
+                         is_dense, &results[t]);
+  }
+  for (auto& w : workers) w.join();
+
+  // merge chunks in order (offsets rebased)
+  Feed* feed = new Feed();
+  feed->slots.resize(num_slots);
+  for (int s = 0; s < num_slots; ++s) {
+    SlotData& dst = feed->slots[s];
+    dst.dense = is_dense[s] != 0;
+    dst.offsets.push_back(0);
+  }
+  for (auto& r : results) {
+    for (int s = 0; s < num_slots; ++s) {
+      SlotData& dst = feed->slots[s];
+      SlotData& src = r.slots[s];
+      int64_t base = dst.dense ? static_cast<int64_t>(dst.vals.size())
+                               : static_cast<int64_t>(dst.ids.size());
+      for (int64_t off : src.offsets) dst.offsets.push_back(base + off);
+      dst.ids.insert(dst.ids.end(), src.ids.begin(), src.ids.end());
+      dst.vals.insert(dst.vals.end(), src.vals.begin(), src.vals.end());
+    }
+    feed->num_lines += r.lines;
+  }
+  return feed;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ps_datafeed_parse(const char* path, int num_slots, const int* is_dense,
+                        int nthreads) {
+  return ParseFile(path, num_slots, is_dense, nthreads);
+}
+
+void ps_datafeed_destroy(void* h) { delete static_cast<Feed*>(h); }
+
+int64_t ps_datafeed_num_lines(void* h) {
+  return static_cast<Feed*>(h)->num_lines;
+}
+
+int64_t ps_datafeed_slot_total(void* h, int slot) {
+  const SlotData& s = static_cast<Feed*>(h)->slots[slot];
+  return s.dense ? static_cast<int64_t>(s.vals.size())
+                 : static_cast<int64_t>(s.ids.size());
+}
+
+void ps_datafeed_slot_offsets(void* h, int slot, int64_t* out) {
+  const SlotData& s = static_cast<Feed*>(h)->slots[slot];
+  std::memcpy(out, s.offsets.data(), sizeof(int64_t) * s.offsets.size());
+}
+
+void ps_datafeed_slot_ids(void* h, int slot, int64_t* out) {
+  const SlotData& s = static_cast<Feed*>(h)->slots[slot];
+  std::memcpy(out, s.ids.data(), sizeof(int64_t) * s.ids.size());
+}
+
+void ps_datafeed_slot_vals(void* h, int slot, float* out) {
+  const SlotData& s = static_cast<Feed*>(h)->slots[slot];
+  std::memcpy(out, s.vals.data(), sizeof(float) * s.vals.size());
+}
+
+}  // extern "C"

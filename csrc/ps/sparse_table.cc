@@ -1,0 +1,358 @@
+// Host-side sharded sparse embedding table — the TPU-native equivalent of
+// the reference's brpc parameter-server sparse tables
+// (reference behavior modeled: distributed/table/common_sparse_table.cc —
+// sharded key->row storage with per-row optimizer state, pull auto-creates
+// rows; framework/fleet/heter_ps/hashtable.h — hash-table embedding store;
+// NOT a port: this is a fresh std::unordered_map + std::thread design with a
+// C ABI for ctypes, no RPC/brpc layer — in the single-controller JAX runtime
+// the "server" lives in-process and multi-host sharding is done above by
+// key-hash routing).
+//
+// Concurrency: keys hash to NUM_SHARDS sub-maps, each with its own mutex.
+// Batched pull/push fan out over worker threads; within one batch a shard
+// is only touched by the thread owning (shard % nthreads), so duplicate
+// keys serialize. The per-shard mutex is still taken for every row
+// operation because *independent* calls may overlap (JAX may dispatch the
+// pure_callback pull and the io_callback push on different host threads,
+// and ctypes releases the GIL): find+create+update happen under the lock so
+// a concurrent pool resize can never invalidate a row pointer in use.
+//
+// Optimizers run on the host, one row at a time, matching the PS model
+// where the server applies updates (SGD / Adagrad / Adam).
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kShards = 64;
+
+enum Optimizer : int { kSGD = 0, kAdagrad = 1, kAdam = 2 };
+
+struct Shard {
+  std::unordered_map<int64_t, uint64_t> index;  // key -> row offset
+  std::vector<float> pool;                      // rows, stride = row_width
+  std::mutex mu;
+};
+
+class SparseTable {
+ public:
+  SparseTable(int dim, int opt, uint64_t seed, float init_range,
+              float beta1, float beta2, float eps)
+      : dim_(dim), opt_(opt), seed_(seed), init_range_(init_range),
+        beta1_(beta1), beta2_(beta2), eps_(eps), step_(0) {
+    switch (opt_) {
+      case kSGD: slots_ = 0; break;
+      case kAdagrad: slots_ = 1; break;
+      case kAdam: slots_ = 2; break;
+      default: slots_ = 0; opt_ = kSGD;
+    }
+    row_width_ = dim_ * (1 + slots_);
+  }
+
+  int dim() const { return dim_; }
+
+  int64_t size() {
+    int64_t n = 0;
+    for (auto& s : shards_) {
+      std::lock_guard<std::mutex> lk(s.mu);
+      n += static_cast<int64_t>(s.index.size());
+    }
+    return n;
+  }
+
+  // Lookup rows for keys[0..n); missing keys are initialized (uniform in
+  // [-init_range, init_range], deterministic in (seed, key)) when
+  // create_missing, else zero-filled.
+  void Pull(const int64_t* keys, int64_t n, float* out, bool create_missing) {
+    RunSharded(n, [&](int shard_lo, int tid, int nthreads) {
+      for (int64_t i = 0; i < n; ++i) {
+        int s = ShardOf(keys[i]);
+        if (s % nthreads != tid) continue;
+        float* dst = out + i * dim_;
+        std::lock_guard<std::mutex> lk(shards_[s].mu);
+        const float* row = FindOrCreate(keys[i], create_missing);
+        if (row) {
+          std::memcpy(dst, row, sizeof(float) * dim_);
+        } else {
+          std::memset(dst, 0, sizeof(float) * dim_);
+        }
+      }
+    });
+  }
+
+  // Apply grads[0..n*dim) to rows of keys (creating them if absent).
+  void Push(const int64_t* keys, int64_t n, const float* grads, float lr) {
+    int64_t t = ++step_;
+    // bias correction uses the table-global step (PS-style, one logical
+    // optimizer step per push batch)
+    float bc1 = 1.0f - std::pow(beta1_, static_cast<float>(t));
+    float bc2 = 1.0f - std::pow(beta2_, static_cast<float>(t));
+    RunSharded(n, [&](int shard_lo, int tid, int nthreads) {
+      for (int64_t i = 0; i < n; ++i) {
+        int s = ShardOf(keys[i]);
+        if (s % nthreads != tid) continue;
+        std::lock_guard<std::mutex> lk(shards_[s].mu);
+        float* row = const_cast<float*>(FindOrCreate(keys[i], true));
+        const float* g = grads + i * dim_;
+        switch (opt_) {
+          case kSGD:
+            for (int d = 0; d < dim_; ++d) row[d] -= lr * g[d];
+            break;
+          case kAdagrad: {
+            float* g2 = row + dim_;
+            for (int d = 0; d < dim_; ++d) {
+              g2[d] += g[d] * g[d];
+              row[d] -= lr * g[d] / (std::sqrt(g2[d]) + eps_);
+            }
+            break;
+          }
+          case kAdam: {
+            float* m = row + dim_;
+            float* v = row + 2 * dim_;
+            for (int d = 0; d < dim_; ++d) {
+              m[d] = beta1_ * m[d] + (1.0f - beta1_) * g[d];
+              v[d] = beta2_ * v[d] + (1.0f - beta2_) * g[d] * g[d];
+              float mh = m[d] / bc1;
+              float vh = v[d] / bc2;
+              row[d] -= lr * mh / (std::sqrt(vh) + eps_);
+            }
+            break;
+          }
+        }
+      }
+    });
+  }
+
+  // Binary format: header(dim, opt, slots, step, nrows) then per row:
+  // key + row_width floats.
+  bool Save(const char* path) {
+    FILE* f = std::fopen(path, "wb");
+    if (!f) return false;
+    int64_t header[5] = {dim_, opt_, slots_, step_.load(), size()};
+    std::fwrite(header, sizeof(int64_t), 5, f);
+    for (auto& s : shards_) {
+      std::lock_guard<std::mutex> lk(s.mu);
+      for (const auto& kv : s.index) {
+        std::fwrite(&kv.first, sizeof(int64_t), 1, f);
+        std::fwrite(s.pool.data() + kv.second, sizeof(float), row_width_, f);
+      }
+    }
+    std::fclose(f);
+    return true;
+  }
+
+  bool Load(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return false;
+    int64_t header[5];
+    if (std::fread(header, sizeof(int64_t), 5, f) != 5 ||
+        header[0] != dim_ || header[1] != opt_) {
+      std::fclose(f);
+      return false;
+    }
+    step_ = header[3];
+    // a checkpoint fully replaces table contents (rows auto-created by a
+    // warm-up pull before load must not survive and merge with it)
+    for (auto& s : shards_) {
+      std::lock_guard<std::mutex> lk(s.mu);
+      s.index.clear();
+      s.pool.clear();
+    }
+    std::vector<float> row(row_width_);
+    for (int64_t i = 0; i < header[4]; ++i) {
+      int64_t key;
+      if (std::fread(&key, sizeof(int64_t), 1, f) != 1 ||
+          std::fread(row.data(), sizeof(float), row_width_, f) !=
+              static_cast<size_t>(row_width_)) {
+        std::fclose(f);
+        return false;
+      }
+      Shard& s = shards_[ShardOf(key)];
+      std::lock_guard<std::mutex> lk(s.mu);
+      uint64_t off = AllocRow(s);
+      s.index[key] = off;
+      std::memcpy(s.pool.data() + off, row.data(),
+                  sizeof(float) * row_width_);
+    }
+    std::fclose(f);
+    return true;
+  }
+
+ private:
+  static int ShardOf(int64_t key) {
+    uint64_t h = static_cast<uint64_t>(key);
+    h ^= h >> 33;
+    h *= 0xff51afd7ed558ccdULL;
+    h ^= h >> 33;
+    return static_cast<int>(h % kShards);
+  }
+
+  uint64_t AllocRow(Shard& s) {
+    uint64_t off = s.pool.size();
+    s.pool.resize(off + row_width_, 0.0f);
+    return off;
+  }
+
+  // caller must hold the shard's mutex
+  const float* FindOrCreate(int64_t key, bool create) {
+    Shard& s = shards_[ShardOf(key)];
+    auto it = s.index.find(key);
+    if (it != s.index.end()) return s.pool.data() + it->second;
+    if (!create) return nullptr;
+    uint64_t off = AllocRow(s);
+    s.index[key] = off;
+    float* row = s.pool.data() + off;
+    if (init_range_ > 0.0f) {
+      std::mt19937_64 rng(seed_ ^ static_cast<uint64_t>(key) * 0x9e3779b9ULL);
+      std::uniform_real_distribution<float> dist(-init_range_, init_range_);
+      for (int d = 0; d < dim_; ++d) row[d] = dist(rng);
+    }
+    return row;
+  }
+
+  template <typename Fn>
+  void RunSharded(int64_t n, Fn fn) {
+    int nthreads = static_cast<int>(
+        std::min<int64_t>(std::max<int64_t>(n / 1024, 1), 8));
+    if (nthreads <= 1) {
+      fn(0, 0, 1);
+      return;
+    }
+    std::vector<std::thread> workers;
+    workers.reserve(nthreads);
+    for (int t = 0; t < nthreads; ++t) {
+      workers.emplace_back([&, t]() { fn(0, t, nthreads); });
+    }
+    for (auto& w : workers) w.join();
+  }
+
+  int dim_, opt_, slots_, row_width_;
+  uint64_t seed_;
+  float init_range_, beta1_, beta2_, eps_;
+  std::atomic<int64_t> step_;
+  Shard shards_[kShards];
+};
+
+// Dense table: one contiguous parameter block with host optimizer — the
+// analogue of distributed/table/common_dense_table.cc.
+class DenseTable {
+ public:
+  DenseTable(int64_t size, int opt, float beta1, float beta2, float eps)
+      : opt_(opt), beta1_(beta1), beta2_(beta2), eps_(eps), step_(0),
+        data_(size, 0.0f) {
+    if (opt_ == kAdagrad) slot1_.assign(size, 0.0f);
+    if (opt_ == kAdam) {
+      slot1_.assign(size, 0.0f);
+      slot2_.assign(size, 0.0f);
+    }
+  }
+
+  int64_t size() const { return static_cast<int64_t>(data_.size()); }
+
+  void Set(const float* src) {
+    std::memcpy(data_.data(), src, sizeof(float) * data_.size());
+  }
+
+  void Pull(float* out) {
+    std::memcpy(out, data_.data(), sizeof(float) * data_.size());
+  }
+
+  void Push(const float* g, float lr) {
+    int64_t t = ++step_;
+    float bc1 = 1.0f - std::pow(beta1_, static_cast<float>(t));
+    float bc2 = 1.0f - std::pow(beta2_, static_cast<float>(t));
+    int64_t n = size();
+    switch (opt_) {
+      case kSGD:
+        for (int64_t i = 0; i < n; ++i) data_[i] -= lr * g[i];
+        break;
+      case kAdagrad:
+        for (int64_t i = 0; i < n; ++i) {
+          slot1_[i] += g[i] * g[i];
+          data_[i] -= lr * g[i] / (std::sqrt(slot1_[i]) + eps_);
+        }
+        break;
+      case kAdam:
+        for (int64_t i = 0; i < n; ++i) {
+          slot1_[i] = beta1_ * slot1_[i] + (1.0f - beta1_) * g[i];
+          slot2_[i] = beta2_ * slot2_[i] + (1.0f - beta2_) * g[i] * g[i];
+          data_[i] -= lr * (slot1_[i] / bc1) /
+                      (std::sqrt(slot2_[i] / bc2) + eps_);
+        }
+        break;
+    }
+  }
+
+ private:
+  int opt_;
+  float beta1_, beta2_, eps_;
+  std::atomic<int64_t> step_;
+  std::vector<float> data_, slot1_, slot2_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ps_sparse_create(int dim, int optimizer, uint64_t seed,
+                       float init_range, float beta1, float beta2,
+                       float eps) {
+  return new SparseTable(dim, optimizer, seed, init_range, beta1, beta2, eps);
+}
+
+void ps_sparse_destroy(void* t) { delete static_cast<SparseTable*>(t); }
+
+int64_t ps_sparse_size(void* t) {
+  return static_cast<SparseTable*>(t)->size();
+}
+
+void ps_sparse_pull(void* t, const int64_t* keys, int64_t n, float* out,
+                    int create_missing) {
+  static_cast<SparseTable*>(t)->Pull(keys, n, out, create_missing != 0);
+}
+
+void ps_sparse_push(void* t, const int64_t* keys, int64_t n,
+                    const float* grads, float lr) {
+  static_cast<SparseTable*>(t)->Push(keys, n, grads, lr);
+}
+
+int ps_sparse_save(void* t, const char* path) {
+  return static_cast<SparseTable*>(t)->Save(path) ? 1 : 0;
+}
+
+int ps_sparse_load(void* t, const char* path) {
+  return static_cast<SparseTable*>(t)->Load(path) ? 1 : 0;
+}
+
+void* ps_dense_create(int64_t size, int optimizer, float beta1, float beta2,
+                      float eps) {
+  return new DenseTable(size, optimizer, beta1, beta2, eps);
+}
+
+void ps_dense_destroy(void* t) { delete static_cast<DenseTable*>(t); }
+
+int64_t ps_dense_size(void* t) { return static_cast<DenseTable*>(t)->size(); }
+
+void ps_dense_set(void* t, const float* src) {
+  static_cast<DenseTable*>(t)->Set(src);
+}
+
+void ps_dense_pull(void* t, float* out) {
+  static_cast<DenseTable*>(t)->Pull(out);
+}
+
+void ps_dense_push(void* t, const float* g, float lr) {
+  static_cast<DenseTable*>(t)->Push(g, lr);
+}
+
+}  // extern "C"

@@ -12,3 +12,4 @@ from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import ps  # noqa: F401

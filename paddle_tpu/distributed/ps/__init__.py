@@ -1,0 +1,11 @@
+"""paddle_tpu.distributed.ps — the sparse parameter-server tier.
+
+Native C++ core (csrc/ps/): sharded hash-table embedding store with
+server-side optimizers + multi-threaded MultiSlot ingest. Python tier here:
+tables, the jit-compatible DistributedEmbedding layer, and the in-memory
+dataset. See each module's docstring for the reference capability map
+(C27–C30 in SURVEY.md §2).
+"""
+from .datafeed import InMemoryDataset, QueueDataset  # noqa: F401
+from .embedding import DistributedEmbedding, make_lookup  # noqa: F401
+from .table import DenseTable, SparseTable, shard_keys  # noqa: F401

@@ -1,0 +1,116 @@
+"""MultiSlot dataset over the native parallel parser.
+
+Capability map (reference): framework/data_feed.h:208 DataFeed /
+:757 MultiSlotDataFeed (multi-threaded text ingest), data_set.h:43 Dataset
+(:101 LoadIntoMemory, global shuffle) and the python paddle.distributed
+InMemoryDataset wrappers. Slots are declared up front; each line holds, per
+slot, a count followed by that many int64 ids (sparse) or floats (dense).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .native import lib
+
+
+class _Slot:
+    def __init__(self, name: str, dense: bool):
+        self.name = name
+        self.dense = dense
+        self.offsets = np.zeros((1,), np.int64)   # CSR over examples
+        self.values = np.zeros((0,), np.float32 if dense else np.int64)
+
+
+class InMemoryDataset:
+    """reference: data_set.h:43 / python InMemoryDataset. load_into_memory
+    parses files with the native multi-threaded parser; global_shuffle
+    permutes examples; batches come out padded (sparse) or stacked (dense).
+    """
+
+    def __init__(self, slot_names: Sequence[str],
+                 dense_slots: Sequence[str] = ()):
+        self.slot_names = list(slot_names)
+        self._slots = [_Slot(n, n in set(dense_slots)) for n in slot_names]
+        self._order: Optional[np.ndarray] = None
+        self._n = 0
+
+    def __len__(self):
+        return self._n
+
+    def load_into_memory(self, filelist: Sequence[str], nthreads: int = 8):
+        l = lib()
+        kinds = np.array([1 if s.dense else 0 for s in self._slots],
+                         dtype=np.int32)
+        kp = kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_int))
+        for path in filelist:
+            h = l.ps_datafeed_parse(path.encode(), len(self._slots), kp,
+                                    nthreads)
+            if not h:
+                raise IOError(f"cannot parse {path}")
+            try:
+                n = int(l.ps_datafeed_num_lines(h))
+                for i, s in enumerate(self._slots):
+                    offs = np.empty((n + 1,), np.int64)
+                    l.ps_datafeed_slot_offsets(
+                        h, i, offs.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_int64)))
+                    total = int(l.ps_datafeed_slot_total(h, i))
+                    if s.dense:
+                        vals = np.empty((total,), np.float32)
+                        l.ps_datafeed_slot_vals(
+                            h, i, vals.ctypes.data_as(
+                                ctypes.POINTER(ctypes.c_float)))
+                    else:
+                        vals = np.empty((total,), np.int64)
+                        l.ps_datafeed_slot_ids(
+                            h, i, vals.ctypes.data_as(
+                                ctypes.POINTER(ctypes.c_int64)))
+                    base = s.values.size
+                    s.offsets = np.concatenate(
+                        [s.offsets, offs[1:] + base])
+                    s.values = np.concatenate([s.values, vals])
+            finally:
+                l.ps_datafeed_destroy(h)
+            self._n = self._slots[0].offsets.size - 1
+        self._order = np.arange(self._n)
+
+    def global_shuffle(self, seed: int = 0):
+        """reference: data_set.h global shuffle (single-host form: permute
+        the example order; multi-host exchange is the caller's alltoall)."""
+        rng = np.random.RandomState(seed)
+        self._order = rng.permutation(self._n)
+
+    def _example_slice(self, s: _Slot, idx: int):
+        a, b = s.offsets[idx], s.offsets[idx + 1]
+        return s.values[a:b]
+
+    def batch(self, start: int, size: int,
+              pad: int = -1) -> Dict[str, np.ndarray]:
+        """Examples [start, start+size) in the (possibly shuffled) order.
+        Sparse slots pad to the longest example with ``pad`` (=-1, the
+        DistributedEmbedding padding id); dense slots stack."""
+        idxs = self._order[start:start + size]
+        out: Dict[str, np.ndarray] = {}
+        for s in self._slots:
+            rows = [self._example_slice(s, int(i)) for i in idxs]
+            if s.dense:
+                out[s.name] = np.stack([r.astype(np.float32) for r in rows])
+            else:
+                L = max((r.size for r in rows), default=1) or 1
+                m = np.full((len(rows), L), pad, dtype=np.int64)
+                for j, r in enumerate(rows):
+                    m[j, :r.size] = r
+                out[s.name] = m
+        return out
+
+    def batches(self, batch_size: int, drop_last: bool = True):
+        n = (self._n // batch_size) * batch_size if drop_last else self._n
+        for st in range(0, n, batch_size):
+            yield self.batch(st, min(batch_size, n - st))
+
+
+# QueueDataset-style streaming is one pass over batches()
+QueueDataset = InMemoryDataset

@@ -1,0 +1,114 @@
+"""DistributedEmbedding — sparse PS-backed embedding inside jitted TPU code.
+
+Capability map (reference): operators/pscore/distributed_lookup_table_op.cc
+(worker-side pull), distributed/service/communicator.h:197 (async grad push),
+c_embedding / SelectedRows sparse-grad path. TPU-native shape: the lookup is
+a jax.pure_callback into the host C++ table (device never materializes the
+vocab), and the backward pass pushes gradients with an ordered
+jax.experimental.io_callback — the server-side optimizer applies the update
+(PS semantics: push IS the optimizer step, so the dense optimizer must not
+also own these rows).
+
+Why the ``grad_hook`` parameter exists: the table rows are not jax arrays,
+so no *requested* gradient mathematically depends on the lookup's
+cotangent — JAX would dead-code-eliminate the backward pass (and with it
+the grad push). Threading one trainable scalar through the opaque
+custom_vjp forces its backward to run exactly when parameter gradients are
+computed; the hook's own gradient is always zero, so it never moves.
+
+Padding: ids < 0 are padding — pulled as zero rows, their grads dropped at
+push.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...nn.layer import Layer
+from .table import SparseTable
+
+
+def make_lookup(table: SparseTable):
+    """Build lookup(ids, lr, hook) -> (..., dim) with grad-push backward."""
+    dim = table.dim
+
+    def _pull(ids):
+        def host_pull(ids_np):
+            ids_np = np.asarray(ids_np)
+            safe = np.where(ids_np < 0, 0, ids_np)
+            emb = table.pull(safe)
+            emb[ids_np < 0] = 0.0
+            return emb
+
+        out = jax.ShapeDtypeStruct(tuple(ids.shape) + (dim,), jnp.float32)
+        return jax.pure_callback(host_pull, out, ids)
+
+    @jax.custom_vjp
+    def lookup(ids, lr, hook):
+        return _pull(ids) + hook * 0.0
+
+    def fwd(ids, lr, hook):
+        return _pull(ids) + hook * 0.0, (ids, lr)
+
+    def bwd(res, g):
+        ids, lr = res
+
+        def host_push(ids_np, g_np, lr_np):
+            ids_np = np.asarray(ids_np).reshape(-1)
+            g_np = np.asarray(g_np).reshape(ids_np.size, dim)
+            mask = ids_np >= 0
+            if mask.any():
+                table.push(ids_np[mask], g_np[mask], float(lr_np))
+            return np.zeros((), np.float32)
+
+        jax.experimental.io_callback(
+            host_push, jax.ShapeDtypeStruct((), jnp.float32),
+            ids, g, lr, ordered=True)
+        return (np.zeros(ids.shape, jax.dtypes.float0), jnp.zeros_like(lr),
+                jnp.zeros(()))
+
+    lookup.defvjp(fwd, bwd)
+    return lookup
+
+
+class DistributedEmbedding(Layer):
+    """Embedding over an unbounded sparse vocabulary stored host-side.
+
+    The table's rows are NOT jax parameters: the host optimizer updates them
+    at gradient-push time (``lr`` here), exactly the PS division of labor —
+    keep these rows out of the device optimizer. (The only jax parameter is
+    the zero ``grad_hook``; see module docstring.)
+    """
+
+    def __init__(self, dim: int, optimizer: str = "adagrad", lr: float = 0.05,
+                 seed: int = 0, init_range: float = 0.01, pooling=None):
+        super().__init__()
+        from ...nn.initializer import Constant
+        self.dim = dim
+        self.lr = lr
+        self.pooling = pooling  # None | "sum" | "mean"
+        self.table = SparseTable(dim, optimizer=optimizer, seed=seed,
+                                 init_range=init_range)
+        self.grad_hook = self.create_parameter((), initializer=Constant(0.0))
+        self._lookup = make_lookup(self.table)
+
+    def forward(self, ids):
+        ids = jnp.asarray(ids)
+        emb = self._lookup(ids, jnp.asarray(self.lr, jnp.float32),
+                           self.grad_hook.value)
+        if self.pooling is None:
+            return emb
+        # pooled bag-of-ids: (B, L) -> (B, dim), padding ids excluded
+        mask = (ids >= 0).astype(jnp.float32)[..., None]
+        s = jnp.sum(emb * mask, axis=-2)
+        if self.pooling == "sum":
+            return s
+        cnt = jnp.maximum(jnp.sum(mask, axis=-2), 1.0)
+        return s / cnt
+
+    def save(self, path: str):
+        self.table.save(path)
+
+    def load(self, path: str):
+        self.table.load(path)

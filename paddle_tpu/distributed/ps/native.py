@@ -1,0 +1,90 @@
+"""Build + ctypes binding for the native PS core (csrc/ps/*.cc).
+
+The shared library is compiled on first use with g++ (cached by source
+mtime) — the lightweight stand-in for the reference's CMake superbuild
+(C66) for this subsystem; no pybind11 in the image, so the C ABI + ctypes
+is the binding layer (reference's pybind/ layer analogue).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(_HERE)))
+_SRC_DIR = os.path.join(_REPO, "csrc", "ps")
+_SOURCES = ["sparse_table.cc", "datafeed.cc"]
+_LIB_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "lib")
+_LIB = os.path.join(_LIB_DIR, "libpaddle_ps.so")
+
+_lock = threading.Lock()
+_dll = None
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB):
+        return True
+    lib_mtime = os.path.getmtime(_LIB)
+    return any(os.path.getmtime(os.path.join(_SRC_DIR, s)) > lib_mtime
+               for s in _SOURCES)
+
+
+def build():
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", _LIB] + srcs
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def lib() -> ctypes.CDLL:
+    """Load (building if stale) the native PS library."""
+    global _dll
+    with _lock:
+        if _dll is not None:
+            return _dll
+        if _needs_build():
+            build()
+        dll = ctypes.CDLL(_LIB)
+        c = ctypes
+        i64, f32 = c.c_int64, c.c_float
+        p_i64 = c.POINTER(c.c_int64)
+        p_f32 = c.POINTER(c.c_float)
+        p_int = c.POINTER(c.c_int)
+
+        dll.ps_sparse_create.restype = c.c_void_p
+        dll.ps_sparse_create.argtypes = [c.c_int, c.c_int, c.c_uint64, f32,
+                                         f32, f32, f32]
+        dll.ps_sparse_destroy.argtypes = [c.c_void_p]
+        dll.ps_sparse_size.restype = i64
+        dll.ps_sparse_size.argtypes = [c.c_void_p]
+        dll.ps_sparse_pull.argtypes = [c.c_void_p, p_i64, i64, p_f32, c.c_int]
+        dll.ps_sparse_push.argtypes = [c.c_void_p, p_i64, i64, p_f32, f32]
+        dll.ps_sparse_save.restype = c.c_int
+        dll.ps_sparse_save.argtypes = [c.c_void_p, c.c_char_p]
+        dll.ps_sparse_load.restype = c.c_int
+        dll.ps_sparse_load.argtypes = [c.c_void_p, c.c_char_p]
+
+        dll.ps_dense_create.restype = c.c_void_p
+        dll.ps_dense_create.argtypes = [i64, c.c_int, f32, f32, f32]
+        dll.ps_dense_destroy.argtypes = [c.c_void_p]
+        dll.ps_dense_size.restype = i64
+        dll.ps_dense_size.argtypes = [c.c_void_p]
+        dll.ps_dense_set.argtypes = [c.c_void_p, p_f32]
+        dll.ps_dense_pull.argtypes = [c.c_void_p, p_f32]
+        dll.ps_dense_push.argtypes = [c.c_void_p, p_f32, f32]
+
+        dll.ps_datafeed_parse.restype = c.c_void_p
+        dll.ps_datafeed_parse.argtypes = [c.c_char_p, c.c_int, p_int, c.c_int]
+        dll.ps_datafeed_destroy.argtypes = [c.c_void_p]
+        dll.ps_datafeed_num_lines.restype = i64
+        dll.ps_datafeed_num_lines.argtypes = [c.c_void_p]
+        dll.ps_datafeed_slot_total.restype = i64
+        dll.ps_datafeed_slot_total.argtypes = [c.c_void_p, c.c_int]
+        dll.ps_datafeed_slot_offsets.argtypes = [c.c_void_p, c.c_int, p_i64]
+        dll.ps_datafeed_slot_ids.argtypes = [c.c_void_p, c.c_int, p_i64]
+        dll.ps_datafeed_slot_vals.argtypes = [c.c_void_p, c.c_int, p_f32]
+        _dll = dll
+        return _dll

@@ -1,0 +1,132 @@
+"""Python-facing sparse/dense PS tables over the native core.
+
+Capability map (reference): distributed/table/common_sparse_table.cc (sharded
+key->row store, server-side optimizer), common_dense_table.cc,
+framework/fleet/fleet_wrapper.h:69 (pull/push entry points). The brpc RPC
+layer has no analogue here: in single-controller JAX the table lives
+in-process; multi-host deployments shard keys by hash across hosts (see
+``shard_keys``) and route pull/push with jax alltoall at the array level.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from .native import lib
+
+_OPTIMIZERS = {"sgd": 0, "adagrad": 1, "adam": 2}
+
+
+def _as_f32(a):
+    return np.ascontiguousarray(a, dtype=np.float32)
+
+
+def _as_i64(a):
+    return np.ascontiguousarray(a, dtype=np.int64)
+
+
+def _fp(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _ip(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+class SparseTable:
+    """Unbounded-vocabulary embedding table with host-side optimizer.
+
+    Rows materialize on first touch (no [vocab, dim] dense alloc) — the
+    trillion-parameter recsys pattern of the reference's PS tier.
+    """
+
+    def __init__(self, dim: int, optimizer: str = "adagrad", seed: int = 0,
+                 init_range: float = 0.01, beta1: float = 0.9,
+                 beta2: float = 0.999, eps: float = 1e-8):
+        if optimizer not in _OPTIMIZERS:
+            raise ValueError(f"optimizer must be one of {list(_OPTIMIZERS)}")
+        self.dim = dim
+        self.optimizer = optimizer
+        self._lib = lib()
+        self._h = self._lib.ps_sparse_create(
+            dim, _OPTIMIZERS[optimizer], seed, init_range, beta1, beta2, eps)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.ps_sparse_destroy(self._h)
+            self._h = None
+
+    def __len__(self):
+        return int(self._lib.ps_sparse_size(self._h))
+
+    def pull(self, keys, create_missing: bool = True) -> np.ndarray:
+        keys = _as_i64(keys)
+        flat = keys.reshape(-1)
+        out = np.empty((flat.size, self.dim), dtype=np.float32)
+        self._lib.ps_sparse_pull(self._h, _ip(flat), flat.size, _fp(out),
+                                 1 if create_missing else 0)
+        return out.reshape(keys.shape + (self.dim,))
+
+    def push(self, keys, grads, lr: float):
+        keys = _as_i64(keys).reshape(-1)
+        grads = _as_f32(grads).reshape(keys.size, self.dim)
+        self._lib.ps_sparse_push(self._h, _ip(keys), keys.size, _fp(grads),
+                                 lr)
+
+    def save(self, path: str):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        if not self._lib.ps_sparse_save(self._h, path.encode()):
+            raise IOError(f"failed to save sparse table to {path}")
+
+    def load(self, path: str):
+        if not self._lib.ps_sparse_load(self._h, path.encode()):
+            raise IOError(f"failed to load sparse table from {path} "
+                          f"(missing file or dim/optimizer mismatch)")
+
+
+class DenseTable:
+    """Host-resident dense parameter block with host optimizer
+    (reference: common_dense_table.cc)."""
+
+    def __init__(self, size: int, optimizer: str = "sgd", beta1: float = 0.9,
+                 beta2: float = 0.999, eps: float = 1e-8,
+                 init: Optional[np.ndarray] = None):
+        self.size = int(size)
+        self._lib = lib()
+        self._h = self._lib.ps_dense_create(
+            self.size, _OPTIMIZERS[optimizer], beta1, beta2, eps)
+        if init is not None:
+            self.set(init)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.ps_dense_destroy(self._h)
+            self._h = None
+
+    def set(self, values):
+        v = _as_f32(values).reshape(-1)
+        assert v.size == self.size
+        self._lib.ps_dense_set(self._h, _fp(v))
+
+    def pull(self) -> np.ndarray:
+        out = np.empty((self.size,), dtype=np.float32)
+        self._lib.ps_dense_pull(self._h, _fp(out))
+        return out
+
+    def push(self, grad, lr: float):
+        g = _as_f32(grad).reshape(-1)
+        assert g.size == self.size
+        self._lib.ps_dense_push(self._h, _fp(g), lr)
+
+
+def shard_keys(keys: np.ndarray, num_shards: int) -> np.ndarray:
+    """Hash-shard assignment for multi-host key routing (same mix as the
+    native table's internal sharding)."""
+    h = keys.astype(np.uint64).copy()
+    h ^= h >> np.uint64(33)
+    h *= np.uint64(0xFF51AFD7ED558CCD)
+    h ^= h >> np.uint64(33)
+    return (h % np.uint64(num_shards)).astype(np.int64)
