@@ -136,19 +136,37 @@ class SparseTable {
   // Binary format: header(dim, opt, slots, step, nrows) then per row:
   // key + row_width floats.
   bool Save(const char* path) {
-    FILE* f = std::fopen(path, "wb");
+    // Exclusive snapshot: every shard lock is held for the whole write so a
+    // concurrent Push (e.g. an async io_callback still landing) cannot add
+    // rows after the header count is taken. Written to a temp file and
+    // renamed so a crash mid-save never clobbers the previous checkpoint.
+    std::vector<std::unique_lock<std::mutex>> locks;
+    locks.reserve(kShards);
+    for (auto& s : shards_) locks.emplace_back(s.mu);
+    std::string tmp = std::string(path) + ".tmp";
+    FILE* f = std::fopen(tmp.c_str(), "wb");
     if (!f) return false;
-    int64_t header[5] = {dim_, opt_, slots_, step_.load(), size()};
-    std::fwrite(header, sizeof(int64_t), 5, f);
+    int64_t nrows = 0;
+    for (auto& s : shards_) nrows += static_cast<int64_t>(s.index.size());
+    int64_t header[5] = {dim_, opt_, slots_, step_.load(), nrows};
+    bool ok = std::fwrite(header, sizeof(int64_t), 5, f) == 5;
     for (auto& s : shards_) {
-      std::lock_guard<std::mutex> lk(s.mu);
+      if (!ok) break;
       for (const auto& kv : s.index) {
-        std::fwrite(&kv.first, sizeof(int64_t), 1, f);
-        std::fwrite(s.pool.data() + kv.second, sizeof(float), row_width_, f);
+        if (std::fwrite(&kv.first, sizeof(int64_t), 1, f) != 1 ||
+            std::fwrite(s.pool.data() + kv.second, sizeof(float), row_width_,
+                        f) != static_cast<size_t>(row_width_)) {
+          ok = false;
+          break;
+        }
       }
     }
-    std::fclose(f);
-    return true;
+    ok = (std::fclose(f) == 0) && ok;
+    if (!ok) {
+      std::remove(tmp.c_str());
+      return false;
+    }
+    return std::rename(tmp.c_str(), path) == 0;
   }
 
   bool Load(const char* path) {
@@ -160,14 +178,14 @@ class SparseTable {
       std::fclose(f);
       return false;
     }
-    step_ = header[3];
-    // a checkpoint fully replaces table contents (rows auto-created by a
-    // warm-up pull before load must not survive and merge with it)
-    for (auto& s : shards_) {
-      std::lock_guard<std::mutex> lk(s.mu);
-      s.index.clear();
-      s.pool.clear();
-    }
+    // Stage the whole file first; the live table is only touched after the
+    // file parses completely, so a truncated/corrupt checkpoint leaves the
+    // existing contents intact.
+    struct Staged {
+      std::unordered_map<int64_t, uint64_t> index;
+      std::vector<float> pool;
+    };
+    std::vector<Staged> staged(kShards);
     std::vector<float> row(row_width_);
     for (int64_t i = 0; i < header[4]; ++i) {
       int64_t key;
@@ -177,14 +195,22 @@ class SparseTable {
         std::fclose(f);
         return false;
       }
-      Shard& s = shards_[ShardOf(key)];
-      std::lock_guard<std::mutex> lk(s.mu);
-      uint64_t off = AllocRow(s);
-      s.index[key] = off;
-      std::memcpy(s.pool.data() + off, row.data(),
+      Staged& st = staged[ShardOf(key)];
+      uint64_t off = st.pool.size();
+      st.pool.resize(off + row_width_);
+      st.index[key] = off;
+      std::memcpy(st.pool.data() + off, row.data(),
                   sizeof(float) * row_width_);
     }
     std::fclose(f);
+    // a checkpoint fully replaces table contents (rows auto-created by a
+    // warm-up pull before load must not survive and merge with it)
+    for (int s = 0; s < kShards; ++s) {
+      std::lock_guard<std::mutex> lk(shards_[s].mu);
+      shards_[s].index = std::move(staged[s].index);
+      shards_[s].pool = std::move(staged[s].pool);
+    }
+    step_ = header[3];
     return true;
   }
 

@@ -40,6 +40,13 @@ from . import distribution  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import monitor  # noqa: F401,E402
 from . import static  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from . import sysconfig  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
+from . import hub  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
+from .framework.flags import get_flags, set_flags  # noqa: F401,E402
 from .framework_io import load, save  # noqa: F401,E402
 from .autograd import grad, no_grad  # noqa: F401,E402
 from .nn.layer import Parameter  # noqa: F401,E402

@@ -216,6 +216,12 @@ class ParallelTrainer:
             key, lr, inputs, labels)
         self.state["params"] = new_params
         self.state["opt"] = new_opt
+        from ..framework import flags as _flags
+        if _flags.flag("check_nan_inf"):
+            _flags.check_numerics({"loss": loss}, "train_step:")
+            _flags.check_numerics(new_params, "params:")
+        if _flags.flag("benchmark"):
+            jax.block_until_ready(loss)
         return loss
 
     def sync_to_model(self):

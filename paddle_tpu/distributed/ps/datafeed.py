@@ -45,6 +45,11 @@ class InMemoryDataset:
         kinds = np.array([1 if s.dense else 0 for s in self._slots],
                          dtype=np.int32)
         kp = kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_int))
+        # per-file pieces accumulated in lists, concatenated once at the end
+        # (a rolling per-file concatenate would copy O(files^2) bytes)
+        piece_offs: List[List[np.ndarray]] = [[] for _ in self._slots]
+        piece_vals: List[List[np.ndarray]] = [[] for _ in self._slots]
+        bases = [s.values.size for s in self._slots]
         for path in filelist:
             h = l.ps_datafeed_parse(path.encode(), len(self._slots), kp,
                                     nthreads)
@@ -68,13 +73,24 @@ class InMemoryDataset:
                         l.ps_datafeed_slot_ids(
                             h, i, vals.ctypes.data_as(
                                 ctypes.POINTER(ctypes.c_int64)))
-                    base = s.values.size
-                    s.offsets = np.concatenate(
-                        [s.offsets, offs[1:] + base])
-                    s.values = np.concatenate([s.values, vals])
+                    piece_offs[i].append(offs[1:] + bases[i])
+                    piece_vals[i].append(vals)
+                    bases[i] += vals.size
             finally:
                 l.ps_datafeed_destroy(h)
-            self._n = self._slots[0].offsets.size - 1
+        for i, s in enumerate(self._slots):
+            s.offsets = np.concatenate([s.offsets] + piece_offs[i])
+            s.values = np.concatenate([s.values] + piece_vals[i])
+        self._n = self._slots[0].offsets.size - 1
+        for s in self._slots:
+            if s.dense and s.offsets.size > 1:
+                widths = np.diff(s.offsets)
+                if widths.min() != widths.max():
+                    bad = int(np.argmax(widths != widths[0]))
+                    raise ValueError(
+                        f"dense slot {s.name!r} has ragged widths: example "
+                        f"{bad} has {int(widths[bad])} floats, expected "
+                        f"{int(widths[0])} — check the input files")
         self._order = np.arange(self._n)
 
     def global_shuffle(self, seed: int = 0):

@@ -34,9 +34,17 @@ def _needs_build() -> bool:
 def build():
     os.makedirs(_LIB_DIR, exist_ok=True)
     srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+    # compile to a per-pid temp name, then atomically rename: concurrent
+    # processes (launcher ranks, pytest-xdist) may build simultaneously and
+    # must never dlopen a half-written .so
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           "-o", _LIB] + srcs
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
+           "-o", tmp] + srcs
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native PS build failed ({' '.join(cmd)}):\n{proc.stderr}")
+    os.replace(tmp, _LIB)
 
 
 def lib() -> ctypes.CDLL:

@@ -1,0 +1,103 @@
+"""Global runtime flags (reference: platform/flags.cc — 35 gflags; python
+surface paddle.set_flags/get_flags via pybind/global_value_getter_setter.cc).
+
+TPU-native translation: a single in-process registry, initialised from
+``FLAGS_*`` environment variables exactly like gflags' env fallback.  Flags
+that configured CUDA allocator/cudnn behaviour have no TPU meaning and are
+registered as accepted-but-inert (documented per flag) so reference code that
+sets them keeps working.  The debugging flags are live:
+
+- ``FLAGS_check_nan_inf`` (reference platform/flags.cc:44, consumed at
+  operator.cc:1183): here consumed by ``check_numerics`` which the optimizer
+  and trainer call on grads/loss when the flag is on (eager host check).
+- ``FLAGS_benchmark`` (operator.cc:1171): makes the trainer block on the
+  device after every step.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def _define(name: str, default, help_: str = ""):
+    env = os.environ.get("FLAGS_" + name)
+    if env is not None:
+        if isinstance(default, bool):
+            default = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            default = int(env)
+        elif isinstance(default, float):
+            default = float(env)
+        else:
+            default = env
+    _REGISTRY[name] = default
+
+
+# Debugging / numerics (live)
+_define("check_nan_inf", False, "scan outputs/grads for NaN/Inf each step")
+_define("benchmark", False, "synchronise device after each op/step")
+_define("check_kernel_launch", False, "alias of benchmark on TPU")
+# Threading / host (live where meaningful)
+_define("paddle_num_threads", 1, "host threads for data feed")
+# Memory flags (inert: XLA's BFC allocator manages HBM; kept for parity)
+_define("fraction_of_gpu_memory_to_use", 0.92, "inert on TPU")
+_define("initial_gpu_memory_in_mb", 0, "inert on TPU")
+_define("reallocate_gpu_memory_in_mb", 0, "inert on TPU")
+_define("memory_fraction_of_eager_deletion", 1.0, "inert: XLA liveness")
+_define("eager_delete_tensor_gb", 0.0, "inert: XLA liveness")
+_define("allocator_strategy", "auto_growth", "inert: XLA BFC")
+_define("use_pinned_memory", True, "host staging buffers")
+# cudnn/conv flags (inert; XLA picks conv algorithms)
+_define("cudnn_deterministic", False, "maps to XLA deterministic ops")
+_define("cudnn_exhaustive_search", False, "inert: XLA autotuning")
+_define("conv_workspace_size_limit", 512, "inert")
+# Distributed
+_define("sync_nccl_allreduce", True, "inert: XLA schedules collectives")
+
+
+def set_flags(flags: Dict[str, Any]):
+    """paddle.set_flags — accepts both 'FLAGS_x' and bare 'x' keys."""
+    for k, v in flags.items():
+        name = k[6:] if k.startswith("FLAGS_") else k
+        if name not in _REGISTRY:
+            raise ValueError(f"unknown flag {k!r}")
+        _REGISTRY[name] = v
+
+
+def get_flags(flags):
+    """paddle.get_flags — returns {'FLAGS_x': value}."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        name = k[6:] if k.startswith("FLAGS_") else k
+        if name not in _REGISTRY:
+            raise ValueError(f"unknown flag {k!r}")
+        out["FLAGS_" + name] = _REGISTRY[name]
+    return out
+
+
+def flag(name: str):
+    return _REGISTRY[name]
+
+
+def check_numerics(tree, tag: str = ""):
+    """Host-side NaN/Inf scan over a pytree when FLAGS_check_nan_inf is on.
+
+    Reference analogue: operator.cc:1183 → details/nan_inf_utils_detail.cu
+    (per-op output scan). Here the scan sits at step granularity: optimizer
+    grads and trainer loss. Raises FloatingPointError naming the first bad
+    leaf, like the reference's enforce failure.
+    """
+    if not _REGISTRY["check_nan_inf"]:
+        return
+    import jax
+    import numpy as np
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(leaf)
+        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+            name = jax.tree_util.keystr(path)
+            raise FloatingPointError(
+                f"FLAGS_check_nan_inf: non-finite value in {tag}{name}")

@@ -1,0 +1,48 @@
+"""paddle.hub-style model loading from a local hubconf.py (the reference
+tree routes hub entry through python/paddle/hapi + vision model zoo; the
+hub protocol is: a repo dir contains ``hubconf.py`` whose public callables
+are the entrypoints).
+
+Zero-egress: only the ``source='local'`` path is supported; github sources
+raise with a clear message.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source: str):
+    if source != "local":
+        raise NotImplementedError(
+            "paddle_tpu.hub supports source='local' only (no egress); "
+            "clone the repo and pass its path")
+
+
+def list(repo_dir: str, source: str = "local"):  # noqa: A001
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local"):  # noqa: A001
+    _check_source(source)
+    return getattr(_load_hubconf(repo_dir), model).__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "local", **kwargs):
+    _check_source(source)
+    return getattr(_load_hubconf(repo_dir), model)(**kwargs)

@@ -35,3 +35,5 @@ from .layers.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder,
     TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer)
 from .layers.vision import ChannelShuffle, PixelShuffle, PixelUnshuffle  # noqa: F401
+from ..optimizer.clip import (  # noqa: F401,E402  (reference: fluid/clip.py re-exported at paddle.nn)
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue)

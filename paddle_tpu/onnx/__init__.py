@@ -1,0 +1,32 @@
+"""paddle.onnx.export (reference: python/paddle/onnx/export.py — a thin
+delegate to the external paddle2onnx package).
+
+TPU translation: the portable interchange format for an XLA-native framework
+is StableHLO, not ONNX. ``export`` therefore produces the same artifact as
+``paddle_tpu.jit.save`` (StableHLO + params) at ``path + '.onnx'``-adjacent
+naming, and only attempts real ONNX if an ``onnx``+converter stack is
+importable (it is not baked into this image — gated, never required).
+"""
+from __future__ import annotations
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 9,
+           **configs):
+    """Export ``layer`` for interchange.
+
+    Mirrors paddle.onnx.export(layer, path, input_spec). Writes a StableHLO
+    program + weights via jit.save; returns the artifact prefix.
+    """
+    try:
+        import onnx  # noqa: F401  (not in this image; gated)
+        have_onnx = True
+    except ImportError:
+        have_onnx = False
+    from .. import jit
+    prefix = path[:-5] if path.endswith(".onnx") else path
+    jit.save(layer, prefix, input_spec=input_spec)
+    if have_onnx:
+        raise NotImplementedError(
+            "ONNX serialization of StableHLO is not wired; the StableHLO "
+            f"artifact at {prefix!r} is the supported interchange format.")
+    return prefix

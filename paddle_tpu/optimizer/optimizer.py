@@ -96,7 +96,11 @@ class Optimizer:
             g = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
             if wd and not decoupled:
-                g = g + wd * p32
+                reg = self.regularization
+                if callable(reg) and getattr(reg, "kind", "l2") != "l2":
+                    g = g + reg(p32, g)  # e.g. L1Decay: coeff*sign(p)
+                else:
+                    g = g + wd * p32
             p_lr = lr * (lr_scales.get(k, 1.0) if lr_scales else 1.0)
             np_, ns = self._update_with_key(k, p32, g, state["slots"][k],
                                             p_lr, step)

@@ -1,0 +1,55 @@
+"""Weight-decay regularizers (reference: python/paddle/regularizer.py
+L1Decay/L2Decay over fluid/regularizer.py:127,217).
+
+In the reference these append `scale*sign(p)` / `scale*p` ops to each
+parameter's gradient during `append_backward`.  Here they are plain config
+objects read by ``Optimizer.apply_gradients`` (optimizer/optimizer.py) inside
+the jitted update — XLA fuses the decay term into the optimizer kernel, so no
+separate "regularization op" exists.
+
+Per-parameter override parity: a regularizer set in ``ParamAttr`` takes
+priority over the optimizer-level one (reference fluid/regularizer.py docs).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class WeightDecayRegularizer:
+    kind = "l2"
+
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+        # legacy-name alias read by fluid-era code paths
+        self._regularization_coeff = float(coeff)
+
+    def __call__(self, param, grad):
+        """Return the decay term to add to ``grad`` (fp32)."""
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self.coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """L1 weight decay: grad += coeff * sign(param).
+
+    Reference: python/paddle/regularizer.py:20 (L1Decay), impl
+    fluid/regularizer.py L1DecayRegularizer (sign op append).
+    """
+    kind = "l1"
+
+    def __call__(self, param, grad):
+        return self.coeff * jnp.sign(param)
+
+
+class L2Decay(WeightDecayRegularizer):
+    """L2 weight decay: grad += coeff * param.
+
+    Reference: python/paddle/regularizer.py L2Decay, impl
+    fluid/regularizer.py L2DecayRegularizer (scale op append).
+    """
+    kind = "l2"
+
+    def __call__(self, param, grad):
+        return self.coeff * param

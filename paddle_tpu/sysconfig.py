@@ -1,0 +1,23 @@
+"""paddle.sysconfig (reference: python/paddle/sysconfig.py — get_include /
+get_lib for building custom C++ ops against the installed wheel).
+
+Here the native surface is ``csrc/`` (the C++ runtime tier); custom-op
+builds via paddle_tpu.utils.cpp_extension compile against these headers.
+"""
+from __future__ import annotations
+
+import os
+
+
+def _root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def get_include() -> str:
+    """Directory of C headers for custom-op extensions."""
+    return os.path.join(_root(), "csrc")
+
+
+def get_lib() -> str:
+    """Directory containing built native libraries (csrc/ build output)."""
+    return os.path.join(_root(), "csrc", "build")

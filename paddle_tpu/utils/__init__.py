@@ -1,0 +1,14 @@
+"""paddle.utils (reference: python/paddle/utils/ — download helpers,
+deprecated decorator, unique_name, install_check run_check, cpp_extension).
+"""
+from __future__ import annotations
+
+from ..framework.naming import unique_name  # noqa: F401
+from .deprecated import deprecated  # noqa: F401
+from .download import get_weights_path_from_url  # noqa: F401
+from .install_check import run_check  # noqa: F401
+
+try:  # guard: needs a host toolchain
+    from . import cpp_extension  # noqa: F401
+except Exception:  # pragma: no cover
+    cpp_extension = None

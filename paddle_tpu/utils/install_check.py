@@ -1,0 +1,63 @@
+"""Installation self-check (reference: python/paddle/utils/install_check.py
+run_check:162 — builds a tiny linear network, runs single-device fwd/bwd and
+a parallel run, prints a verdict).
+
+TPU translation: single-device = jit fwd/bwd on the default backend;
+"parallel" = pjit over all local devices with a data-sharded batch.
+"""
+from __future__ import annotations
+
+
+def _simple_network():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    class _Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 8)
+            self.out = nn.Linear(8, 1)
+
+        def forward(self, x):
+            return self.out(paddle.nn.functional.relu(self.fc(x)))
+
+    return _Net()
+
+
+def run_check():
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.engine import ParallelTrainer
+    from paddle_tpu.distributed.mesh import build_mesh
+
+    print(f"Running verify paddle_tpu ({paddle.__version__}) ...")
+    backend = jax.default_backend()
+    n = jax.local_device_count()
+    paddle.seed(0)
+
+    model = _simple_network()
+    opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+    trainer = ParallelTrainer(model, opt,
+                              lambda out, y: nn.functional.mse_loss(out, y))
+    x = np.random.RandomState(0).rand(max(2, n), 4).astype("float32")
+    y = np.zeros((max(2, n), 1), dtype="float32")
+    build_mesh({"data": 1})
+    loss = float(trainer.train_step(x, y))
+    print(f"paddle_tpu works on 1 {backend} device: loss={loss:.4f}")
+
+    if n > 1:
+        build_mesh({"data": n})
+        model2 = _simple_network()
+        opt2 = paddle.optimizer.SGD(0.1, parameters=model2.parameters())
+        t2 = ParallelTrainer(model2, opt2,
+                             lambda out, yy: nn.functional.mse_loss(out, yy))
+        xb = np.random.RandomState(1).rand(2 * n, 4).astype("float32")
+        yb = np.zeros((2 * n, 1), dtype="float32")
+        loss2 = float(t2.train_step(xb, yb))
+        print(f"paddle_tpu works on {n} {backend} devices (data-parallel): "
+              f"loss={loss2:.4f}")
+        build_mesh({"data": 1})
+    print("paddle_tpu is installed successfully!")

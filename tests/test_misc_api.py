@@ -1,0 +1,119 @@
+"""Tests for flags, regularizer, device, hub, utils, onnx export
+(reference parity: platform/flags.cc, python/paddle/regularizer.py,
+python/paddle/device.py, python/paddle/hub.py, python/paddle/utils/)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_flags_set_get_roundtrip():
+    paddle.set_flags({"FLAGS_benchmark": True})
+    assert paddle.get_flags("FLAGS_benchmark")["FLAGS_benchmark"] is True
+    paddle.set_flags({"benchmark": False})
+    assert paddle.get_flags(["benchmark"])["FLAGS_benchmark"] is False
+    with pytest.raises(ValueError):
+        paddle.set_flags({"FLAGS_no_such_flag": 1})
+
+
+def test_check_nan_inf_flag_catches_bad_grads():
+    from paddle_tpu.framework import flags
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(FloatingPointError):
+            flags.check_numerics({"g": np.array([1.0, np.nan])}, "t:")
+        flags.check_numerics({"g": np.array([1.0, 2.0])}, "t:")  # no raise
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_l2decay_matches_manual_sgd():
+    coeff = 0.1
+    lr = 0.5
+    w0 = np.array([2.0, -3.0], dtype=np.float32)
+    g = np.array([0.5, 0.5], dtype=np.float32)
+
+    p = paddle.nn.Parameter(w0.copy())
+    opt = paddle.optimizer.SGD(lr, parameters=[p],
+                               weight_decay=paddle.regularizer.L2Decay(coeff))
+    params = {"w": p.value}
+    state = opt.init_state(params)
+    new_params, _ = opt.apply_gradients(params, {"w": g}, state, lr=lr)
+    expect = w0 - lr * (g + coeff * w0)
+    np.testing.assert_allclose(np.asarray(new_params["w"]), expect, rtol=1e-6)
+
+
+def test_l1decay_adds_sign_term():
+    coeff = 0.1
+    lr = 1.0
+    w0 = np.array([2.0, -3.0], dtype=np.float32)
+    g = np.zeros(2, dtype=np.float32)
+    p = paddle.nn.Parameter(w0.copy())
+    opt = paddle.optimizer.SGD(lr, parameters=[p],
+                               weight_decay=paddle.regularizer.L1Decay(coeff))
+    params = {"w": p.value}
+    state = opt.init_state(params)
+    new_params, _ = opt.apply_gradients(params, {"w": g}, state, lr=lr)
+    expect = w0 - lr * coeff * np.sign(w0)
+    np.testing.assert_allclose(np.asarray(new_params["w"]), expect, rtol=1e-6)
+
+
+def test_device_namespace():
+    assert paddle.device.device_count() >= 1
+    cpu = paddle.device.CPUPlace()
+    assert cpu.get_device_id() == 0
+    assert cpu.jax_device.platform == "cpu"
+    assert isinstance(paddle.device.get_available_device(), list)
+    paddle.device.synchronize()
+    paddle.device.cuda.empty_cache()  # no-op shim
+
+
+def test_nn_clip_alias():
+    assert paddle.nn.ClipGradByGlobalNorm is \
+        paddle.optimizer.clip.ClipGradByGlobalNorm
+
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny(n=3):\n    'docstring here'\n    return list(range(n))\n")
+    assert paddle.hub.list(str(tmp_path)) == ["tiny"]
+    assert "docstring" in paddle.hub.help(str(tmp_path), "tiny")
+    assert paddle.hub.load(str(tmp_path), "tiny", n=2) == [0, 1]
+    with pytest.raises(NotImplementedError):
+        paddle.hub.load("user/repo", "tiny", source="github")
+
+
+def test_deprecated_decorator_warns():
+    @paddle.utils.deprecated(update_to="new_api", since="0.1")
+    def old_api():
+        return 42
+
+    with pytest.warns(DeprecationWarning):
+        assert old_api() == 42
+
+
+def test_onnx_export_produces_stablehlo(tmp_path):
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    m = M()
+    from paddle_tpu.static import InputSpec
+    prefix = paddle.onnx.export(
+        m, str(tmp_path / "m.onnx"),
+        input_spec=[InputSpec([2, 4], "float32")])
+    assert os.path.exists(prefix + ".stablehlo")
+    assert os.path.exists(prefix + ".pdiparams")
+
+
+def test_run_check_smoke(capsys):
+    paddle.utils.run_check()
+    out = capsys.readouterr().out
+    assert "installed successfully" in out
