@@ -1,2 +1,3 @@
 """paddle_tpu.text — NLP models & datasets (reference: python/paddle/text/)."""
 from . import models  # noqa: F401
+from . import datasets  # noqa: F401

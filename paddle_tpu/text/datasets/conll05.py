@@ -1,0 +1,148 @@
+"""CoNLL-2005 SRL dataset (reference:
+python/paddle/text/datasets/conll05.py — tarball with
+``test.wsj.words.gz``/``test.wsj.props.gz`` column files; samples are the
+classic SRL features: word ids, five predicate-context windows, predicate
+id, ±2 mark vector, BIO label ids).
+"""
+from __future__ import annotations
+
+import gzip
+import tarfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+from ...utils.download import DATA_HOME, get_path_from_url
+
+DATA_URL = "https://dataset.bj.bcebos.com/conll05st%2Fconll05st-tests.tar.gz"
+WORDDICT_URL = "https://dataset.bj.bcebos.com/conll05st%2FwordDict.txt"
+VERBDICT_URL = "https://dataset.bj.bcebos.com/conll05st%2FverbDict.txt"
+TRGDICT_URL = "https://dataset.bj.bcebos.com/conll05st%2FtargetDict.txt"
+EMB_URL = "https://dataset.bj.bcebos.com/conll05st%2Femb"
+UNK_IDX = 0
+
+_WORDS_MEMBER = "conll05st-release/test.wsj/words/test.wsj.words.gz"
+_PROPS_MEMBER = "conll05st-release/test.wsj/props/test.wsj.props.gz"
+
+
+def _parse_prop_column(col):
+    """Turn one predicate's bracketed prop column into a BIO sequence."""
+    seq, cur, inside = [], "O", False
+    for tok in col:
+        if tok == "*":
+            seq.append("I-" + cur if inside else "O")
+        elif tok == "*)":
+            seq.append("I-" + cur)
+            inside = False
+        elif "(" in tok:
+            cur = tok[1:tok.find("*")]
+            seq.append("B-" + cur)
+            inside = ")" not in tok
+        else:
+            raise RuntimeError(f"unexpected SRL label {tok!r}")
+    return seq
+
+
+class Conll05st(Dataset):
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 download=True):
+        def fetch(path, url, name):
+            if path is not None:
+                return path
+            assert download, f"{name} not set and download disabled"
+            return get_path_from_url(url, DATA_HOME + "/conll05st",
+                                     decompress=False)
+
+        self.data_file = fetch(data_file, DATA_URL, "data_file")
+        self.word_dict_file = fetch(word_dict_file, WORDDICT_URL,
+                                    "word_dict_file")
+        self.verb_dict_file = fetch(verb_dict_file, VERBDICT_URL,
+                                    "verb_dict_file")
+        self.target_dict_file = fetch(target_dict_file, TRGDICT_URL,
+                                      "target_dict_file")
+        self.emb_file = emb_file
+        self.word_dict = self._load_dict(self.word_dict_file)
+        self.predicate_dict = self._load_dict(self.verb_dict_file)
+        self.label_dict = self._load_label_dict(self.target_dict_file)
+        self._load_anno()
+
+    @staticmethod
+    def _load_dict(path):
+        with open(path) as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    @staticmethod
+    def _load_label_dict(path):
+        tags = set()
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith(("B-", "I-")):
+                    tags.add(line[2:])
+        d = {}
+        for tag in tags:  # insertion order; matches reference's set iteration
+            d["B-" + tag] = len(d)
+            d["I-" + tag] = len(d)
+        d["O"] = len(d)
+        return d
+
+    def _load_anno(self):
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(self.data_file) as tf, \
+                gzip.GzipFile(fileobj=tf.extractfile(_WORDS_MEMBER)) as wf, \
+                gzip.GzipFile(fileobj=tf.extractfile(_PROPS_MEMBER)) as pf:
+            sentence, prop_rows = [], []
+            for wline, pline in zip(wf, pf):
+                word = wline.decode().strip()
+                cols = pline.decode().strip().split()
+                if not cols:  # blank line = end of sentence
+                    if prop_rows:
+                        verbs = [c for c in
+                                 (row[0] for row in prop_rows) if c != "-"]
+                        n_pred = len(prop_rows[0]) - 1
+                        for i in range(n_pred):
+                            col = [row[i + 1] for row in prop_rows]
+                            self.sentences.append(sentence)
+                            self.predicates.append(verbs[i])
+                            self.labels.append(_parse_prop_column(col))
+                    sentence, prop_rows = [], []
+                else:
+                    sentence.append(word)
+                    prop_rows.append(cols)
+
+    def __getitem__(self, idx):
+        sentence = self.sentences[idx]
+        labels = self.labels[idx]
+        n = len(sentence)
+        v = labels.index("B-V")
+        mark = [0] * n
+        ctx = {}
+        for off, name, fill in ((-2, "n2", "bos"), (-1, "n1", "bos"),
+                                (0, "0", None), (1, "p1", "eos"),
+                                (2, "p2", "eos")):
+            j = v + off
+            if 0 <= j < n:
+                mark[j] = 1
+                ctx[name] = sentence[j]
+            else:
+                ctx[name] = fill
+        word_idx = [self.word_dict.get(w, UNK_IDX) for w in sentence]
+        out = [np.array(word_idx)]
+        for name in ("n2", "n1", "0", "p1", "p2"):
+            out.append(np.array(
+                [self.word_dict.get(ctx[name], UNK_IDX)] * n))
+        out.append(np.array(
+            [self.predicate_dict.get(self.predicates[idx])] * n))
+        out.append(np.array(mark))
+        out.append(np.array([self.label_dict.get(t) for t in labels]))
+        return tuple(out)
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def get_embedding(self):
+        return self.emb_file

@@ -1,0 +1,75 @@
+"""IMDB sentiment dataset (reference: python/paddle/text/datasets/imdb.py:33
+— aclImdb tarball, word-frequency dict with cutoff, pos label 0 / neg 1).
+"""
+from __future__ import annotations
+
+import collections
+import re
+import string
+import tarfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+from ...utils.download import DATA_HOME, get_path_from_url
+
+URL = "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz"
+MD5 = "7c2ac02c03563afcf9b574c7e56c153a"
+
+_PUNCT = str.maketrans("", "", string.punctuation)
+
+
+class Imdb(Dataset):
+    """Samples: (np.array(word_ids), np.array([label])) with label 0=pos,
+    1=neg (matches reference imdb.py:139)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        if data_file is None:
+            assert download, "data_file not set and download disabled"
+            data_file = get_path_from_url(URL, DATA_HOME + "/imdb",
+                                          decompress=False)
+        self.data_file = data_file
+        self.word_idx = self._build_word_dict(cutoff)
+        self._load(self.word_idx)
+
+    def _tokenize(self, pattern):
+        docs = []
+        with tarfile.open(self.data_file) as tf:
+            for member in tf:
+                if pattern.match(member.name):
+                    text = tf.extractfile(member).read().decode(
+                        "utf-8", "ignore")
+                    docs.append(
+                        text.rstrip("\n\r").translate(_PUNCT).lower().split())
+        return docs
+
+    def _build_word_dict(self, cutoff):
+        pattern = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+        freq = collections.Counter()
+        for doc in self._tokenize(pattern):
+            freq.update(doc)
+        freq.pop("<unk>", None)
+        kept = [(w, c) for w, c in freq.items() if c > cutoff]
+        kept.sort(key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load(self, word_idx):
+        unk = word_idx["<unk>"]
+        self.docs, self.labels = [], []
+        for label, sub in ((0, "pos"), (1, "neg")):
+            pattern = re.compile(
+                rf"aclImdb/{self.mode}/{sub}/.*\.txt$")
+            for doc in self._tokenize(pattern):
+                self.docs.append([word_idx.get(w, unk) for w in doc])
+                self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return np.array(self.docs[idx]), np.array([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)

@@ -1,0 +1,86 @@
+"""PTB language-model dataset (reference:
+python/paddle/text/datasets/imikolov.py:31 — simple-examples tarball,
+min-freq word dict, NGRAM windows or SEQ mode with <s>/<e> markers).
+"""
+from __future__ import annotations
+
+import collections
+import tarfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+from ...utils.download import DATA_HOME, get_path_from_url
+
+URL = "https://dataset.bj.bcebos.com/imikolov%2Fsimple-examples.tar.gz"
+MD5 = "30177ea32e27c525793142b6bf2c8e2d"
+
+
+class Imikolov(Dataset):
+    """data_type='NGRAM': samples are window_size-grams (tuple of arrays);
+    data_type='SEQ': samples are (src_seq, trg_seq) shifted by one."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        assert data_type.upper() in ("NGRAM", "SEQ"), data_type
+        assert mode.lower() in ("train", "test"), mode
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        self.mode = mode.lower()
+        self.min_word_freq = min_word_freq
+        if data_file is None:
+            assert download, "data_file not set and download disabled"
+            data_file = get_path_from_url(URL, DATA_HOME + "/imikolov",
+                                          decompress=False)
+        self.data_file = data_file
+        self.word_idx = self._build_dict()
+        self.data = self._load()
+
+    def _member(self, tf, suffix):
+        for m in tf:
+            if m.name.endswith(suffix):
+                return m
+        raise IOError(f"{suffix} not found in {self.data_file}")
+
+    def _lines(self, suffix):
+        with tarfile.open(self.data_file) as tf:
+            f = tf.extractfile(self._member(tf, suffix))
+            for line in f:
+                yield line.decode("utf-8", "ignore").strip().split()
+
+    def _build_dict(self):
+        freq = collections.Counter()
+        for words in self._lines("ptb.train.txt"):
+            freq.update(words)
+        freq.pop("<unk>", None)
+        kept = [(w, c) for w, c in freq.items() if c >= self.min_word_freq]
+        kept.sort(key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load(self):
+        suffix = f"ptb.{self.mode}.txt"
+        unk = self.word_idx["<unk>"]
+        data = []
+        for words in self._lines(suffix):
+            if self.data_type == "NGRAM":
+                assert self.window_size > 0, "window_size must be set >0"
+                ids = [self.word_idx.get(w, unk)
+                       for w in ["<s>"] * (self.window_size - 1) + words
+                       + ["<e>"]]
+                # markers outside the dict map to unk, matching reference
+                for i in range(self.window_size, len(ids) + 1):
+                    data.append(tuple(ids[i - self.window_size:i]))
+            else:
+                ids = [self.word_idx.get(w, unk) for w in words]
+                src = [self.word_idx.get("<s>", unk)] + ids
+                trg = ids + [self.word_idx.get("<e>", unk)]
+                data.append((src, trg))
+        return data
+
+    def __getitem__(self, idx):
+        return tuple(np.array(x) for x in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)

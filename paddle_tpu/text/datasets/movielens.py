@@ -1,0 +1,123 @@
+"""MovieLens-1M rating dataset (reference:
+python/paddle/text/datasets/movielens.py — ml-1m zip with movies.dat /
+users.dat / ratings.dat in the `a::b::c` format; random train/test split by
+test_ratio).
+"""
+from __future__ import annotations
+
+import re
+import zipfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+from ...utils.download import DATA_HOME, get_path_from_url
+
+URL = "https://dataset.bj.bcebos.com/movielens%2Fml-1m.zip"
+MD5 = "c4d9eecfca2ab87c1945afe126590906"
+
+_AGES = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    """reference movielens.py MovieInfo: index/categories/title + value()."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [
+            [self.index],
+            [categories_dict[c] for c in self.categories],
+            [movie_title_dict[w.lower()] for w in self.title.split()],
+        ]
+
+    def __repr__(self):
+        return (f"<MovieInfo id({self.index}), title({self.title}), "
+                f"categories({self.categories})>")
+
+
+class UserInfo:
+    """reference movielens.py UserInfo: index/gender/age-bucket/job."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.positive_gender = gender == "M"
+        self.age = _AGES.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.positive_gender else 1],
+                [self.age], [self.job_id]]
+
+    def __repr__(self):
+        return (f"<UserInfo id({self.index}), "
+                f"gender({'M' if self.positive_gender else 'F'}), "
+                f"age({_AGES[self.age]}), job({self.job_id})>")
+
+
+class Movielens(Dataset):
+    """Samples: usr.value() + mov.value(...) + [[rating]] flattened to a
+    tuple of np arrays (reference movielens.py __getitem__)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        if data_file is None:
+            assert download, "data_file not set and download disabled"
+            data_file = get_path_from_url(URL, DATA_HOME + "/movielens",
+                                          decompress=False)
+        self.data_file = data_file
+        self.test_ratio = test_ratio
+        np.random.seed(rand_seed)
+        self._load_meta()
+        self._load_ratings()
+
+    def _load_meta(self):
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        self.movie_info, self.user_info = {}, {}
+        title_words, categories = set(), set()
+        with zipfile.ZipFile(self.data_file) as z:
+            with z.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    mid, title, cats = line.decode("latin1").strip().split("::")
+                    cats = cats.split("|")
+                    categories.update(cats)
+                    m = pattern.match(title)
+                    title = m.group(1) if m else title
+                    title_words.update(w.lower() for w in title.split())
+                    self.movie_info[int(mid)] = MovieInfo(mid, cats, title)
+            with z.open("ml-1m/users.dat") as f:
+                for line in f:
+                    uid, gender, age, job, _ = \
+                        line.decode("latin1").strip().split("::")
+                    self.user_info[int(uid)] = UserInfo(uid, gender, age, job)
+        self.movie_title_dict = {w: i for i, w in enumerate(title_words)}
+        self.categories_dict = {c: i for i, c in enumerate(categories)}
+
+    def _load_ratings(self):
+        is_test = self.mode == "test"
+        self.data = []
+        with zipfile.ZipFile(self.data_file) as z:
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    if (np.random.random() < self.test_ratio) != is_test:
+                        continue
+                    uid, mid, rating, _ = \
+                        line.decode("latin1").strip().split("::")
+                    usr = self.user_info[int(uid)]
+                    mov = self.movie_info[int(mid)]
+                    self.data.append(
+                        usr.value()
+                        + mov.value(self.categories_dict,
+                                    self.movie_title_dict)
+                        + [[float(rating)]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(x) for x in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)

@@ -1,0 +1,43 @@
+"""UCI Housing regression dataset (reference:
+python/paddle/text/datasets/uci_housing.py:34 — 14 whitespace-separated
+columns, per-feature normalization over the full set, 80/20 train/test
+split).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...io.dataset import Dataset
+from ...utils.download import DATA_HOME, get_path_from_url
+
+URL = "https://archive.ics.uci.edu/ml/machine-learning-databases/housing/housing.data"
+MD5 = "d4accdce7a25600298819f8e28e8d593"
+FEATURE_NUM = 14
+TRAIN_RATIO = 0.8
+
+
+class UCIHousing(Dataset):
+    """Samples: (np.array(13 features, float32), np.array([price]))."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        if data_file is None:
+            assert download, "data_file not set and download disabled"
+            data_file = get_path_from_url(URL, DATA_HOME + "/uci_housing",
+                                          decompress=False)
+        data = np.loadtxt(data_file).reshape(-1, FEATURE_NUM)
+        # normalize features (not the target) by max/min/mean over all rows
+        maxs, mins, means = (data.max(0), data.min(0), data.mean(0))
+        for i in range(FEATURE_NUM - 1):
+            data[:, i] = (data[:, i] - means[i]) / (maxs[i] - mins[i])
+        split = int(data.shape[0] * TRAIN_RATIO)
+        self.data = (data[:split] if self.mode == "train"
+                     else data[split:]).astype("float32")
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)

@@ -1,0 +1,86 @@
+"""WMT14 en→fr translation dataset (reference:
+python/paddle/text/datasets/wmt14.py — preprocessed tarball with
+``src.dict``/``trg.dict`` files and ``{mode}/{mode}`` parallel files of
+tab-separated sentence pairs; sequences over 80 tokens dropped).
+"""
+from __future__ import annotations
+
+import tarfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+from ...utils.download import DATA_HOME, get_path_from_url
+
+URL_TRAIN = ("https://paddlemodels.bj.bcebos.com/wmt/wmt14.tgz")
+START, END, UNK = "<s>", "<e>", "<unk>"
+UNK_IDX = 2
+
+
+class WMT14(Dataset):
+    """Samples: (src_ids, trg_ids, trg_ids_next) np arrays."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=True):
+        assert mode.lower() in ("train", "test", "gen"), mode
+        self.mode = mode.lower()
+        if data_file is None:
+            assert download, "data_file not set and download disabled"
+            data_file = get_path_from_url(URL_TRAIN, DATA_HOME + "/wmt14",
+                                          decompress=False)
+        self.data_file = data_file
+        assert dict_size > 0, "dict_size must be positive"
+        self.dict_size = dict_size
+        self._load()
+
+    @staticmethod
+    def _read_dict(f, size):
+        d = {}
+        for i, line in enumerate(f):
+            if i >= size:
+                break
+            d[line.decode("utf-8", "ignore").strip()] = i
+        return d
+
+    def _load(self):
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            members = {m.name: m for m in tf}
+            src_dicts = [n for n in members if n.endswith("src.dict")]
+            trg_dicts = [n for n in members if n.endswith("trg.dict")]
+            assert len(src_dicts) == 1 and len(trg_dicts) == 1
+            self.src_dict = self._read_dict(
+                tf.extractfile(members[src_dicts[0]]), self.dict_size)
+            self.trg_dict = self._read_dict(
+                tf.extractfile(members[trg_dicts[0]]), self.dict_size)
+            want = f"{self.mode}/{self.mode}"
+            for name in members:
+                if not name.endswith(want):
+                    continue
+                for line in tf.extractfile(members[name]):
+                    parts = line.decode("utf-8", "ignore").strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src = [self.src_dict.get(w, UNK_IDX)
+                           for w in [START] + parts[0].split() + [END]]
+                    trg = [self.trg_dict.get(w, UNK_IDX)
+                           for w in parts[1].split()]
+                    if len(src) > 80 or len(trg) > 80:
+                        continue
+                    self.src_ids.append(src)
+                    self.trg_ids.append([self.trg_dict[START]] + trg)
+                    self.trg_ids_next.append(trg + [self.trg_dict[END]])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, reverse=False):
+        """Return (src_dict, trg_dict); reversed = id→word."""
+        if reverse:
+            return ({v: k for k, v in self.src_dict.items()},
+                    {v: k for k, v in self.trg_dict.items()})
+        return self.src_dict, self.trg_dict

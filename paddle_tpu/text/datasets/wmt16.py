@@ -1,0 +1,114 @@
+"""WMT16 en↔de translation dataset (reference:
+python/paddle/text/datasets/wmt16.py — tarball with ``wmt16/{train,val,test}``
+files of tab-separated en/de pairs; dictionaries built from the train split
+on first use and cached under DATA_HOME).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import tarfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+from ...utils.download import DATA_HOME, get_path_from_url
+
+DATA_URL = "https://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz"
+START_MARK, END_MARK, UNK_MARK = "<s>", "<e>", "<unk>"
+
+
+class WMT16(Dataset):
+    """Samples: (src_ids, trg_ids, trg_ids_next) np arrays; ``lang``
+    selects which side is the source."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        assert mode.lower() in ("train", "test", "val"), mode
+        self.mode = mode.lower()
+        if data_file is None:
+            assert download, "data_file not set and download disabled"
+            data_file = get_path_from_url(DATA_URL, DATA_HOME + "/wmt16",
+                                          decompress=False)
+        self.data_file = data_file
+        self.lang = lang
+        assert src_dict_size > 0 and trg_dict_size > 0, \
+            "dict sizes must be positive"
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        self.src_dict = self._load_dict(lang, src_dict_size)
+        self.trg_dict = self._load_dict("de" if lang == "en" else "en",
+                                        trg_dict_size)
+        self.data = self._load_data()
+
+    def _dict_path(self, lang, size):
+        root = os.path.join(DATA_HOME, "wmt16")
+        os.makedirs(root, exist_ok=True)
+        return os.path.join(root, f"{lang}_{size}.dict")
+
+    def _build_dict(self, path, size, lang):
+        col = 0 if lang == "en" else 1
+        freq = collections.Counter()
+        with tarfile.open(self.data_file) as tf:
+            for line in tf.extractfile("wmt16/train"):
+                parts = line.decode("utf-8", "ignore").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                freq.update(parts[col].split())
+        with open(path, "w") as f:
+            f.write(f"{START_MARK}\n{END_MARK}\n{UNK_MARK}\n")
+            for i, (w, _) in enumerate(
+                    sorted(freq.items(), key=lambda x: -x[1])):
+                if i + 3 == size:
+                    break
+                f.write(w + "\n")
+
+    def _load_dict(self, lang, size, reverse=False):
+        path = self._dict_path(lang, size)
+        ok = os.path.exists(path)
+        if ok:
+            with open(path) as f:
+                ok = len(f.readlines()) == size
+        if not ok:
+            self._build_dict(path, size, lang)
+        d = {}
+        with open(path) as f:
+            for i, line in enumerate(f):
+                if reverse:
+                    d[i] = line.strip()
+                else:
+                    d[line.strip()] = i
+        return d
+
+    def _load_data(self):
+        start_id = self.src_dict[START_MARK]
+        end_id = self.src_dict[END_MARK]
+        unk_id = self.src_dict[UNK_MARK]
+        src_col = 0 if self.lang == "en" else 1
+        trg_col = 1 - src_col
+        data = []
+        with tarfile.open(self.data_file) as tf:
+            for line in tf.extractfile(f"wmt16/{self.mode}"):
+                parts = line.decode("utf-8", "ignore").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src_words = parts[src_col].split()
+                trg_words = parts[trg_col].split()
+                if not src_words or not trg_words:
+                    continue
+                src = ([start_id]
+                       + [self.src_dict.get(w, unk_id) for w in src_words]
+                       + [end_id])
+                trg = [self.trg_dict.get(w, unk_id) for w in trg_words]
+                data.append((src, [start_id] + trg, trg + [end_id]))
+        return data
+
+    def __getitem__(self, idx):
+        return tuple(np.array(x) for x in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+    def get_dict(self, lang, reverse=False):
+        size = self.src_dict_size if lang == self.lang else self.trg_dict_size
+        return self._load_dict(lang, size, reverse)

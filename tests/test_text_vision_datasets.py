@@ -1,0 +1,254 @@
+"""text.datasets parsing (synthetic archives in the reference formats) and
+vision.ops numerics (reference: python/paddle/text/datasets/*,
+python/paddle/vision/ops.py)."""
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _add_bytes(tf, name, data: bytes):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+# ---------------------------------------------------------------------------
+# text datasets
+# ---------------------------------------------------------------------------
+def test_imdb_synthetic(tmp_path):
+    path = tmp_path / "aclImdb_v1.tar.gz"
+    with tarfile.open(path, "w:gz") as tf:
+        for i in range(3):
+            _add_bytes(tf, f"aclImdb/train/pos/{i}.txt",
+                       b"great movie really great fun")
+            _add_bytes(tf, f"aclImdb/train/neg/{i}.txt",
+                       b"bad movie really bad boring")
+    ds = paddle.text.datasets.Imdb(data_file=str(path), mode="train",
+                                   cutoff=1)
+    assert len(ds) == 6
+    doc, label = ds[0]
+    assert label[0] == 0 and doc.dtype.kind == "i"
+    labels = sorted(int(ds[i][1][0]) for i in range(6))
+    assert labels == [0, 0, 0, 1, 1, 1]
+    assert "<unk>" in ds.word_idx
+
+
+def test_uci_housing_synthetic(tmp_path):
+    rng = np.random.RandomState(0)
+    data = rng.rand(50, 14)
+    path = tmp_path / "housing.data"
+    np.savetxt(path, data)
+    tr = paddle.text.datasets.UCIHousing(data_file=str(path), mode="train")
+    te = paddle.text.datasets.UCIHousing(data_file=str(path), mode="test")
+    assert len(tr) == 40 and len(te) == 10
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert x.dtype == np.float32
+
+
+def test_imikolov_synthetic(tmp_path):
+    text = b"the cat sat on the mat\nthe dog sat on the log\n"
+    path = tmp_path / "simple-examples.tgz"
+    with tarfile.open(path, "w:gz") as tf:
+        _add_bytes(tf, "simple-examples/data/ptb.train.txt", text)
+        _add_bytes(tf, "simple-examples/data/ptb.test.txt", text)
+    ds = paddle.text.datasets.Imikolov(data_file=str(path), data_type="NGRAM",
+                                       window_size=3, mode="train",
+                                       min_word_freq=1)
+    assert len(ds) > 0
+    gram = ds[0]
+    assert len(gram) == 3
+    seq = paddle.text.datasets.Imikolov(data_file=str(path), data_type="SEQ",
+                                        mode="test", min_word_freq=1)
+    src, trg = seq[0]
+    assert src.shape == trg.shape
+
+
+def test_movielens_synthetic(tmp_path):
+    path = tmp_path / "ml-1m.zip"
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("ml-1m/movies.dat",
+                   "1::Toy Story (1995)::Animation|Comedy\n"
+                   "2::Jumanji (1995)::Adventure\n")
+        z.writestr("ml-1m/users.dat",
+                   "1::F::1::10::48067\n2::M::25::16::70072\n")
+        z.writestr("ml-1m/ratings.dat",
+                   "1::1::5::978300760\n2::2::3::978302109\n"
+                   "1::2::4::978301968\n")
+    ds = paddle.text.datasets.Movielens(data_file=str(path), mode="train",
+                                        test_ratio=0.0)
+    assert len(ds) == 3
+    sample = ds[0]
+    assert len(sample) == 8  # uid,gender,age,job + mid,cats,title + rating
+    assert sample[-1].shape == (1,)
+
+
+def _wmt14_archive(tmp_path):
+    path = tmp_path / "wmt14.tgz"
+    dict_lines = b"<s>\n<e>\n<unk>\nhello\nworld\nbonjour\nmonde\n"
+    corpus = b"hello world\tbonjour monde\nworld hello\tmonde bonjour\n"
+    with tarfile.open(path, "w:gz") as tf:
+        _add_bytes(tf, "wmt14/src.dict", dict_lines)
+        _add_bytes(tf, "wmt14/trg.dict", dict_lines)
+        _add_bytes(tf, "wmt14/train/train", corpus)
+        _add_bytes(tf, "wmt14/test/test", corpus)
+    return path
+
+
+def test_wmt14_synthetic(tmp_path):
+    ds = paddle.text.datasets.WMT14(data_file=str(_wmt14_archive(tmp_path)),
+                                    mode="train", dict_size=7)
+    assert len(ds) == 2
+    src, trg, trg_next = ds[0]
+    # src wrapped in <s>...<e>; trg starts with <s>; trg_next ends with <e>
+    assert src[0] == 0 and src[-1] == 1
+    assert trg[0] == 0 and trg_next[-1] == 1
+    np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+    src_d, trg_d = ds.get_dict()
+    assert src_d["hello"] == 3
+
+
+def test_wmt16_synthetic(tmp_path, monkeypatch):
+    import paddle_tpu.utils.download as dl
+    monkeypatch.setattr(dl, "DATA_HOME", str(tmp_path / "cache"))
+    import paddle_tpu.text.datasets.wmt16 as w16
+    monkeypatch.setattr(w16, "DATA_HOME", str(tmp_path / "cache"))
+    path = tmp_path / "wmt16.tar.gz"
+    corpus = b"hello world\thallo welt\nworld of words\twelt der worte\n"
+    with tarfile.open(path, "w:gz") as tf:
+        _add_bytes(tf, "wmt16/train", corpus)
+        _add_bytes(tf, "wmt16/val", corpus)
+        _add_bytes(tf, "wmt16/test", corpus)
+    ds = paddle.text.datasets.WMT16(data_file=str(path), mode="train",
+                                    src_dict_size=8, trg_dict_size=8,
+                                    lang="en")
+    assert len(ds) == 2
+    src, trg, trg_next = ds[0]
+    assert src[0] == 0 and src[-1] == 1
+    np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+
+
+def test_conll05_synthetic(tmp_path):
+    words = b"The\ncat\nsat\n\n"
+    props = b"-  (A0*  \n-  *)  \nsat  (V*)  \n\n"
+    path = tmp_path / "conll05st-tests.tar.gz"
+    with tarfile.open(path, "w:gz") as tf:
+        _add_bytes(tf, "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                   gzip.compress(words))
+        _add_bytes(tf, "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                   gzip.compress(props))
+    for name, content in (("wordDict.txt", "the\ncat\nsat\n"),
+                          ("verbDict.txt", "sat\n"),
+                          ("targetDict.txt", "B-A0\nI-A0\nB-V\nI-V\nO\n")):
+        (tmp_path / name).write_text(content)
+    ds = paddle.text.datasets.Conll05st(
+        data_file=str(path),
+        word_dict_file=str(tmp_path / "wordDict.txt"),
+        verb_dict_file=str(tmp_path / "verbDict.txt"),
+        target_dict_file=str(tmp_path / "targetDict.txt"))
+    assert len(ds) == 1
+    sample = ds[0]
+    assert len(sample) == 9
+    word_idx = sample[0]
+    assert word_idx.shape == (3,)
+    mark = sample[7]
+    assert mark.sum() >= 1  # predicate neighborhood marked
+    wd, pd, ld = ds.get_dict()
+    assert "O" in ld
+
+
+# ---------------------------------------------------------------------------
+# vision ops
+# ---------------------------------------------------------------------------
+def test_yolo_box_decode_matches_manual():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 1 * (5 + 2), 2, 2).astype("float32")
+    anchors = [16, 32]
+    boxes, scores = paddle.vision.ops.yolo_box(
+        x, np.array([[64, 64]]), anchors, 2, 0.0, 32, clip_bbox=False)
+    assert boxes.shape == (1, 4, 4) and scores.shape == (1, 4, 2)
+    # manual decode of cell (0,0)
+    p = x.reshape(1, 5 + 2, 2, 2)
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    bx = (sig(p[0, 0, 0, 0]) + 0) / 2
+    bw = np.exp(p[0, 2, 0, 0]) * 16 / 64.0
+    x1 = (bx - bw / 2) * 64
+    np.testing.assert_allclose(float(boxes[0, 0, 0]), x1, rtol=1e-5)
+
+
+def test_yolo_loss_trains_down():
+    import jax
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3 * (5 + 4), 4, 4).astype("float32") * 0.1
+    gt = np.array([[[0.5, 0.5, 0.4, 0.4], [0.0, 0.0, 0.0, 0.0]]] * 2,
+                  dtype="float32")
+    gl = np.zeros((2, 2), dtype="int32")
+
+    def f(xx):
+        return paddle.vision.ops.yolo_loss(
+            xx, gt, gl, [10, 13, 16, 30, 33, 23], [0, 1, 2], 4, 0.7,
+            32).sum()
+
+    l0 = float(f(x))
+    g = jax.grad(f)
+    xx = x
+    for _ in range(10):
+        xx = xx - 0.05 * np.asarray(g(xx))
+    assert float(f(xx)) < l0
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 8, 8).astype("float32")
+    w = rng.randn(6, 4, 3, 3).astype("float32")
+    off = np.zeros((2, 18, 8, 8), dtype="float32")
+    out = paddle.vision.ops.deform_conv2d(x, off, w, stride=1, padding=1)
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), ((1, 1), (1, 1)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_deform_conv2d_mask_scales_output():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 6, 6).astype("float32")
+    w = rng.randn(3, 2, 3, 3).astype("float32")
+    off = np.zeros((1, 18, 6, 6), dtype="float32")
+    full = paddle.vision.ops.deform_conv2d(x, off, w, padding=1,
+                                           mask=np.ones((1, 9, 6, 6), "float32"))
+    half = paddle.vision.ops.deform_conv2d(x, off, w, padding=1,
+                                           mask=np.full((1, 9, 6, 6), 0.5,
+                                                        "float32"))
+    np.testing.assert_allclose(np.asarray(half), np.asarray(full) * 0.5,
+                               atol=1e-5)
+
+
+def test_deform_conv2d_layer():
+    layer = paddle.vision.ops.DeformConv2D(4, 6, 3, padding=1)
+    x = np.random.RandomState(0).randn(1, 4, 5, 5).astype("float32")
+    off = np.zeros((1, 18, 5, 5), dtype="float32")
+    out = layer(x, off)
+    assert out.shape == (1, 6, 5, 5)
+
+
+def test_read_file_decode_jpeg(tmp_path):
+    from PIL import Image
+    img = Image.fromarray(
+        (np.random.RandomState(0).rand(16, 16, 3) * 255).astype("uint8"))
+    p = str(tmp_path / "img.jpg")
+    img.save(p)
+    raw = paddle.vision.ops.read_file(p)
+    assert raw.dtype == np.uint8
+    decoded = paddle.vision.ops.decode_jpeg(raw, mode="rgb")
+    assert decoded.shape == (3, 16, 16)
