@@ -1,0 +1,77 @@
+// Custom-op extension ABI for paddle_tpu
+// (capability parity with the reference's out-of-tree custom-op API:
+// paddle/fluid/extension/include/ext_op_meta_info.h PD_BUILD_OP and
+// framework/custom_operator.cc — re-designed for the JAX runtime: the C++
+// kernel runs on host through jax.pure_callback; gradients plug into
+// jax.custom_vjp. NOT a port: registration is a plain inline registry, no
+// OpMetaInfo/pybind machinery.)
+//
+// Convention (v1): float32 elementwise-style ops.
+//   forward:  void fwd(const float** ins, int n_ins, float* out, int64_t n)
+//             n = element count; out has the shape of ins[0].
+//   backward: void bwd(const float** ins, int n_ins, const float* grad_out,
+//                      float** grad_ins, int64_t n)   // may be nullptr
+//
+// A user .cc includes this header ONCE and registers ops:
+//   PD_EXT_REGISTER(relu6, &relu6_fwd, &relu6_bwd, 1);
+// Build + load from Python with paddle_tpu.utils.cpp_extension.load().
+
+#ifndef PADDLE_TPU_CSRC_PADDLE_EXT_H_
+#define PADDLE_TPU_CSRC_PADDLE_EXT_H_
+
+#include <cstdint>
+#include <vector>
+
+typedef void (*pd_ext_fwd_fn)(const float**, int, float*, int64_t);
+typedef void (*pd_ext_bwd_fn)(const float**, int, const float*, float**,
+                              int64_t);
+
+struct PdExtOp {
+  const char* name;
+  pd_ext_fwd_fn fwd;
+  pd_ext_bwd_fn bwd;  // nullptr -> op is non-differentiable
+  int n_inputs;
+};
+
+inline std::vector<PdExtOp>& pd_ext_registry() {
+  static std::vector<PdExtOp> r;
+  return r;
+}
+
+struct PdExtRegistrar {
+  PdExtRegistrar(const char* name, pd_ext_fwd_fn fwd, pd_ext_bwd_fn bwd,
+                 int n_inputs) {
+    pd_ext_registry().push_back(PdExtOp{name, fwd, bwd, n_inputs});
+  }
+};
+
+#define PD_EXT_REGISTER(opname, fwd, bwd, n_inputs) \
+  static PdExtRegistrar pd_ext_reg_##opname(#opname, fwd, bwd, n_inputs)
+
+// -- C ABI queried by ctypes (defined here; the user source is a single
+// translation unit, so plain external definitions are safe) ----------------
+extern "C" {
+
+int pd_ext_num_ops() { return static_cast<int>(pd_ext_registry().size()); }
+
+const char* pd_ext_op_name(int i) { return pd_ext_registry()[i].name; }
+
+int pd_ext_op_n_inputs(int i) { return pd_ext_registry()[i].n_inputs; }
+
+int pd_ext_op_has_grad(int i) {
+  return pd_ext_registry()[i].bwd != nullptr ? 1 : 0;
+}
+
+void pd_ext_op_forward(int i, const float** ins, int n_ins, float* out,
+                       int64_t n) {
+  pd_ext_registry()[i].fwd(ins, n_ins, out, n);
+}
+
+void pd_ext_op_backward(int i, const float** ins, int n_ins,
+                        const float* grad_out, float** grad_ins, int64_t n) {
+  pd_ext_registry()[i].bwd(ins, n_ins, grad_out, grad_ins, n);
+}
+
+}  // extern "C"
+
+#endif  // PADDLE_TPU_CSRC_PADDLE_EXT_H_

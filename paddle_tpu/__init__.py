@@ -45,6 +45,7 @@ from . import regularizer  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import sysconfig  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
+from . import inference  # noqa: F401,E402
 from . import hub  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from .framework.flags import get_flags, set_flags  # noqa: F401,E402

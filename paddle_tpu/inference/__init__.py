@@ -1,0 +1,171 @@
+"""paddle.inference — serving-style predictor API (reference:
+paddle/fluid/inference/api/ — AnalysisPredictor analysis_predictor.h:82,
+Run at analysis_predictor.cc:389, CreatePaddlePredictor :1197; python
+surface paddle/inference with Config / create_predictor / handles).
+
+TPU-native design: the reference's graph-analysis pipeline (ir passes, TRT
+subgraphs, NaiveExecutor) collapses into the StableHLO artifact written by
+``paddle_tpu.jit.save`` — XLA *is* the analysis+fusion pipeline, applied at
+load time when the exported program is recompiled for the serving device.
+The handle API (get_input_handle / copy_from_cpu / run / copy_to_cpu)
+matches the reference's zero-copy tensor handles.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Config:
+    """reference: paddle_analysis_config.h AnalysisConfig (python
+    paddle.inference.Config). Holds the model path + device choice; the
+    CUDA/TRT/MKLDNN toggles are accepted for source compat and mapped onto
+    the single XLA compilation path."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file is not None:
+            for suffix in (".stablehlo", ".pdmodel", ".json"):
+                if prog_file.endswith(suffix):
+                    prog_file = prog_file[:-len(suffix)]
+                    break
+        self._prefix = prog_file
+        self._device = None  # default backend
+        self._enable_profile = False
+        self._memory_pool_mb = 0
+
+    # -- model ---------------------------------------------------------------
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        self.__init__(prog_file, params_file)
+
+    def prog_file(self) -> str:
+        return (self._prefix or "") + ".stablehlo"
+
+    def params_file(self) -> str:
+        return (self._prefix or "") + ".pdiparams"
+
+    def model_dir(self) -> str:
+        return os.path.dirname(self._prefix or "")
+
+    # -- device --------------------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0):
+        """Source-compat: selects the accelerator backend (TPU here)."""
+        self._memory_pool_mb = memory_pool_init_size_mb
+        self._device = None  # default accelerator
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self) -> bool:
+        import jax
+        return (self._device is None
+                and jax.default_backend() != "cpu")
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    # accepted-but-inert reference toggles (XLA owns these optimizations)
+    def switch_ir_optim(self, flag: bool = True):
+        pass
+
+    def enable_memory_optim(self):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **kw):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        pass
+
+
+class Tensor:
+    """Input/output handle (reference: paddle_tensor.h ZeroCopyTensor —
+    copy_from_cpu/copy_to_cpu)."""
+
+    def __init__(self, name: str, owner: "Predictor", is_input: bool):
+        self._name = name
+        self._owner = owner
+        self._is_input = is_input
+
+    def name(self) -> str:
+        return self._name
+
+    def copy_from_cpu(self, data):
+        assert self._is_input, "cannot write an output handle"
+        self._owner._inputs[self._name] = np.ascontiguousarray(data)
+
+    def copy_to_cpu(self):
+        assert not self._is_input, "cannot read an input handle"
+        return np.asarray(self._owner._outputs[self._name])
+
+    def shape(self):
+        store = (self._owner._inputs if self._is_input
+                 else self._owner._outputs)
+        return list(np.shape(store[self._name]))
+
+
+class Predictor:
+    """reference: AnalysisPredictor. Loads the StableHLO artifact once;
+    ``run`` executes the compiled program on the serving device."""
+
+    def __init__(self, config: Config):
+        from .. import jit
+        self._config = config
+        self._layer = jit.load(config._prefix)
+        exported = self._layer._exported
+        # input names: positional args after (params, buffers)
+        n_in = len(exported.in_avals) if hasattr(exported, "in_avals") else 1
+        self._input_names = [f"x{i}" for i in range(self._n_user_inputs())]
+        self._output_names = ["out0"]
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: Dict[str, np.ndarray] = {}
+
+    def _n_user_inputs(self) -> int:
+        import jax
+        exported = self._layer._exported
+        tree = exported.in_tree
+        # in_tree is ((params, buffers, *args), kwargs)
+        args = tree.children()[0].children()
+        return max(len(args) - 2, 1)
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        assert name in self._input_names, name
+        return Tensor(name, self, True)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return Tensor(name, self, False)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Execute. Either pass a positional list (returns outputs list) or
+        pre-fill input handles and read output handles (reference style)."""
+        if inputs is None:
+            inputs = [self._inputs[n] for n in self._input_names]
+        out = self._layer(*inputs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._output_names = [f"out{i}" for i in range(len(outs))]
+        self._outputs = {n: o for n, o in zip(self._output_names, outs)}
+        return [np.asarray(o) for o in outs]
+
+    def clear_intermediate_tensor(self):
+        self._inputs.clear()
+        self._outputs.clear()
+
+
+def create_predictor(config: Config) -> Predictor:
+    """reference: CreatePaddlePredictor (analysis_predictor.cc:1197)."""
+    return Predictor(config)
+
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor"]

@@ -1,0 +1,15 @@
+"""Out-of-tree custom C++ ops (reference:
+python/paddle/utils/cpp_extension/ — CppExtension/CUDAExtension/setup/load
+JIT-building ops registered with PD_BUILD_OP in
+paddle/fluid/extension/, loaded by framework/custom_operator.cc).
+
+TPU-native design: the C++ kernel is a host function behind the C ABI in
+``csrc/paddle_ext.h``; ``load()`` compiles it with g++, binds via ctypes
+(no pybind11 in this image) and wraps each registered op as a JAX op —
+``jax.pure_callback`` for the forward, ``jax.custom_vjp`` when a backward
+is registered, so the op composes with grad/jit/vmap-on-batch like any
+other primitive. Device placement: the callback runs on host; XLA moves
+data HBM↔host around it (same topology as the reference's CPU custom op
+under a GPU program, via data transfer).
+"""
+from .extension_utils import CppExtension, load, setup  # noqa: F401

@@ -1,0 +1,200 @@
+"""Build + bind machinery for custom C++ ops (see package docstring).
+
+reference surface: cpp_extension/cpp_extension.py (CppExtension, setup),
+cpp_extension/extension_utils.py (load with build cache keyed on source
+mtime).
+"""
+from __future__ import annotations
+
+import ctypes
+import functools
+import os
+import subprocess
+import tempfile
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ... import sysconfig
+
+
+class CppExtension:
+    """Declarative build unit for ``setup`` (reference
+    cpp_extension.py CppExtension — a setuptools Extension factory)."""
+
+    def __init__(self, sources: Sequence[str], name: Optional[str] = None,
+                 extra_compile_args: Optional[List[str]] = None,
+                 include_dirs: Optional[List[str]] = None, **kwargs):
+        self.name = name
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args or [])
+        self.include_dirs = list(include_dirs or [])
+
+
+def CUDAExtension(*args, **kwargs):
+    raise NotImplementedError(
+        "CUDAExtension targets nvcc; on TPU write the hot path as a Pallas "
+        "kernel (paddle_tpu.ops.pallas) and host-side C++ as a CppExtension")
+
+
+def setup(name: str, ext_modules, **kwargs):
+    """Eager build of the extension(s) into the default cache (the wheel
+    packaging of the reference's setup() is out of scope; importers use
+    ``load`` which returns the bound module)."""
+    exts = ext_modules if isinstance(ext_modules, (list, tuple)) \
+        else [ext_modules]
+    mods = [load(e.name or name, e.sources,
+                 extra_cxx_flags=e.extra_compile_args,
+                 include_dirs=e.include_dirs) for e in exts]
+    return mods[0] if len(mods) == 1 else mods
+
+
+class _CustomOp:
+    """One registered op bound into JAX."""
+
+    def __init__(self, dll, index: int, name: str, n_inputs: int,
+                 has_grad: bool):
+        self._dll = dll
+        self._index = index
+        self.name = name
+        self.n_inputs = n_inputs
+        self.has_grad = has_grad
+        self._fn = self._build()
+
+    # host kernels ----------------------------------------------------------
+    def _host_forward(self, *arrays):
+        arrays = [np.ascontiguousarray(a, dtype=np.float32) for a in arrays]
+        out = np.empty_like(arrays[0])
+        n = out.size
+        ins = (ctypes.POINTER(ctypes.c_float) * len(arrays))(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for a in arrays])
+        self._dll.pd_ext_op_forward(
+            self._index, ins, len(arrays),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n)
+        return out
+
+    def _host_backward(self, arrays, gout):
+        arrays = [np.ascontiguousarray(a, dtype=np.float32) for a in arrays]
+        gout = np.ascontiguousarray(gout, dtype=np.float32)
+        gins = [np.zeros_like(a) for a in arrays]
+        n = gout.size
+        ins = (ctypes.POINTER(ctypes.c_float) * len(arrays))(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for a in arrays])
+        gptrs = (ctypes.POINTER(ctypes.c_float) * len(gins))(
+            *[g.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+              for g in gins])
+        self._dll.pd_ext_op_backward(
+            self._index, ins, len(arrays),
+            gout.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), gptrs, n)
+        return tuple(gins)
+
+    # jax wrapping ----------------------------------------------------------
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        def call_fwd(*args):
+            out_shape = jax.ShapeDtypeStruct(args[0].shape, jnp.float32)
+            return jax.pure_callback(self._host_forward, out_shape, *args,
+                                     vmap_method="sequential")
+
+        if not self.has_grad:
+            return call_fwd
+
+        @jax.custom_vjp
+        def op(*args):
+            return call_fwd(*args)
+
+        def fwd(*args):
+            return call_fwd(*args), args
+
+        def bwd(res, g):
+            shapes = tuple(
+                jax.ShapeDtypeStruct(a.shape, jnp.float32) for a in res)
+            grads = jax.pure_callback(
+                lambda *xs: self._host_backward(xs[:-1], xs[-1]),
+                shapes, *res, g, vmap_method="sequential")
+            return tuple(grads)
+
+        op.defvjp(fwd, bwd)
+        return op
+
+    def __call__(self, *args):
+        assert len(args) == self.n_inputs, \
+            f"{self.name} expects {self.n_inputs} inputs, got {len(args)}"
+        return self._fn(*args)
+
+
+class ExtensionModule:
+    """Namespace of ops loaded from one shared library."""
+
+    def __init__(self, dll, lib_path: str):
+        self._dll = dll
+        self._lib_path = lib_path
+        self._ops: Dict[str, _CustomOp] = {}
+        for i in range(dll.pd_ext_num_ops()):
+            name = dll.pd_ext_op_name(i).decode()
+            op = _CustomOp(dll, i, name, dll.pd_ext_op_n_inputs(i),
+                           bool(dll.pd_ext_op_has_grad(i)))
+            self._ops[name] = op
+            setattr(self, name, op)
+
+    def op_names(self) -> List[str]:
+        return sorted(self._ops)
+
+
+def _default_build_dir() -> str:
+    return os.path.join(tempfile.gettempdir(), "paddle_tpu_extensions")
+
+
+@functools.lru_cache(maxsize=None)
+def _load_cached(name, sources, cxx_flags, include_dirs, build_directory):
+    sources = list(sources)
+    build_dir = build_directory or _default_build_dir()
+    os.makedirs(build_dir, exist_ok=True)
+    lib_path = os.path.join(build_dir, f"lib{name}.so")
+    stale = (not os.path.exists(lib_path)
+             or any(os.path.getmtime(s) > os.path.getmtime(lib_path)
+                    for s in sources))
+    if stale:
+        tmp = f"{lib_path}.{os.getpid()}.tmp"
+        cmd = (["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                "-I", sysconfig.get_include()]
+               + [f"-I{d}" for d in include_dirs]
+               + list(cxx_flags) + ["-o", tmp] + sources)
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"custom-op build failed ({' '.join(cmd)}):\n{proc.stderr}")
+        os.replace(tmp, lib_path)
+    dll = ctypes.CDLL(lib_path)
+    c = ctypes
+    pp_f32 = c.POINTER(c.POINTER(c.c_float))
+    dll.pd_ext_num_ops.restype = c.c_int
+    dll.pd_ext_op_name.restype = c.c_char_p
+    dll.pd_ext_op_name.argtypes = [c.c_int]
+    dll.pd_ext_op_n_inputs.restype = c.c_int
+    dll.pd_ext_op_n_inputs.argtypes = [c.c_int]
+    dll.pd_ext_op_has_grad.restype = c.c_int
+    dll.pd_ext_op_has_grad.argtypes = [c.c_int]
+    dll.pd_ext_op_forward.argtypes = [c.c_int, pp_f32, c.c_int,
+                                      c.POINTER(c.c_float), c.c_int64]
+    dll.pd_ext_op_backward.argtypes = [c.c_int, pp_f32, c.c_int,
+                                       c.POINTER(c.c_float), pp_f32,
+                                       c.c_int64]
+    return ExtensionModule(dll, lib_path)
+
+
+def load(name: str, sources: Sequence[str],
+         extra_cxx_flags: Optional[Sequence[str]] = None,
+         include_dirs: Optional[Sequence[str]] = None,
+         build_directory: Optional[str] = None,
+         verbose: bool = False) -> ExtensionModule:
+    """JIT-build and bind a custom-op source (reference:
+    cpp_extension.load). Returns a module-like object with one callable
+    JAX op per PD_EXT_REGISTER in the source."""
+    return _load_cached(name, tuple(sources),
+                        tuple(extra_cxx_flags or ()),
+                        tuple(include_dirs or ()), build_directory)

@@ -1,0 +1,142 @@
+"""Inference predictor + custom-op cpp_extension (reference:
+paddle/fluid/inference/api/analysis_predictor.*, python/paddle/utils/
+cpp_extension/)."""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+@pytest.fixture(scope="module")
+def exported_model(tmp_path_factory):
+    from paddle_tpu.static import InputSpec
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 3)
+
+        def forward(self, x):
+            return nn.functional.softmax(self.fc(x))
+
+    paddle.seed(7)
+    m = M()
+    prefix = str(tmp_path_factory.mktemp("inf") / "model")
+    paddle.jit.save(m, prefix, input_spec=[InputSpec([2, 4], "float32")])
+    return m, prefix
+
+
+def test_predictor_run_positional(exported_model):
+    m, prefix = exported_model
+    cfg = paddle.inference.Config(prefix)
+    pred = paddle.inference.create_predictor(cfg)
+    x = np.random.RandomState(0).rand(2, 4).astype("float32")
+    outs = pred.run([x])
+    np.testing.assert_allclose(outs[0], np.asarray(m(x)), rtol=1e-5)
+
+
+def test_predictor_handle_api(exported_model):
+    m, prefix = exported_model
+    pred = paddle.inference.create_predictor(paddle.inference.Config(prefix))
+    names = pred.get_input_names()
+    assert names == ["x0"]
+    x = np.random.RandomState(1).rand(2, 4).astype("float32")
+    pred.get_input_handle("x0").copy_from_cpu(x)
+    pred.run()
+    out_name = pred.get_output_names()[0]
+    out = pred.get_output_handle(out_name).copy_to_cpu()
+    np.testing.assert_allclose(out, np.asarray(m(x)), rtol=1e-5)
+    pred.clear_intermediate_tensor()
+
+
+def test_config_accepts_reference_toggles(exported_model):
+    _, prefix = exported_model
+    cfg = paddle.inference.Config(prefix + ".stablehlo")
+    cfg.disable_gpu()
+    cfg.switch_ir_optim(True)
+    cfg.enable_memory_optim()
+    cfg.enable_mkldnn()
+    cfg.set_cpu_math_library_num_threads(4)
+    assert cfg.prog_file().endswith(".stablehlo")
+    assert not cfg.use_gpu()
+    pred = paddle.inference.create_predictor(cfg)
+    assert pred.get_input_names()
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ext")
+    src = d / "myops.cc"
+    src.write_text(textwrap.dedent("""
+        #include "paddle_ext.h"
+        #include <algorithm>
+        #include <cmath>
+
+        static void relu6_fwd(const float** ins, int, float* out, int64_t n) {
+          for (int64_t i = 0; i < n; ++i)
+            out[i] = std::min(std::max(ins[0][i], 0.0f), 6.0f);
+        }
+        static void relu6_bwd(const float** ins, int, const float* gout,
+                              float** gins, int64_t n) {
+          for (int64_t i = 0; i < n; ++i)
+            gins[0][i] = (ins[0][i] > 0.0f && ins[0][i] < 6.0f)
+                             ? gout[i] : 0.0f;
+        }
+        PD_EXT_REGISTER(relu6, &relu6_fwd, &relu6_bwd, 1);
+
+        static void scaled_add_fwd(const float** ins, int, float* out,
+                                   int64_t n) {
+          for (int64_t i = 0; i < n; ++i)
+            out[i] = ins[0][i] + 2.0f * ins[1][i];
+        }
+        PD_EXT_REGISTER(scaled_add, &scaled_add_fwd, nullptr, 2);
+    """))
+    from paddle_tpu.utils import cpp_extension
+    return cpp_extension.load("myops_test", [str(src)],
+                              build_directory=str(d / "build"))
+
+
+def test_custom_op_forward(ext):
+    x = np.array([-2.0, 1.0, 9.0], dtype=np.float32)
+    np.testing.assert_array_equal(np.asarray(ext.relu6(x)), [0.0, 1.0, 6.0])
+
+
+def test_custom_op_grad(ext):
+    import jax
+    x = np.array([-2.0, 1.0, 9.0], dtype=np.float32)
+    g = jax.grad(lambda v: ext.relu6(v).sum())(x)
+    np.testing.assert_array_equal(np.asarray(g), [0.0, 1.0, 0.0])
+
+
+def test_custom_op_under_jit(ext):
+    import jax
+    x = np.arange(8, dtype=np.float32) - 4
+    np.testing.assert_allclose(np.asarray(jax.jit(ext.relu6)(x)),
+                               np.clip(x, 0, 6))
+
+
+def test_custom_op_two_inputs_no_grad(ext):
+    x = np.ones(4, dtype=np.float32)
+    y = np.full(4, 3.0, dtype=np.float32)
+    np.testing.assert_array_equal(np.asarray(ext.scaled_add(x, y)),
+                                  np.full(4, 7.0, np.float32))
+    assert "scaled_add" in ext.op_names()
+    assert not ext._ops["scaled_add"].has_grad
+
+
+def test_setup_builds_extension(tmp_path):
+    src = tmp_path / "one.cc"
+    src.write_text(
+        '#include "paddle_ext.h"\n'
+        "static void neg_fwd(const float** ins, int, float* out, int64_t n)"
+        " { for (int64_t i = 0; i < n; ++i) out[i] = -ins[0][i]; }\n"
+        "PD_EXT_REGISTER(neg, &neg_fwd, nullptr, 1);\n")
+    from paddle_tpu.utils import cpp_extension
+    mod = cpp_extension.setup(
+        "one_test", cpp_extension.CppExtension([str(src)], name="one_test"))
+    x = np.array([1.0, -2.0], dtype=np.float32)
+    np.testing.assert_array_equal(np.asarray(mod.neg(x)), [-1.0, 2.0])
