@@ -13,3 +13,4 @@ from . import fleet  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import ps  # noqa: F401
+from . import sharding  # noqa: F401,E402

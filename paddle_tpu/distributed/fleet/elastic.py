@@ -1,0 +1,152 @@
+"""Elastic training manager (reference: fleet/elastic.py:99 ElasticManager —
+etcd-backed membership: register ranks :142, watch host/np changes
+:171-204, match expected vs live hosts :252, relaunch on change with
+ElasticStatus HOLD/RESTART/EXIT :29; signal deregistration :343).
+
+TPU-native translation: no etcd in the stack — membership lives in a
+shared-filesystem KV directory (one file per rank with a heartbeat mtime),
+which on Cloud TPU pods is the job's shared staging volume; the
+jax.distributed coordinator performs the actual barrier/rendezvous, this
+manager only decides HOLD/RESTART/EXIT like the reference. Combined with
+deterministic sharded checkpoints (distributed/checkpoint.py) a RESTART
+resumes from the last step.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import List, Optional
+
+
+class ElasticStatus:
+    """reference fleet/elastic.py:29."""
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """File-KV membership manager.
+
+    Args mirror the reference: ``elastic_server`` is the KV root directory
+    (in place of an etcd url), ``job_id`` namespaces the job, ``np`` is the
+    expected world size (or "min:max" range), ``host`` identifies this
+    member, ``timeout`` the heartbeat staleness bound.
+    """
+
+    def __init__(self, elastic_server: Optional[str] = None,
+                 job_id: Optional[str] = None, np: Optional[int] = None,
+                 host: Optional[str] = None, timeout: float = 30.0):
+        self.server = elastic_server or os.environ.get(
+            "PADDLE_ELASTIC_SERVER")
+        self.job_id = job_id or os.environ.get("PADDLE_ELASTIC_JOB_ID", "job")
+        np_env = np if np is not None else os.environ.get(
+            "PADDLE_ELASTIC_NP", "0")
+        self.np_min, self.np_max = self._parse_np(str(np_env))
+        self.host = host or os.environ.get(
+            "POD_IP", f"rank-{os.environ.get('PADDLE_TRAINER_ID', '0')}")
+        self.timeout = timeout
+        self.enable = bool(self.server) and self.np_min > 0
+        self._registered = False
+        if self.enable:
+            os.makedirs(self._dir(), exist_ok=True)
+            signal.signal(signal.SIGTERM, self.signal_handler)
+            signal.signal(signal.SIGINT, self.signal_handler)
+
+    @staticmethod
+    def _parse_np(np_str: str):
+        if ":" in np_str:
+            lo, hi = np_str.split(":")
+            return int(lo), int(hi)
+        n = int(np_str)
+        return n, n
+
+    def _dir(self) -> str:
+        return os.path.join(self.server, self.job_id)
+
+    def _member_file(self, host: Optional[str] = None) -> str:
+        return os.path.join(self._dir(), (host or self.host) + ".alive")
+
+    # -- membership ----------------------------------------------------------
+    def register(self):
+        """reference :142 — announce this member; refresh = heartbeat."""
+        if not self.enable:
+            return
+        with open(self._member_file(), "w") as f:
+            f.write(str(os.getpid()))
+        self._registered = True
+
+    def heartbeat(self):
+        if self._registered:
+            os.utime(self._member_file())
+
+    def deregister(self):
+        if self._registered:
+            try:
+                os.remove(self._member_file())
+            except FileNotFoundError:
+                pass
+            self._registered = False
+
+    def hosts(self) -> List[str]:
+        """Live members (heartbeat within timeout)."""
+        if not self.enable:
+            return []
+        now = time.time()
+        out = []
+        for fn in os.listdir(self._dir()):
+            if not fn.endswith(".alive"):
+                continue
+            full = os.path.join(self._dir(), fn)
+            try:
+                if now - os.path.getmtime(full) <= self.timeout:
+                    out.append(fn[:-len(".alive")])
+            except FileNotFoundError:
+                pass
+        return sorted(out)
+
+    # -- decisions -----------------------------------------------------------
+    def _match(self) -> bool:
+        """reference :252 — live membership matches the expected np."""
+        n = len(self.hosts())
+        return self.np_min <= n <= self.np_max
+
+    def wait(self, interval: float = 1.0, max_wait: float = 60.0) -> bool:
+        """reference :286 — block until membership matches (or timeout)."""
+        if not self.enable:
+            return True
+        deadline = time.time() + max_wait
+        while time.time() < deadline:
+            self.heartbeat()
+            if self._match():
+                return True
+            time.sleep(interval)
+        return self._match()
+
+    def watch(self, proc_alive=lambda: True) -> str:
+        """reference :316 — one observation step → ElasticStatus."""
+        if not self.enable:
+            return ElasticStatus.COMPLETED if not proc_alive() \
+                else ElasticStatus.HOLD
+        self.heartbeat()
+        if not proc_alive():
+            return ElasticStatus.COMPLETED
+        n = len(self.hosts())
+        if n < self.np_min:
+            return ElasticStatus.EXIT if n == 0 else ElasticStatus.RESTART
+        if n > self.np_max:
+            return ElasticStatus.RESTART
+        return ElasticStatus.HOLD
+
+    def exit(self, completed: bool = False):
+        """reference :220."""
+        self.deregister()
+        return ElasticStatus.COMPLETED if completed else ElasticStatus.EXIT
+
+    def signal_handler(self, sigint, frame):
+        """reference :343 — deregister before dying."""
+        self.deregister()
+        raise SystemExit(128 + sigint)

@@ -1,0 +1,3 @@
+"""paddle.incubate.checkpoint (reference:
+python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py)."""
+from . import auto_checkpoint  # noqa: F401
