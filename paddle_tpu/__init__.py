@@ -68,8 +68,11 @@ def numpy(x):
 
 
 def in_dynamic_mode() -> bool:
-    """Eager-by-default: True outside jit tracing (the reference's
-    dygraph/static switch collapses; reference fluid/framework.py:185)."""
+    """Eager-by-default: True outside jit tracing and outside the
+    enable_static() compat mode (the reference's dygraph/static switch
+    collapses; reference fluid/framework.py:185)."""
+    if _static_mode:
+        return False
     import jax.core as _core
     try:
         return not isinstance(_jax.numpy.zeros(()), _core.Tracer)
@@ -77,12 +80,76 @@ def in_dynamic_mode() -> bool:
         return True
 
 
-def disable_static():
-    pass
+_static_mode = False
+
+
+def disable_static(place=None):
+    global _static_mode
+    _static_mode = False
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu has no global static-graph mode switch: jax.jit staging "
-        "replaces it. Use paddle_tpu.jit.to_static(layer_or_fn) or the "
-        "paddle_tpu.static namespace (Program.trace / Executor).")
+    """Source-compat switch (reference paddle.enable_static). There is no
+    global graph mode here — jax.jit staging replaces it — so this only flips
+    the flag read by ``in_dynamic_mode`` and routes users to the
+    ``paddle_tpu.static`` facade (Program.trace / Executor)."""
+    global _static_mode
+    _static_mode = True
+
+
+def in_dygraph_mode() -> bool:
+    return not _static_mode
+
+
+enable_dygraph = disable_static
+disable_dygraph = enable_static
+
+
+# -- source-compat aliases (reference python/paddle/__init__.py) -------------
+VarBase = Tensor                      # fluid core.VarBase → jax.Array
+dtype = _jax.numpy.dtype              # paddle.dtype (VarType enum → np dtype)
+bool = bool_                          # noqa: A001  (dtype alias, like paddle)
+from .device import (  # noqa: F401,E402
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, NPUPlace, TPUPlace, XPUPlace)
+from .distributed.parallel import DataParallel  # noqa: F401,E402
+from .batch import batch  # noqa: F401,E402
+from .autograd import set_grad_enabled  # noqa: F401,E402
+
+
+def get_cudnn_version():
+    """No cuDNN on TPU (reference device.py:62); None = not available."""
+    return None
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def get_cuda_rng_state():
+    """Map the reference's CUDA generator state onto the global JAX PRNG key
+    (framework/random.py); returned value round-trips via set_cuda_rng_state."""
+    from .framework import random as _rnd
+    return [_rnd._state.key]
+
+
+def set_cuda_rng_state(state_list):
+    from .framework import random as _rnd
+    _rnd._state.key = state_list[0]
+
+
+def monkey_patch_math_varbase():
+    """No-op: jax.Array already carries operator overloads (the reference
+    patches VarBase with math dunders at import; ours need no patching)."""
+
+
+def monkey_patch_variable():
+    """No-op: see monkey_patch_math_varbase."""
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Standalone parameter factory (reference framework create_parameter)."""
+    from .nn.initializer import Constant, XavierNormal
+    from .nn.layer import Parameter
+    init = default_initializer or (Constant(0.0) if is_bias else XavierNormal())
+    return Parameter(init(shape, dtype), trainable=True, name=name)

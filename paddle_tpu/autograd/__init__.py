@@ -156,3 +156,13 @@ class PyLayer:
     @staticmethod
     def backward(ctx, *grads):
         raise NotImplementedError
+
+
+def set_grad_enabled(mode: bool):
+    """Context manager parity (reference framework set_grad_enabled). Under
+    functional autodiff gradients exist only where jax.grad traces, so this
+    returns the ``no_grad`` context when disabling and a null context
+    otherwise."""
+    if mode:
+        return contextlib.nullcontext()
+    return no_grad()

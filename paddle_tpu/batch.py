@@ -1,0 +1,26 @@
+"""paddle.batch — reader-decorator that groups sample readers into
+mini-batches (reference: python/paddle/batch.py).
+
+Kept for source compat with reference-era training scripts; new code should
+use io.DataLoader (which also does host→device prefetch).
+"""
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

@@ -103,3 +103,11 @@ class cuda:  # namespace shim: paddle.device.cuda.*
     @staticmethod
     def empty_cache():
         pass
+
+
+class XPUPlace(TPUPlace):
+    """Accepted for source compat; maps to the default accelerator."""
+
+
+class NPUPlace(TPUPlace):
+    """Accepted for source compat; maps to the default accelerator."""

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import os
 import signal
+import threading
 import time
 from typing import List, Optional
 
@@ -53,8 +54,15 @@ class ElasticManager:
         self._registered = False
         if self.enable:
             os.makedirs(self._dir(), exist_ok=True)
-            signal.signal(signal.SIGTERM, self.signal_handler)
-            signal.signal(signal.SIGINT, self.signal_handler)
+            # Chain (don't clobber) existing handlers; signal.signal only
+            # works on the main thread — skip elsewhere.
+            if threading.current_thread() is threading.main_thread():
+                self._prev_handlers = {
+                    signal.SIGTERM: signal.signal(signal.SIGTERM,
+                                                  self.signal_handler),
+                    signal.SIGINT: signal.signal(signal.SIGINT,
+                                                 self.signal_handler),
+                }
 
     @staticmethod
     def _parse_np(np_str: str):
@@ -81,7 +89,13 @@ class ElasticManager:
 
     def heartbeat(self):
         if self._registered:
-            os.utime(self._member_file())
+            try:
+                os.utime(self._member_file())
+            except FileNotFoundError:
+                # KV dir was wiped (elastic relaunch / operator cleanup):
+                # re-register instead of crashing the training loop.
+                os.makedirs(self._dir(), exist_ok=True)
+                self.register()
 
     def deregister(self):
         if self._registered:

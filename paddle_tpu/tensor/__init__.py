@@ -1,5 +1,8 @@
 """paddle_tpu.tensor — tensor op namespace (reference: python/paddle/tensor/)."""
-from . import creation, linalg, logic, manipulation, math, random, stat  # noqa: F401
+from . import (array, creation, inplace, linalg, logic, manipulation, math,  # noqa: F401
+               random, stat)
+from .array import array_length, array_read, array_write, create_array  # noqa: F401
+from .inplace import *  # noqa: F401,F403
 from .creation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403

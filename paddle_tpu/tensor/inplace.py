@@ -1,0 +1,101 @@
+"""Trailing-underscore ("inplace") op variants.
+
+Reference: python/paddle/tensor/math.py / manipulation.py register the
+``add_`` / ``reshape_`` / ``squeeze_`` ... inplace APIs (dygraph-only in the
+reference, mutating the VarBase buffer).
+
+TPU translation: jax.Arrays are immutable — under jit, XLA's buffer donation
+and liveness analysis already reuse dead buffers, which is what the
+reference's inplace ops exist to achieve. These variants therefore RETURN the
+result (callers must rebind), keeping source compatibility for code written
+against the reference's API while letting XLA own memory reuse.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import manipulation as _manip
+from . import math as _math
+
+__all__ = [
+    "add_", "subtract_", "ceil_", "clip_", "exp_", "flatten_", "floor_",
+    "reciprocal_", "reshape_", "round_", "rsqrt_", "scale_", "scatter_",
+    "sqrt_", "squeeze_", "tanh_", "unsqueeze_", "zero_", "fill_",
+]
+
+
+def add_(x, y, name=None):
+    return _math.add(x, y)
+
+
+def subtract_(x, y, name=None):
+    return _math.subtract(x, y)
+
+
+def ceil_(x, name=None):
+    return jnp.ceil(x)
+
+
+def clip_(x, min=None, max=None, name=None):
+    return _math.clip(x, min=min, max=max)
+
+
+def exp_(x, name=None):
+    return jnp.exp(x)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return _manip.flatten(x, start_axis, stop_axis)
+
+
+def floor_(x, name=None):
+    return jnp.floor(x)
+
+
+def reciprocal_(x, name=None):
+    return jnp.reciprocal(x)
+
+
+def reshape_(x, shape, name=None):
+    return _manip.reshape(x, shape)
+
+
+def round_(x, name=None):
+    return jnp.round(x)
+
+
+def rsqrt_(x, name=None):
+    return _math.rsqrt(x)
+
+
+def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    return _math.scale(x, scale=scale, bias=bias,
+                       bias_after_scale=bias_after_scale, act=act)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return _manip.scatter(x, index, updates, overwrite=overwrite)
+
+
+def sqrt_(x, name=None):
+    return jnp.sqrt(x)
+
+
+def squeeze_(x, axis=None, name=None):
+    return _manip.squeeze(x, axis)
+
+
+def tanh_(x, name=None):
+    return jnp.tanh(x)
+
+
+def unsqueeze_(x, axis, name=None):
+    return _manip.unsqueeze(x, axis)
+
+
+def zero_(x, name=None):
+    return jnp.zeros_like(x)
+
+
+def fill_(x, value, name=None):
+    return jnp.full_like(x, value)

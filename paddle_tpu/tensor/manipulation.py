@@ -321,3 +321,44 @@ def view(x, shape):
 
 def tensordot(x, y, axes=2, name=None):
     return jnp.tensordot(x, y, axes=axes)
+
+
+def rank(input):
+    """Number of dimensions (reference operators/rank_op — tensor attribute)."""
+    return jnp.asarray(jnp.ndim(input), dtype=jnp.int32)
+
+
+def reverse(x, axis, name=None):
+    return flip(x, axis)
+
+
+crop_tensor = crop
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Recompute a global index into a shard-local one (reference
+    operators/shard_index_op.cc — used by TP-sharded embedding lookup)."""
+    if shard_id < 0 or shard_id >= nshards:
+        raise ValueError(
+            f"shard_id {shard_id} out of range [0, {nshards})")
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (input // shard_size) == shard_id
+    return jnp.where(in_shard, input % shard_size, ignore_value)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Mirror reference paddle.set_printoptions onto numpy's print state
+    (jax.Array __repr__ routes through numpy)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not bool(sci_mode)
+    np.set_printoptions(**kw)

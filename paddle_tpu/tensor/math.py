@@ -406,3 +406,19 @@ def gcd(x, y, name=None):
 
 def lcm(x, y, name=None):
     return jnp.lcm(x, y)
+
+
+def add_n(inputs, name=None):
+    """Sum a list of tensors (reference operators/sum_op.cc; tensor/math.py
+    add_n). SelectedRows (row-sparse) summation dissolves — grads are dense
+    jax.Arrays."""
+    if not isinstance(inputs, (list, tuple)):
+        return inputs
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = jnp.add(out, t)
+    return out
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)

@@ -79,3 +79,16 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
 
 def exponential_(x, lam=1.0, name=None):
     return jax.random.exponential(get_rng_key(), x.shape).astype(x.dtype) / lam
+
+
+def check_shape(shape):
+    """Validate a shape argument for random ops (reference
+    tensor/random.py check_shape): entries must be positive ints (or a
+    0-D/1-D integer Tensor eagerly)."""
+    import numpy as _np
+    if hasattr(shape, "shape"):
+        shape = [int(s) for s in _np.asarray(shape).reshape(-1)]
+    for s in shape:
+        if int(s) <= 0:
+            raise ValueError(f"shape entries must be positive, got {list(shape)}")
+    return [int(s) for s in shape]

@@ -1,0 +1,111 @@
+"""Tests for API-parity additions: inplace-variant ops, TensorArray ops,
+misc tensor fns (add_n/diagonal/rank/shard_index), top-level compat aliases
+(Places, rng state, batch reader decorator).
+
+Reference surfaces covered: python/paddle/__init__.py top-level exports,
+python/paddle/tensor/{math,manipulation,array}.py, python/paddle/batch.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_inplace_variants_return_results():
+    x = paddle.to_tensor(np.array([1.0, 4.0, 9.0], dtype="float32"))
+    np.testing.assert_allclose(np.asarray(paddle.sqrt_(x)), [1, 2, 3])
+    np.testing.assert_allclose(np.asarray(paddle.rsqrt_(x)), [1, 0.5, 1 / 3],
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(paddle.add_(x, x)), [2, 8, 18])
+    np.testing.assert_allclose(np.asarray(paddle.clip_(x, 2.0, 5.0)), [2, 4, 5])
+    np.testing.assert_allclose(np.asarray(paddle.scale_(x, 2.0, 1.0)),
+                               [3, 9, 19])
+    assert paddle.reshape_(x, [3, 1]).shape == (3, 1)
+    assert paddle.unsqueeze_(x, 0).shape == (1, 3)
+    assert paddle.squeeze_(paddle.unsqueeze_(x, 0)).shape == (3,)
+    assert paddle.flatten_(paddle.ones([2, 3])).shape == (6,)
+    np.testing.assert_allclose(np.asarray(paddle.tensor.zero_(x)), [0, 0, 0])
+
+
+def test_tensor_array_ops():
+    arr = paddle.create_array()
+    x = paddle.ones([2])
+    paddle.tensor.array_write(x, 3, arr)
+    assert paddle.tensor.array_length(arr) == 4
+    got = paddle.tensor.array_read(arr, 3)
+    np.testing.assert_allclose(np.asarray(got), [1, 1])
+
+
+def test_add_n_diagonal_rank_reverse():
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+    s = paddle.add_n([x, x, x])
+    np.testing.assert_allclose(np.asarray(s), 3 * np.arange(6).reshape(2, 3))
+    np.testing.assert_allclose(np.asarray(paddle.diagonal(x)), [0, 4])
+    assert int(paddle.rank(x)) == 2
+    np.testing.assert_allclose(np.asarray(paddle.tensor.reverse(x, [1]))[0],
+                               [2, 1, 0])
+
+
+def test_shard_index():
+    idx = paddle.to_tensor(np.array([1, 5, 9], dtype="int64"))
+    # 10 indices over 2 shards -> shard_size 5; shard 0 owns [0,5)
+    out = np.asarray(paddle.shard_index(idx, 10, 2, 0))
+    np.testing.assert_array_equal(out, [1, -1, -1])
+    out1 = np.asarray(paddle.shard_index(idx, 10, 2, 1))
+    np.testing.assert_array_equal(out1, [-1, 0, 4])
+    with pytest.raises(ValueError):
+        paddle.shard_index(idx, 10, 2, 7)
+
+
+def test_places_and_compat_aliases():
+    assert paddle.CPUPlace() == paddle.CPUPlace()
+    assert paddle.CUDAPlace(0).get_device_id() == 0
+    paddle.XPUPlace(0), paddle.NPUPlace(0), paddle.CUDAPinnedPlace()
+    assert paddle.VarBase is paddle.Tensor
+    assert paddle.get_cudnn_version() is None
+    assert not paddle.is_compiled_with_rocm()
+    paddle.monkey_patch_math_varbase()
+    paddle.monkey_patch_variable()
+
+
+def test_static_mode_flag_roundtrip():
+    assert paddle.in_dygraph_mode()
+    paddle.enable_static()
+    try:
+        assert not paddle.in_dygraph_mode()
+    finally:
+        paddle.disable_static()
+    assert paddle.in_dygraph_mode()
+
+
+def test_cuda_rng_state_roundtrip():
+    state = paddle.get_cuda_rng_state()
+    a = np.asarray(paddle.rand([3]))
+    paddle.set_cuda_rng_state(state)
+    b = np.asarray(paddle.rand([3]))
+    np.testing.assert_allclose(a, b)
+
+
+def test_batch_decorator():
+    r = paddle.batch(lambda: iter(range(7)), 3)
+    sizes = [len(b) for b in r()]
+    assert sizes == [3, 3, 1]
+    r2 = paddle.batch(lambda: iter(range(7)), 3, drop_last=True)
+    assert [len(b) for b in r2()] == [3, 3]
+
+
+def test_create_parameter_and_grad_enabled():
+    p = paddle.create_parameter([4, 5], "float32")
+    assert p.shape == (4, 5) and p.trainable
+    b = paddle.create_parameter([5], "float32", is_bias=True)
+    np.testing.assert_allclose(np.asarray(b.value), np.zeros(5))
+    with paddle.set_grad_enabled(False):
+        pass
+    with paddle.set_grad_enabled(True):
+        pass
+
+
+def test_check_shape():
+    assert paddle.tensor.random.check_shape([2, 3]) == [2, 3]
+    with pytest.raises(ValueError):
+        paddle.tensor.random.check_shape([2, -3])
