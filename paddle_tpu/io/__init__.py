@@ -6,3 +6,4 @@ from .dataset import (  # noqa: F401
 from .sampler import (  # noqa: F401
     BatchSampler, DistributedBatchSampler, RandomSampler, Sampler,
     SequenceSampler, WeightedRandomSampler)
+from .dataloader import get_worker_info  # noqa: F401

@@ -38,8 +38,31 @@ def default_collate_fn(batch):
     return arr
 
 
+class WorkerInfo:
+    """Info visible inside a DataLoader worker (reference
+    fluid/dataloader/worker.py WorkerInfo: id/num_workers/dataset)."""
+
+    def __init__(self, id, num_workers, dataset, seed=None):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Return the current WorkerInfo inside a worker process, else None
+    (reference python/paddle/io get_worker_info — used by IterableDataset
+    shards)."""
+    return _worker_info
+
+
 def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
-                 worker_init_fn):
+                 worker_init_fn, num_workers=0):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
     while True:
@@ -66,7 +89,8 @@ class _MultiProcessIter:
             w = ctx.Process(
                 target=_worker_loop,
                 args=(loader.dataset, self.index_queue, self.data_queue,
-                      loader.collate_fn, wid, loader.worker_init_fn),
+                      loader.collate_fn, wid, loader.worker_init_fn,
+                      loader.num_workers),
                 daemon=True)
             w.start()
             self.workers.append(w)

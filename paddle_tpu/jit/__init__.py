@@ -187,3 +187,39 @@ def load(path, **configs) -> TranslatedLayer:
 
 def not_to_static(fn):
     return fn
+
+
+# -- dy2static compat surface (reference fluid/dygraph/dygraph_to_static) ----
+_verbosity = 0
+_code_level = 0
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    """Compat with reference jit.set_verbosity: there is no AST transpiler to
+    log (jax.jit traces Python directly), so this only records the level."""
+    global _verbosity
+    _verbosity = int(level)
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    """Compat with reference jit.set_code_level (transformed-code printing)."""
+    global _code_level
+    _code_level = int(level)
+
+
+class ProgramTranslator:
+    """Singleton compat shim (reference dygraph_to_static/program_translator
+    .py:232). ``enable(False)`` disables staging: to_static returns the
+    original callable unchanged."""
+
+    _instance = None
+    enable_to_static = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static: bool):
+        ProgramTranslator.enable_to_static = bool(enable_to_static)

@@ -1,0 +1,173 @@
+"""Seq2seq decoding: Decoder protocol, BeamSearchDecoder, dynamic_decode.
+
+Reference: python/paddle/nn/decode.py — ``BeamSearchDecoder`` (tile-beam
+state expansion, log-prob accumulation, length-penalty scoring, finished
+masking) and ``dynamic_decode`` (step loop until all beams finish), backed by
+operators/gather_tree_op.cc for the final backtrace.
+
+TPU translation: the decode loop is a plain Python loop eagerly (each step is
+jit-compiled by the cell) with static shapes per step — beam dimensions are
+folded into batch (batch*beam) exactly like the reference's
+``_merge_batch_beams``; the backtrace reuses functional.extension.gather_tree.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import jax
+import jax.numpy as jnp
+
+from .functional.extension import gather_tree
+from .layer import Layer
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Decoder:
+    """Abstract decoder protocol (reference nn/decode.py Decoder):
+    ``initialize``/``step``/``finalize``."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam-search wrapper over a step cell (reference nn/decode.py:88).
+
+    ``cell(inputs, states) -> (cell_out, new_states)``; ``output_fn`` maps
+    cell output to vocab logits; ``embedding_fn`` maps token ids to the next
+    step's inputs.
+    """
+
+    OutputWrapper = namedtuple("OutputWrapper",
+                               ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = namedtuple("StateWrapper",
+                              ("cell_states", "log_probs", "finished",
+                               "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+
+    # -- beam bookkeeping (reference _expand_to_beam_size etc.) -----------
+    def _expand(self, x):
+        x = jnp.asarray(x)
+        tiled = jnp.repeat(x[:, None, ...], self.beam_size, axis=1)
+        return tiled
+
+    def _merge(self, x):  # (batch, beam, ...) -> (batch*beam, ...)
+        x = jnp.asarray(x)
+        return x.reshape((-1,) + x.shape[2:])
+
+    def _split(self, x):  # (batch*beam, ...) -> (batch, beam, ...)
+        x = jnp.asarray(x)
+        return x.reshape((-1, self.beam_size) + x.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        cell_states = jax.tree_util.tree_map(
+            lambda s: self._merge(self._expand(s)), initial_cell_states)
+        sample = jax.tree_util.tree_leaves(cell_states)[0]
+        batch = sample.shape[0] // self.beam_size
+        # only beam 0 is live at t=0 (the reference's kInf masking)
+        log_probs = jnp.tile(
+            jnp.array([0.0] + [-1e9] * (self.beam_size - 1), jnp.float32),
+            (batch, 1))
+        init_ids = jnp.full((batch, self.beam_size), self.start_token,
+                            jnp.int32)
+        finished = jnp.zeros((batch, self.beam_size), jnp.bool_)
+        lengths = jnp.zeros((batch, self.beam_size), jnp.int32)
+        states = self.StateWrapper(cell_states, log_probs, finished, lengths)
+        inputs = (self.embedding_fn(init_ids) if self.embedding_fn
+                  else init_ids)
+        return inputs, states, finished
+
+    def step(self, time, inputs, states, **kwargs):
+        merged_inputs = jax.tree_util.tree_map(self._merge, inputs)
+        cell_out, next_cell_states = self.cell(merged_inputs,
+                                               states.cell_states, **kwargs)
+        logits = self.output_fn(cell_out) if self.output_fn else cell_out
+        logits = self._split(logits)                  # (batch, beam, vocab)
+        vocab = logits.shape[-1]
+        step_log_probs = jax.nn.log_softmax(logits)
+        # finished beams only extend with end_token at no cost
+        noend = jnp.full((vocab,), -1e9, step_log_probs.dtype)
+        noend = noend.at[self.end_token].set(0.0)
+        step_log_probs = jnp.where(states.finished[..., None],
+                                   noend[None, None, :], step_log_probs)
+        log_probs = states.log_probs[..., None] + step_log_probs
+        flat = log_probs.reshape(log_probs.shape[0], -1)
+        topk_scores, topk_idx = jax.lax.top_k(flat, self.beam_size)
+        parent_ids = (topk_idx // vocab).astype(jnp.int32)
+        token_ids = (topk_idx % vocab).astype(jnp.int32)
+
+        def regroup(s):
+            return jnp.take_along_axis(
+                self._split(s),
+                parent_ids.reshape(parent_ids.shape + (1,) * (s.ndim - 1)),
+                axis=1).reshape((-1,) + s.shape[1:])
+
+        next_cell_states = jax.tree_util.tree_map(regroup, next_cell_states)
+        prev_finished = jnp.take_along_axis(states.finished, parent_ids,
+                                            axis=1)
+        finished = prev_finished | (token_ids == self.end_token)
+        lengths = jnp.take_along_axis(states.lengths, parent_ids, axis=1)
+        lengths = jnp.where(prev_finished, lengths, lengths + 1)
+        next_states = self.StateWrapper(next_cell_states, topk_scores,
+                                        finished, lengths)
+        outputs = self.OutputWrapper(topk_scores, token_ids, parent_ids)
+        next_inputs = (self.embedding_fn(token_ids) if self.embedding_fn
+                       else token_ids)
+        return outputs, next_states, next_inputs, finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        # outputs.* : (time, batch, beam)
+        predicted_ids = gather_tree(outputs.predicted_ids, outputs.parent_ids)
+        return self.OutputWrapper(outputs.scores, predicted_ids,
+                                  outputs.parent_ids), final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run ``decoder`` until every sequence finishes or ``max_step_num``
+    (reference nn/decode.py dynamic_decode). Eager loop; per-step compute is
+    whatever the decoder's cell jits."""
+    inputs, states, finished = decoder.initialize(inits)
+    step_outputs_acc = []
+    time = 0
+    max_steps = max_step_num if max_step_num is not None else 256
+    while time < max_steps:
+        outputs, states, inputs, finished = decoder.step(time, inputs, states,
+                                                         **kwargs)
+        step_outputs_acc.append(outputs)
+        time += 1
+        if bool(jnp.all(finished)):
+            break
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                     *step_outputs_acc)
+    lengths = getattr(states, "lengths", None)
+    final_outputs, final_states = decoder.finalize(stacked, states, lengths)
+    if not output_time_major:
+        final_outputs = jax.tree_util.tree_map(
+            lambda x: jnp.moveaxis(x, 0, 1), final_outputs)
+    if return_length:
+        return final_outputs, final_states, lengths
+    return final_outputs, final_states

@@ -1,19 +1,21 @@
 """paddle_tpu.nn.functional — functional API surface
 (reference: python/paddle/nn/functional/__init__.py)."""
 from .activation import (  # noqa: F401
-    celu, elu, gelu, glu, gumbel_softmax, hardshrink, hardsigmoid, hardswish,
+    celu, elu, elu_, gelu, glu, gumbel_softmax, hardshrink, hardsigmoid, hardswish,
     hardtanh, leaky_relu, log_sigmoid, log_softmax, maxout, mish, prelu, relu,
     relu6, relu_, rrelu, selu, sigmoid, silu, softmax, softplus, softshrink,
-    softsign, swish, tanh, tanhshrink, thresholded_relu)
+    softmax_, softsign, swish, tanh, tanh_, tanhshrink, thresholded_relu)
 from .common import (  # noqa: F401
     alpha_dropout, bilinear, cosine_similarity, dropout, dropout2d, dropout3d,
     embedding, fold, interpolate, label_smooth, linear, one_hot, pad,
     sequence_mask, unfold, upsample)
 from .conv import (  # noqa: F401
     conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d, conv3d_transpose)
+from .extension import diag_embed, gather_tree, temporal_shift  # noqa: F401
 from .loss import (  # noqa: F401
     binary_cross_entropy, binary_cross_entropy_with_logits,
-    cosine_embedding_loss, cross_entropy, ctc_loss, hinge_embedding_loss,
+    cosine_embedding_loss, cross_entropy, ctc_loss, dice_loss,
+    hinge_embedding_loss, hsigmoid_loss,
     kl_div, l1_loss, log_loss, margin_ranking_loss, mse_loss, nll_loss,
     npair_loss, sigmoid_focal_loss, smooth_l1_loss, softmax_with_cross_entropy,
     square_error_cost, triplet_margin_loss)

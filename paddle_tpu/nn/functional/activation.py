@@ -153,3 +153,16 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
             jax.nn.one_hot(jnp.squeeze(idx, axis), y.shape[axis], axis=axis, dtype=y.dtype)
         y = jax.lax.stop_gradient(y_hard - y) + y
     return y
+
+
+def elu_(x, alpha=1.0):
+    """Return-value "inplace" variant (see tensor/inplace.py rationale)."""
+    return elu(x, alpha)
+
+
+def softmax_(x, axis=-1, dtype=None):
+    return softmax(x, axis=axis, dtype=dtype)
+
+
+def tanh_(x):
+    return tanh(x)

@@ -1,0 +1,74 @@
+"""Extension functional ops: diag_embed, gather_tree, temporal_shift.
+
+Reference: python/paddle/nn/functional/extension.py (diag_embed, gather_tree)
+and python/paddle/fluid/layers/nn.py temporal_shift
+(operators/temporal_shift_op.cc, operators/gather_tree_op.cc,
+operators/diag_embed_op.cc kernels). All lower to pure XLA HLOs here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["diag_embed", "gather_tree", "temporal_shift"]
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Embed the last dimension of ``input`` as a (dim1, dim2) diagonal."""
+    x = jnp.asarray(input)
+    n = x.shape[-1] + abs(offset)
+    rows = jnp.arange(x.shape[-1])
+    out = jnp.zeros(x.shape[:-1] + (n, n), dtype=x.dtype)
+    if offset >= 0:
+        out = out.at[..., rows, rows + offset].set(x)
+    else:
+        out = out.at[..., rows - offset, rows].set(x)
+    # The diagonal plane was appended as the last two axes; move to dim1/dim2.
+    nd = out.ndim
+    return jnp.moveaxis(out, (nd - 2, nd - 1), (dim1 % nd, dim2 % nd))
+
+
+def gather_tree(ids, parents):
+    """Backtrace full beam-search sequences from per-step ids and parent
+    beam indices. Shapes: (max_time, batch, beam) → (max_time, batch, beam).
+
+    Reference operators/gather_tree_op.cc: walks from the last step to the
+    first following ``parents``; here the walk is a reversed ``lax.scan``.
+    """
+    ids = jnp.asarray(ids)
+    parents = jnp.asarray(parents)
+    max_time = ids.shape[0]
+    beam = ids.shape[-1]
+
+    def step(next_beams, t):
+        # next_beams: (batch, beam) — beam index at step t+1 traced back
+        cur_parents = jnp.take_along_axis(parents[t], next_beams, axis=-1)
+        cur_ids = jnp.take_along_axis(ids[t], next_beams, axis=-1)
+        return cur_parents, cur_ids
+
+    init = jnp.tile(jnp.arange(beam), ids.shape[1:-1] + (1,))
+    _, out = jax.lax.scan(step, init, jnp.arange(max_time - 1, -1, -1))
+    return out[::-1]
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None, data_format="NCHW"):
+    """Temporal Shift Module (TSM): shift a fraction of channels one step
+    along the segment (time) axis. Input (N*T, C, H, W)."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"unsupported data_format {data_format}")
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x5 = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    # channels [0,c1): shift left (future→current); [c1,c2): shift right
+    pad = jnp.pad(x5, ((0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
+    left = pad[:, 2:, :c1]
+    right = pad[:, :-2, c1:c2]
+    keep = x5[:, :, c2:]
+    out = jnp.concatenate([left, right, keep], axis=2).reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out

@@ -226,3 +226,61 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
 
 def mae_loss(input, label, reduction="mean"):
     return l1_loss(input, label, reduction)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice loss for segmentation (reference nn/functional/loss.py dice_loss):
+    input (N, ..., C) class probabilities, label (N, ..., 1) int labels."""
+    label = jnp.squeeze(label, axis=-1)
+    onehot = jax.nn.one_hot(label, input.shape[-1], dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    intersect = jnp.sum(input * onehot, axis=reduce_dims)
+    denom = jnp.sum(input, axis=reduce_dims) + jnp.sum(onehot, axis=reduce_dims)
+    dice = (2 * intersect + epsilon) / (denom + epsilon)
+    return jnp.mean(1 - dice)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid loss (reference nn/functional/loss.py
+    hsigmoid_loss; operators/hierarchical_sigmoid_op.cc). Default tree =
+    heap-numbered complete binary tree over ``num_classes`` leaves: internal
+    nodes 1..num_classes-1 map to rows of ``weight`` (num_classes-1, D);
+    custom trees come in via ``path_table``/``path_code``.
+    On TPU the per-sample variable-length path is padded to max depth and
+    masked (static shapes for XLA).
+    """
+    import numpy as _np
+    x = jnp.asarray(input)
+    weight = jnp.asarray(weight)
+    if bias is not None:
+        bias = jnp.asarray(bias)
+    label = jnp.asarray(label).reshape(-1)
+    if path_table is not None:
+        table = jnp.asarray(path_table)
+        code = jnp.asarray(path_code)
+        node_ids = jnp.take(table, label, axis=0)        # (N, depth)
+        codes = jnp.take(code, label, axis=0).astype(x.dtype)
+        mask = (node_ids >= 0).astype(x.dtype)
+        rows = jnp.maximum(node_ids, 0)
+    else:
+        depth = max(1, int(_np.ceil(_np.log2(max(2, num_classes)))))
+        leaf = label + num_classes                        # heap leaf id
+        nodes, codes_l = [], []
+        node = leaf
+        for _ in range(depth):
+            codes_l.append((node % 2).astype(x.dtype))
+            node = node // 2
+            nodes.append(node)
+        node_ids = jnp.stack(nodes, axis=1)               # ancestors, (N, depth)
+        codes = jnp.stack(codes_l, axis=1)
+        mask = (node_ids >= 1).astype(x.dtype)
+        rows = jnp.maximum(node_ids - 1, 0)               # weight row index
+    w = jnp.take(weight, rows, axis=0)                    # (N, depth, D)
+    logits = jnp.einsum("nd,nkd->nk", x, w)
+    if bias is not None:
+        logits = logits + jnp.take(jnp.asarray(bias).reshape(-1), rows, axis=0)
+    # code==1 → right child → target 1; log sigmoid of signed logit
+    sign = 1.0 - 2.0 * codes
+    loss = -jax.nn.log_sigmoid(sign * logits) * mask
+    return jnp.sum(loss, axis=1, keepdims=True)

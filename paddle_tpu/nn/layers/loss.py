@@ -136,3 +136,31 @@ class CTCLoss(Layer):
                 norm_by_times=False):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
                           self.blank, self.reduction, norm_by_times)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer (reference nn/layer/loss.py
+    HSigmoidLoss). Holds the internal-node weight table (num_classes-1, D)
+    and optional bias; see functional.hsigmoid_loss for the tree encoding."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if not is_custom and num_classes < 2:
+            raise ValueError("num_classes must be >= 2 for the default tree")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        rows = num_classes - 1 if not is_custom else num_classes
+        self.weight = self.create_parameter((rows, feature_size),
+                                            attr=weight_attr)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter((rows, 1), attr=bias_attr,
+                                              is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        bias = self.bias.value.reshape(-1) if self.bias is not None else None
+        return F.hsigmoid_loss(input, label, self.num_classes,
+                               self.weight.value, bias,
+                               path_table=path_table, path_code=path_code)

@@ -2,7 +2,7 @@
 from . import lr  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .optimizer import (  # noqa: F401
-    SGD, Adagrad, Adam, Adamax, AdamW, Lamb, Lars, LarsMomentum, Momentum,
+    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Lars, LarsMomentum, Momentum,
     Optimizer, RMSProp)
 
 

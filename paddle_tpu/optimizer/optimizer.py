@@ -204,6 +204,30 @@ class Adagrad(Optimizer):
         return p, {"moment": m}
 
 
+class Adadelta(Optimizer):
+    """Reference optimizer/adadelta.py (operators/optimizers/adadelta_op.cc):
+    avg_squared_grad/avg_squared_update accumulators, rho/epsilon."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def init_slots(self, value):
+        return {"avg_squared_grad": jnp.zeros(value.shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(value.shape, jnp.float32)}
+
+    def update(self, p, g, slots, lr, step):
+        rho, eps = self._rho, self._epsilon
+        asg = rho * slots["avg_squared_grad"] + (1 - rho) * jnp.square(g)
+        upd = g * jnp.sqrt(slots["avg_squared_update"] + eps) / \
+            jnp.sqrt(asg + eps)
+        asu = rho * slots["avg_squared_update"] + (1 - rho) * jnp.square(upd)
+        return p - lr * upd, {"avg_squared_grad": asg,
+                              "avg_squared_update": asu}
+
+
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,

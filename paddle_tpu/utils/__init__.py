@@ -12,3 +12,32 @@ try:  # guard: needs a host toolchain
     from . import cpp_extension  # noqa: F401
 except Exception:  # pragma: no cover
     cpp_extension = None
+
+
+def try_import(module_name, err_msg=None):
+    """Import-or-explain helper (reference utils/lazy_import.py try_import)."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"Optional dependency '{module_name}' is required for "
+            f"this API but is not installed.")
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version against a range (reference
+    utils/op_version.py require_version semantics on paddle.__version__)."""
+    from .. import __version__
+
+    def _tup(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+
+    cur = _tup(__version__)
+    if _tup(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required min {min_version}")
+    if max_version is not None and _tup(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed max {max_version}")
+    return True

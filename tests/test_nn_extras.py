@@ -1,0 +1,178 @@
+"""Tests for nn additions: diag_embed/gather_tree/temporal_shift,
+dice_loss/hsigmoid_loss (+HSigmoidLoss layer), BeamSearchDecoder +
+dynamic_decode, Adadelta optimizer, jit/io/utils compat shims.
+
+Reference surfaces: python/paddle/nn/functional/extension.py, loss.py,
+python/paddle/nn/decode.py, python/paddle/optimizer/adadelta.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import jax.numpy as jnp
+
+
+def test_diag_embed_values_and_offset():
+    x = paddle.to_tensor(np.array([[1.0, 2.0, 3.0]], dtype="float32"))
+    out = np.asarray(nn.functional.diag_embed(x))
+    assert out.shape == (1, 3, 3)
+    np.testing.assert_allclose(np.diag(out[0]), [1, 2, 3])
+    off = np.asarray(nn.functional.diag_embed(x, offset=1))
+    assert off.shape == (1, 4, 4)
+    np.testing.assert_allclose(np.diag(off[0], k=1), [1, 2, 3])
+    neg = np.asarray(nn.functional.diag_embed(x, offset=-1))
+    np.testing.assert_allclose(np.diag(neg[0], k=-1), [1, 2, 3])
+
+
+def test_diag_embed_dim_placement():
+    x = paddle.ones([2, 3])
+    out = nn.functional.diag_embed(x, dim1=0, dim2=2)
+    assert out.shape == (3, 2, 3)
+
+
+def test_temporal_shift():
+    # 2 videos x 2 segments, 4 channels
+    x = np.arange(2 * 2 * 4 * 1 * 1, dtype="float32").reshape(4, 4, 1, 1)
+    out = np.asarray(nn.functional.temporal_shift(x, seg_num=2,
+                                                  shift_ratio=0.25))
+    assert out.shape == (4, 4, 1, 1)
+    x5 = x.reshape(2, 2, 4, 1, 1)
+    # channel 0 shifted left: t=0 gets t=1's value, t=1 gets 0
+    assert out.reshape(2, 2, 4)[0, 0, 0] == x5[0, 1, 0, 0, 0]
+    assert out.reshape(2, 2, 4)[0, 1, 0] == 0.0
+    # channel 1 shifted right: t=1 gets t=0's value, t=0 gets 0
+    assert out.reshape(2, 2, 4)[0, 1, 1] == x5[0, 0, 1, 0, 0]
+    assert out.reshape(2, 2, 4)[0, 0, 1] == 0.0
+    # channels 2-3 unshifted
+    np.testing.assert_allclose(out.reshape(2, 2, 4)[:, :, 2:],
+                               x5[:, :, 2:, 0, 0])
+
+
+def test_gather_tree():
+    # reference operators/gather_tree_op.cc example
+    ids = np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]],
+                   dtype="int64")
+    parents = np.array([[[0, 0], [1, 1]], [[1, 0], [1, 0]],
+                        [[0, 0], [0, 1]]], dtype="int64")
+    out = np.asarray(nn.functional.gather_tree(ids, parents))
+    expected = np.array([[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]],
+                        dtype="int64")
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_dice_loss():
+    probs = paddle.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8]],
+                                      dtype="float32"))
+    labels = paddle.to_tensor(np.array([[0], [1]], dtype="int64"))
+    loss = float(nn.functional.dice_loss(probs, labels))
+    # per-sample dice = (2*0.9+eps)/(1+1+eps) -> loss ~= 1-0.9=0.1 ; ~0.2 avg: (0.1+0.2)/2
+    assert abs(loss - 0.15) < 1e-3
+
+
+def test_hsigmoid_loss_layer_and_grad():
+    paddle.seed(7)
+    m = nn.HSigmoidLoss(feature_size=8, num_classes=6)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype("float32"))
+    y = paddle.to_tensor(np.array([0, 2, 4, 5], dtype="int64"))
+    out = m(x, y)
+    assert out.shape == (4, 1)
+    assert np.all(np.asarray(out) > 0)  # -log sigmoid sums are positive
+    # loss decreases under sgd on the functional path
+    import jax
+    w0 = np.asarray(m.weight.value)
+
+    def loss_fn(w):
+        return jnp.mean(nn.functional.hsigmoid_loss(
+            jnp.asarray(x), y, 6, w, None))
+
+    g = jax.grad(loss_fn)(m.weight.value)
+    assert np.isfinite(np.asarray(g)).all()
+    l0 = float(loss_fn(m.weight.value))
+    l1 = float(loss_fn(m.weight.value - 0.1 * g))
+    assert l1 < l0
+
+
+def test_hsigmoid_custom_path():
+    # custom tree: num_classes=4 with explicit path table/code
+    path_table = np.array([[0, 1, -1], [0, 2, -1], [1, 0, -1], [2, 1, 0]],
+                          dtype="int64")
+    path_code = np.array([[0, 1, 0], [1, 0, 0], [0, 0, 0], [1, 1, 1]],
+                         dtype="int64")
+    w = np.random.RandomState(0).randn(4, 5).astype("float32")
+    x = np.random.RandomState(1).randn(3, 5).astype("float32")
+    y = np.array([0, 1, 3], dtype="int64")
+    out = nn.functional.hsigmoid_loss(x, y, 4, w, None,
+                                      path_table=path_table,
+                                      path_code=path_code)
+    assert out.shape == (3, 1) and np.isfinite(np.asarray(out)).all()
+
+
+class _CellWrap:
+    """Greedy argmax-deterministic toy cell: logits depend on input token."""
+
+    def __init__(self, vocab, table):
+        self.vocab = vocab
+        self.table = table  # (vocab, vocab) next-token logits
+
+    def __call__(self, inputs, states):
+        logits = jnp.take(self.table, jnp.asarray(inputs).reshape(-1), axis=0)
+        return logits, states
+
+
+def test_beam_search_decode_follows_highest_prob_path():
+    vocab, end = 5, 4
+    # token t deterministically prefers token (t+1) % 5; token 3 prefers END
+    table = np.full((vocab, vocab), -10.0, dtype="float32")
+    for t in range(vocab):
+        table[t, (t + 1) % vocab] = 10.0
+    dec = nn.BeamSearchDecoder(
+        _CellWrap(vocab, jnp.asarray(table)), start_token=0, end_token=end,
+        beam_size=2)
+    init_states = jnp.zeros((2, 1))  # batch=2 dummy states
+    out, states = nn.dynamic_decode(dec, init_states, max_step_num=10)
+    seq = np.asarray(out.predicted_ids)[0, :, 0]  # batch 0, best beam
+    # path from start 0: 1,2,3,4(END)
+    np.testing.assert_array_equal(seq[:4], [1, 2, 3, 4])
+    assert bool(np.all(np.asarray(states.finished)))
+
+
+def test_adadelta_decreases_loss():
+    paddle.seed(0)
+    lin = nn.Linear(4, 1)
+    opt = paddle.optimizer.Adadelta(learning_rate=1.0,
+                                    parameters=lin.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0).randn(16, 4).astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(16, 1).astype("float32"))
+
+    def closure():
+        return nn.functional.mse_loss(lin(x), y)
+
+    l0 = float(closure())
+    for _ in range(30):
+        paddle.autograd.backward(lin, closure)
+        opt.step()
+        opt.clear_grad()
+    assert float(closure()) < l0
+
+
+def test_compat_shims():
+    paddle.jit.set_verbosity(3)
+    paddle.jit.set_code_level(50)
+    pt = paddle.jit.ProgramTranslator.get_instance()
+    pt.enable(False)
+    assert not paddle.jit.ProgramTranslator.enable_to_static
+    pt.enable(True)
+    assert paddle.io.get_worker_info() is None
+    assert paddle.utils.require_version("0.0.1")
+    with pytest.raises(Exception):
+        paddle.utils.require_version("999.0.0")
+    np_mod = paddle.utils.try_import("numpy")
+    assert np_mod is np
+    with pytest.raises(ImportError):
+        paddle.utils.try_import("definitely_not_a_module_xyz")
+    assert paddle.vision.get_image_backend() == "pil"
+    with pytest.raises(ValueError):
+        paddle.vision.set_image_backend("bogus")
+    from paddle_tpu.text import Imdb, WMT14  # noqa: F401
+    assert paddle.nn.functional.elu_ is not None
