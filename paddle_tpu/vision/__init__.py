@@ -1,3 +1,29 @@
 """paddle_tpu.vision (reference: python/paddle/vision/)."""
 from . import datasets, models, ops, transforms  # noqa: F401
 from .models import LeNet  # noqa: F401
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend: str):
+    """reference vision/image.py set_image_backend ('pil' | 'cv2')."""
+    global _image_backend
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"unsupported image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """reference vision/image.py image_load — load an image file with the
+    configured backend (PIL here; cv2 is not in the TPU image)."""
+    backend = backend or _image_backend
+    if backend == "cv2":
+        from ..utils import try_import
+        cv2 = try_import("cv2", "cv2 backend requested but not installed")
+        return cv2.imread(str(path))
+    from PIL import Image
+    return Image.open(path)

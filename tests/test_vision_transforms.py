@@ -1,0 +1,127 @@
+"""Tests for vision.transforms functional ops + new transform classes.
+
+Reference surface: python/paddle/vision/transforms/{functional,transforms}.py.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision.transforms import functional as Fv
+
+
+def _img(h=8, w=6, c=3, seed=0):
+    return np.random.RandomState(seed).randint(0, 256, (h, w, c)) \
+        .astype(np.uint8)
+
+
+def test_to_tensor_scales_and_chw():
+    t = Fv.to_tensor(_img())
+    assert t.shape == (3, 8, 6)
+    assert t.dtype == np.float32 and t.max() <= 1.0
+    t2 = Fv.to_tensor(_img(), data_format="HWC")
+    assert t2.shape == (8, 6, 3)
+
+
+def test_resize_int_preserves_aspect():
+    out = Fv.resize(_img(8, 6), 4)
+    assert out.shape[:2] == (int(4 * 8 / 6), 4)
+    out2 = Fv.resize(_img(8, 6), (5, 7))
+    assert out2.shape[:2] == (5, 7)
+
+
+def test_pad_modes():
+    img = _img(4, 4)
+    assert Fv.pad(img, 2).shape == (8, 8, 3)
+    assert Fv.pad(img, (1, 2)).shape == (4 + 4, 4 + 2, 3)
+    assert Fv.pad(img, (1, 2, 3, 4)).shape == (4 + 6, 4 + 4, 3)
+    Fv.pad(img, 1, padding_mode="reflect")
+    Fv.pad(img, 1, padding_mode="edge")
+
+
+def test_crop_center_crop_flips():
+    img = _img(8, 8)
+    c = Fv.crop(img, 2, 3, 4, 5)
+    np.testing.assert_array_equal(c, img[2:6, 3:8])
+    cc = Fv.center_crop(img, 4)
+    np.testing.assert_array_equal(cc, img[2:6, 2:6])
+    np.testing.assert_array_equal(Fv.hflip(img), img[:, ::-1])
+    np.testing.assert_array_equal(Fv.vflip(img), img[::-1])
+
+
+def test_normalize():
+    chw = Fv.to_tensor(_img())
+    out = Fv.normalize(chw, mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5])
+    assert abs(float(out.max())) <= 1.0 + 1e-6
+
+
+def test_rotate_90_exact():
+    img = _img(5, 5)
+    out = Fv.rotate(img, 90)
+    # 90° CCW: out[y,x] should equal rot90 of the image
+    np.testing.assert_array_equal(out, np.rot90(img, -1))
+
+
+def test_rotate_expand():
+    img = _img(4, 8)
+    out = Fv.rotate(img, 90, expand=True)
+    assert out.shape[:2] == (8, 4)
+
+
+def test_grayscale_and_color_adjust():
+    img = _img()
+    g = Fv.to_grayscale(img)
+    assert g.shape == (8, 6, 1)
+    g3 = Fv.to_grayscale(img, 3)
+    assert g3.shape == (8, 6, 3)
+    b = Fv.adjust_brightness(img, 0.0)
+    assert b.sum() == 0
+    b2 = Fv.adjust_brightness(img, 1.0)
+    np.testing.assert_array_equal(b2, img)
+    c = Fv.adjust_contrast(img, 1.0)
+    np.testing.assert_array_equal(c, img)
+    s = Fv.adjust_saturation(img, 0.0)  # fully desaturated = grayscale
+    np.testing.assert_allclose(s[..., 0], s[..., 1], atol=1)
+    h_same = Fv.adjust_hue(img, 0.0)
+    np.testing.assert_allclose(h_same.astype(int), img.astype(int), atol=2)
+    with pytest.raises(ValueError):
+        Fv.adjust_hue(img, 0.7)
+
+
+def test_adjust_hue_full_turn_roundtrip():
+    img = _img()
+    half1 = Fv.adjust_hue(img, 0.5)
+    # hue is periodic: shifting by +0.5 then +0.5 again returns (approx)
+    back = Fv.adjust_hue(half1, 0.5)
+    np.testing.assert_allclose(back.astype(int), img.astype(int), atol=3)
+
+
+def test_pil_roundtrip():
+    from PIL import Image
+    pil = Image.fromarray(_img())
+    out = Fv.resize(pil, (4, 4))
+    assert out.size == (4, 4)  # PIL size is (w, h)
+    r = Fv.rotate(pil, 45, expand=True)
+    assert r.size[0] > 4
+    f = Fv.hflip(pil)
+    np.testing.assert_array_equal(np.asarray(f), np.asarray(pil)[:, ::-1])
+
+
+def test_transform_classes():
+    img = _img(16, 16)
+    for t in [T.ColorJitter(0.4, 0.4, 0.4, 0.4), T.ContrastTransform(0.4),
+              T.SaturationTransform(0.4), T.HueTransform(0.4),
+              T.Grayscale(3), T.Pad(2), T.RandomRotation(30),
+              T.RandomResizedCrop(8)]:
+        out = t(img)
+        assert out is not None
+    out = T.RandomResizedCrop(8)(img)
+    assert np.asarray(out).shape[:2] == (8, 8)
+    out = T.Pad(3)(img)
+    assert out.shape == (22, 22, 3)
+    comp = T.Compose([T.RandomResizedCrop(8), T.ToTensor()])
+    chw = comp(img)
+    assert chw.shape == (3, 8, 8)
+    with pytest.raises(ValueError):
+        T.HueTransform(0.9)
+    with pytest.raises(ValueError):
+        T.ContrastTransform(-1)
