@@ -161,6 +161,9 @@ class ElasticManager:
         return ElasticStatus.COMPLETED if completed else ElasticStatus.EXIT
 
     def signal_handler(self, sigint, frame):
-        """reference :343 — deregister before dying."""
+        """reference :343 — deregister, chain the previous handler, die."""
         self.deregister()
+        prev = getattr(self, "_prev_handlers", {}).get(sigint)
+        if callable(prev):
+            prev(sigint, frame)
         raise SystemExit(128 + sigint)

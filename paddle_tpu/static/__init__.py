@@ -259,3 +259,325 @@ def cpu_places(device_count: Optional[int] = None):
 
 def device_count() -> int:
     return jax.device_count()
+
+
+# -- Scope / variable store ---------------------------------------------------
+class Variable:
+    """Static-graph variable handle (reference fluid/framework.py:805). Here
+    it names an entry in a Scope; values are jax.Arrays."""
+
+    def __init__(self, name, shape=None, dtype="float32", persistable=False):
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.persistable = persistable
+
+    def __repr__(self):
+        return f"Variable(name={self.name}, shape={self.shape})"
+
+
+class Scope:
+    """Name → value store (reference framework/scope.h:173: name→Variable
+    map with parent chain)."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, object] = {}
+        self._parent = parent
+
+    def var(self, name: str, value=None):
+        if value is not None:
+            self._vars[name] = value
+        else:
+            self._vars.setdefault(name, None)
+        return self._vars.get(name)
+
+    def find_var(self, name: str):
+        if name in self._vars:
+            return self._vars[name]
+        return self._parent.find_var(name) if self._parent else None
+
+    def local_var_names(self):
+        return list(self._vars)
+
+    def new_scope(self) -> "Scope":
+        return Scope(parent=self)
+
+    def drop_kids(self):
+        pass
+
+    # dict-ish
+    def __contains__(self, name):
+        return self.find_var(name) is not None
+
+
+_global_scope = Scope()
+_scope_stack: List[Scope] = [_global_scope]
+
+
+def global_scope() -> Scope:
+    """reference fluid/executor.py global_scope()."""
+    return _scope_stack[-1]
+
+
+class scope_guard:
+    """reference fluid/executor.py scope_guard."""
+
+    def __init__(self, scope: Scope):
+        self._scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self._scope)
+        return self._scope
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()
+        return False
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference fluid/layers/tensor.py create_global_var."""
+    from ..framework.naming import unique_name
+    name = name or unique_name("global_var")
+    arr = jnp.full(tuple(shape), value, dtype=jnp.dtype(dtype))
+    global_scope().var(name, arr)
+    return Variable(name, shape, dtype, persistable=persistable)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """reference static create_parameter — registers in the global scope."""
+    from .nn import _param
+    from ..framework.naming import unique_name
+    name = name or unique_name("parameter")
+    return _param(name, tuple(shape), dtype,
+                  initializer=default_initializer, is_bias=is_bias)
+
+
+# -- program/state serialization ---------------------------------------------
+def load_program_state(model_path: str, var_list=None):
+    """reference fluid/io.py load_program_state — returns name→ndarray."""
+    import pickle
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    if var_list is not None:
+        names = {v.name if hasattr(v, "name") else v for v in var_list}
+        state = {k: v for k, v in state.items() if k in names}
+    return state
+
+
+def set_program_state(program, state_dict):
+    """reference fluid/io.py set_program_state — write into global scope."""
+    scope = global_scope()
+    for k, v in state_dict.items():
+        scope.var(k, jnp.asarray(v))
+
+
+def save(program, model_path: str, protocol=4, **configs):
+    """reference static save (fluid/io.py save): persist every scope value
+    + the program meta."""
+    import os
+    import pickle
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    scope = global_scope()
+    state = {k: np.asarray(scope.find_var(k))
+             for k in scope.local_var_names()
+             if scope.find_var(k) is not None}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+    with open(model_path + ".pdmodel", "wb") as f:
+        pickle.dump({"feed_names": getattr(program, "feed_names", []),
+                     "fetch_names": getattr(program, "fetch_names", [])}, f,
+                    protocol=protocol)
+
+
+def load(program, model_path: str, executor=None, var_list=None):
+    """reference static load (fluid/io.py load)."""
+    set_program_state(program, load_program_state(model_path,
+                                                  var_list=var_list))
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None,
+                      **kwargs) -> bytes:
+    """reference static/io.py serialize_program."""
+    import pickle
+    program = program or default_main_program()
+    return pickle.dumps({"feed_names": program.feed_names,
+                         "fetch_names": program.fetch_names,
+                         "text": program.to_string(False)})
+
+
+def deserialize_program(data: bytes):
+    import pickle
+    meta = pickle.loads(data)
+    prog = Program()
+    prog._fetch_names = meta.get("fetch_names", [])
+    return prog
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, executor=None,
+                           program=None, **kwargs) -> bytes:
+    import pickle
+    scope = global_scope()
+    state = {k: np.asarray(scope.find_var(k))
+             for k in scope.local_var_names()
+             if scope.find_var(k) is not None}
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    import pickle
+    set_program_state(program, pickle.loads(data))
+
+
+def save_to_file(path: str, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars=None, fetch_vars=None, **kwargs):
+    """reference static/io.py normalize_program — prune/dedup for export.
+    jaxpr programs are already pruned by tracing; identity."""
+    return program
+
+
+# -- strategies / multi-device shims -----------------------------------------
+class BuildStrategy:
+    """reference details/build_strategy.h — pass-pipeline knobs. XLA owns
+    fusion/memory passes, so these are accepted-and-recorded only."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.memory_optimize = True
+        self.reduce_strategy = None
+        self.gradient_scale_strategy = None
+
+
+class ExecutionStrategy:
+    """reference details/execution_strategy.h."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.use_thread_barrier = False
+
+
+class ParallelExecutor:
+    """reference framework/parallel_executor.cc — multi-device SSA executor.
+    On TPU this is pjit/GSPMD: wraps a Program; run() jits over the active
+    mesh (SURVEY.md §7: ParallelExecutor → pjit)."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 build_strategy=None, exec_strategy=None, **kwargs):
+        self._program = main_program or default_main_program()
+        self.build_strategy = build_strategy or BuildStrategy()
+        self.exec_strategy = exec_strategy or ExecutionStrategy()
+
+    def run(self, fetch_list=None, feed=None, return_numpy=True):
+        return Executor().run(self._program, feed=feed,
+                              fetch_list=fetch_list,
+                              return_numpy=return_numpy)
+
+
+class device_guard:
+    """reference framework.py device_guard — pin ops to a device. Under XLA,
+    placement is whole-computation (jax.default_device)."""
+
+    def __init__(self, device=None):
+        self._device = device
+        self._cm = None
+
+    def __enter__(self):
+        if self._device and self._device.startswith("cpu"):
+            self._cm = jax.default_device(jax.devices("cpu")[0])
+            self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._cm:
+            self._cm.__exit__(*exc)
+        return False
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """reference fluid/layers/control_flow.py Print →
+    jax.debug.print (works under jit)."""
+    jax.debug.print((message or "") + " {x}", x=input)
+    return input
+
+
+def py_func(func, x, out=None, backward_func=None,
+            skip_vars_in_backward_input=None):
+    from .nn import py_func as _py_func
+    return _py_func(func, x, out=out, backward_func=backward_func,
+                    skip_vars_in_backward_input=skip_vars_in_backward_input)
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, topk=1,
+        slide_steps=1):
+    """Batch AUC (reference fluid/layers/metric_op.py auc) — returns
+    (auc_value, batch_auc_value, [state]) simplified to the value."""
+    from ..metric import Auc as _Auc
+    m = _Auc(num_thresholds=num_thresholds)
+    m.update(np.asarray(input), np.asarray(label))
+    return jnp.asarray(m.accumulate(), jnp.float32)
+
+
+def cuda_places(device_ids=None):
+    """Accelerator devices (reference fluid/framework.py cuda_places —
+    maps to the TPU/accelerator devices here)."""
+    devs = [d for d in jax.devices() if d.platform != "cpu"] or jax.devices()
+    if device_ids is None:
+        return devs
+    return [devs[i] for i in device_ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+class WeightNormParamAttr:
+    """reference fluid/param_attr.py WeightNormParamAttr — weight-norm
+    reparameterization config (consumed by nn initializer machinery)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+
+
+from . import nn  # noqa: F401,E402
+
+__all__ += [
+    "Variable", "Scope", "global_scope", "scope_guard", "create_global_var",
+    "create_parameter", "load_program_state", "set_program_state", "save",
+    "load", "serialize_program", "deserialize_program",
+    "serialize_persistables", "deserialize_persistables", "save_to_file",
+    "load_from_file", "normalize_program", "BuildStrategy",
+    "ExecutionStrategy", "ParallelExecutor", "device_guard", "Print",
+    "py_func", "accuracy", "auc", "cuda_places", "xpu_places",
+    "WeightNormParamAttr", "nn", "default_startup_program",
+]

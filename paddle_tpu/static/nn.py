@@ -1,0 +1,740 @@
+"""paddle_tpu.static.nn — static-graph layer functions.
+
+Reference: python/paddle/static/nn/__init__.py (40 names re-exported from
+fluid/layers/nn.py et al). In the reference these append OpDescs + create
+persistable parameters in the default Program; here they are ordinary eager/
+traceable functions whose parameters are created once per ``name`` in the
+global scope (static/__init__.py Scope) so repeated tracing reuses weights
+and ``static.save`` persists them.
+
+LoDTensor translation: the reference's sequence_* ops consume LoDTensors
+(ragged rows encoded by offset tables — framework/lod_tensor.h). The TPU
+encoding is a dense padded batch (batch, max_len, ...) plus an explicit
+``length`` vector — static shapes for XLA; masks express the raggedness.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn import functional as F
+from ..nn import initializer as init_mod
+
+__all__ = [
+    "fc", "batch_norm", "embedding", "bilinear_tensor_product", "case",
+    "cond", "conv2d", "conv2d_transpose", "conv3d", "conv3d_transpose",
+    "crf_decoding", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "multi_box_head", "nce", "prelu",
+    "py_func", "row_conv", "spectral_norm", "switch_case", "while_loop",
+    "sparse_embedding", "sequence_conv", "sequence_softmax", "sequence_pool",
+    "sequence_concat", "sequence_first_step", "sequence_last_step",
+    "sequence_slice", "sequence_expand", "sequence_expand_as", "sequence_pad",
+    "sequence_unpad", "sequence_reshape", "sequence_scatter",
+    "sequence_enumerate", "sequence_reverse",
+]
+
+
+# -- parameter creation in the global scope ---------------------------------
+def _param(name, shape, dtype="float32", initializer=None, is_bias=False):
+    from . import global_scope
+    scope = global_scope()
+    found = scope.find_var(name)
+    if found is not None:
+        return found
+    if initializer is None:
+        initializer = (init_mod.Constant(0.0) if is_bias
+                       else init_mod.XavierUniform())
+    # Parameter creation must be CONCRETE even when the layer function is
+    # being traced by Program.trace / jax.jit: ensure_compile_time_eval makes
+    # the initializer (and its global-PRNG split) execute eagerly, so no
+    # tracer leaks into the scope or the RNG state.
+    with jax.ensure_compile_time_eval():
+        value = initializer(tuple(shape), jnp.dtype(dtype))
+    scope.var(name, value)
+    return value
+
+
+def _uname(prefix):
+    from ..framework.naming import unique_name
+    return unique_name(prefix)
+
+
+# -- dense layers ------------------------------------------------------------
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """reference static/nn/common.py fc (operators/math/fc.cc)."""
+    name = name or _uname("fc")
+    lead = int(np.prod(x.shape[num_flatten_dims:]))
+    flat = jnp.reshape(x, x.shape[:num_flatten_dims] + (lead,))
+    w = _param(f"{name}.w_0", (lead, size))
+    out = jnp.matmul(flat, w)
+    if bias_attr is not False:
+        b = _param(f"{name}.b_0", (size,), is_bias=True)
+        out = out + b
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    name = name or _uname("embedding")
+    table = _param(f"{name}.w_0", tuple(size), dtype,
+                   initializer=init_mod.Normal(0.0, 0.02))
+    out = jnp.take(table, jnp.asarray(input), axis=0)
+    if padding_idx is not None:
+        pad = padding_idx if padding_idx >= 0 else size[0] + padding_idx
+        mask = (jnp.asarray(input) != pad)[..., None].astype(out.dtype)
+        out = out * mask
+    return out
+
+
+def sparse_embedding(input, size, padding_idx=None, param_attr=None,
+                     is_test=False, name=None):
+    """reference static/nn/common.py sparse_embedding — the PS-backed
+    trillion-row table. Dense fallback here; the distributed PS path lives in
+    distributed/ps (csrc/ps native store)."""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     param_attr=param_attr, name=name)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """out_k = x W_k y^T (reference operators/bilinear_tensor_product_op)."""
+    name = name or _uname("bilinear")
+    w = _param(f"{name}.w_0", (size, x.shape[-1], y.shape[-1]))
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if bias_attr is not False:
+        out = out + _param(f"{name}.b_0", (size,), is_bias=True)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def _norm_params(name, c, param_attr, bias_attr):
+    scale = _param(f"{name}.w_0", (c,), initializer=init_mod.Constant(1.0))
+    bias = _param(f"{name}.b_0", (c,), is_bias=True)
+    return scale, bias
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None, moving_mean_name=None, moving_variance_name=None,
+               **kwargs):
+    """Stateless inference-style BN over the batch statistics (training-mode
+    running stats belong to nn.BatchNorm layers; reference
+    static/nn/common.py batch_norm)."""
+    name = name or _uname("batch_norm")
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale, bias = _norm_params(name, c, param_attr, bias_attr)
+    axes = tuple(i for i in range(input.ndim)
+                 if i != (1 if data_layout == "NCHW" else input.ndim - 1))
+    mean = jnp.mean(input, axis=axes, keepdims=True)
+    var = jnp.var(input, axis=axes, keepdims=True)
+    shape = [1] * input.ndim
+    shape[1 if data_layout == "NCHW" else -1] = c
+    out = (input - mean) / jnp.sqrt(var + epsilon)
+    out = out * scale.reshape(shape) + bias.reshape(shape)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None, name=None,
+              **kwargs):
+    """reference static/nn/common.py data_norm — scale-shift by accumulated
+    batch statistics (PS CTR models); single-batch form here."""
+    return batch_norm(input, act=act, epsilon=epsilon, name=name or
+                      _uname("data_norm"), data_layout="NHWC"
+                      if input.ndim == 2 else "NCHW")
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    name = name or _uname("group_norm")
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale, bias = _norm_params(name, c, param_attr, bias_attr)
+    out = F.group_norm(input, groups, epsilon=epsilon, weight=scale,
+                       bias=bias, data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    name = name or _uname("instance_norm")
+    c = input.shape[1]
+    scale, bias = _norm_params(name, c, param_attr, bias_attr)
+    return F.instance_norm(input, weight=scale, bias=bias, eps=epsilon)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    name = name or _uname("layer_norm")
+    norm_shape = input.shape[begin_norm_axis:]
+    n = int(np.prod(norm_shape))
+    w = _param(f"{name}.w_0", (n,), initializer=init_mod.Constant(1.0)) \
+        if scale else None
+    b = _param(f"{name}.b_0", (n,), is_bias=True) if shift else None
+    flat = jnp.reshape(input, input.shape[:begin_norm_axis] + (n,))
+    out = F.layer_norm(flat, (n,), weight=w, bias=b, epsilon=epsilon)
+    out = jnp.reshape(out, input.shape)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Power-iteration spectral normalization of a weight tensor
+    (reference operators/spectral_norm_op)."""
+    w = jnp.moveaxis(jnp.asarray(weight), dim, 0)
+    mat = w.reshape(w.shape[0], -1)
+    u = jnp.ones((mat.shape[0],), mat.dtype)
+    for _ in range(max(1, power_iters)):
+        v = mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ mat @ v
+    out = w / (sigma + eps)
+    return jnp.moveaxis(out, 0, dim)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    name = name or _uname("prelu")
+    if mode == "all":
+        shape = (1,)
+    elif mode == "channel":
+        shape = (x.shape[1],)
+    else:  # element
+        shape = tuple(x.shape[1:])
+    alpha = _param(f"{name}.w_0", shape,
+                   initializer=init_mod.Constant(0.25))
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return jnp.where(x > 0, x, alpha * x)
+
+
+# -- convolutions ------------------------------------------------------------
+def _conv_param(name, shape):
+    return _param(f"{name}.w_0", shape,
+                  initializer=init_mod.XavierUniform(fan_in=int(
+                      np.prod(shape[1:]))))
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    name = name or _uname("conv2d")
+    ks = (filter_size, filter_size) if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    w = _conv_param(name, (num_filters, cin // groups) + ks)
+    b = None if bias_attr is False else _param(f"{name}.b_0", (num_filters,),
+                                               is_bias=True)
+    out = F.conv2d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCHW", name=None):
+    name = name or _uname("conv2d_transpose")
+    ks = (filter_size, filter_size) if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    w = _conv_param(name, (cin, num_filters // groups) + ks)
+    b = None if bias_attr is False else _param(f"{name}.b_0", (num_filters,),
+                                               is_bias=True)
+    out = F.conv2d_transpose(input, w, b, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             output_size=output_size,
+                             data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCDHW", name=None):
+    name = name or _uname("conv3d")
+    ks = (filter_size,) * 3 if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    cin = input.shape[1]
+    w = _conv_param(name, (num_filters, cin // groups) + ks)
+    b = None if bias_attr is False else _param(f"{name}.b_0", (num_filters,),
+                                               is_bias=True)
+    out = F.conv3d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    name = name or _uname("conv3d_transpose")
+    ks = (filter_size,) * 3 if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    cin = input.shape[1]
+    w = _conv_param(name, (cin, num_filters // groups) + ks)
+    b = None if bias_attr is False else _param(f"{name}.b_0", (num_filters,),
+                                               is_bias=True)
+    out = F.conv3d_transpose(input, w, b, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             output_size=output_size)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    """Delegates to vision.ops.deform_conv2d (reference
+    operators/deformable_conv_op.cu)."""
+    from ..vision.ops import deform_conv2d as _dc
+    name = name or _uname("deform_conv2d")
+    ks = (filter_size, filter_size) if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    w = _conv_param(name, (num_filters, x.shape[1] // groups) + ks)
+    b = None if bias_attr is False else _param(f"{name}.b_0", (num_filters,),
+                                               is_bias=True)
+    return _dc(x, offset, w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=mask)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    """Lookahead row convolution (reference operators/row_conv_op.cc —
+    DeepSpeech2): out[t] = sum_{k=0..K} in[t+k] * w[k] per channel.
+    Input (batch, time, dim)."""
+    name = name or _uname("row_conv")
+    k = future_context_size + 1
+    d = input.shape[-1]
+    w = _param(f"{name}.w_0", (k, d),
+               initializer=init_mod.Constant(1.0 / k))
+    pad = jnp.pad(input, ((0, 0), (0, future_context_size), (0, 0)))
+    out = sum(pad[:, i:i + input.shape[1]] * w[i] for i in range(k))
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+# -- control flow (lax wrappers) ---------------------------------------------
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """reference static/nn/control_flow cond → lax.cond (XLA-native
+    conditional; both branches traced)."""
+    return jax.lax.cond(jnp.asarray(pred).reshape(()), lambda _: true_fn(),
+                        lambda _: false_fn(), operand=None)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First true predicate wins (reference control_flow.py case; with no
+    default, the last pair's fn is the fallback — reference semantics)."""
+    fns = list(pred_fn_pairs)
+    if not fns:
+        raise ValueError("case: pred_fn_pairs must be non-empty")
+    # lax.cond traces BOTH branches, so the no-match terminal must be a
+    # traceable fallback, never a raise.
+    fallback = default if default is not None else fns[-1][1]
+
+    def build(i):
+        if i == len(fns):
+            return fallback()
+        pred, fn = fns[i]
+        return jax.lax.cond(jnp.asarray(pred).reshape(()),
+                            lambda _: fn(), lambda _: build(i + 1),
+                            operand=None)
+
+    return build(0)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference control_flow.py switch_case → lax.switch."""
+    if isinstance(branch_fns, dict):
+        max_idx = max(branch_fns)
+        fns = [branch_fns.get(i, default or branch_fns[max_idx])
+               for i in range(max_idx + 1)]
+    else:
+        fns = [f for _, f in branch_fns] if isinstance(branch_fns[0], tuple) \
+            else list(branch_fns)
+    if default is not None:
+        fns.append(default)
+    idx = jnp.clip(jnp.asarray(branch_index).reshape(()), 0, len(fns) - 1)
+    return jax.lax.switch(idx, [lambda _, f=f: f() for f in fns], None)
+
+
+def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+    """reference control_flow.py while_loop → lax.while_loop (bounded device
+    loop; no per-iteration host sync, unlike the reference's WhileOp
+    re-entering the executor)."""
+    return jax.lax.while_loop(lambda vs: jnp.asarray(cond_fn(*vs)).reshape(()),
+                              lambda vs: tuple(body(*vs)), tuple(loop_vars))
+
+
+def py_func(func, x, out=None, backward_func=None, skip_vars_in_backward_input=None):
+    """reference static/nn/common.py py_func → jax.pure_callback (host
+    callback staged into the XLA program)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    if out is None:
+        # infer the host function's output spec on zero-filled numpy inputs
+        # (cannot trace it — it runs outside XLA)
+        probe = func(*[np.zeros(jnp.shape(v),
+                                jnp.result_type(v)) for v in xs])
+        probes = probe if isinstance(probe, (list, tuple)) else [probe]
+        result_shape = [jax.ShapeDtypeStruct(np.shape(o),
+                                             np.asarray(o).dtype)
+                        for o in probes]
+        if not isinstance(probe, (list, tuple)):
+            result_shape = result_shape[0]
+    else:
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        result_shape = [jax.ShapeDtypeStruct(tuple(o.shape), o.dtype)
+                        for o in outs]
+        if not isinstance(out, (list, tuple)):
+            result_shape = result_shape[0]
+    return jax.pure_callback(func, result_shape,
+                             *[jnp.asarray(v) for v in xs])
+
+
+# -- CRF / NCE ---------------------------------------------------------------
+def crf_decoding(input, transition, length=None, label=None, name=None):
+    """Viterbi decode of a linear-chain CRF (reference
+    operators/crf_decoding_op.cc). ``input`` (batch, seq, n_tags) emission
+    scores; ``transition`` (n_tags+2, n_tags): rows 0/1 are start/stop.
+    Returns best tag path (batch, seq). The reference's per-sequence C++ loop
+    becomes a lax.scan over time."""
+    emis = jnp.asarray(input)
+    trans = jnp.asarray(transition)
+    start, stop, pair = trans[0], trans[1], trans[2:]
+    b, t, n = emis.shape
+
+    def step(carry, e_t):
+        alpha = carry  # (b, n)
+        scores = alpha[:, :, None] + pair[None]  # (b, n_prev, n)
+        best_prev = jnp.argmax(scores, axis=1)
+        alpha_t = jnp.max(scores, axis=1) + e_t
+        return alpha_t, best_prev
+
+    alpha0 = start[None] + emis[:, 0]
+    alpha_T, backptrs = jax.lax.scan(step, alpha0,
+                                     jnp.moveaxis(emis[:, 1:], 1, 0))
+    alpha_T = alpha_T + stop[None]
+    last = jnp.argmax(alpha_T, axis=-1)  # (b,)
+
+    def back(carry, bp_t):
+        cur = carry
+        prev = jnp.take_along_axis(bp_t, cur[:, None], axis=1)[:, 0]
+        return prev, cur
+
+    first, path_rev = jax.lax.scan(back, last, backptrs[::-1])
+    # path_rev = [tag_{T-1}, ..., tag_1]; final carry = tag_0
+    path = jnp.concatenate([first[None], path_rev[::-1]], axis=0)  # (t, b)
+    out = jnp.moveaxis(path, 0, 1)
+    if length is not None:
+        mask = F.sequence_mask(length, maxlen=t, dtype="int64")
+        out = out * mask
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=10, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference operators/nce_op.cc):
+    binary logistic on the true class + k uniform negatives."""
+    name = name or _uname("nce")
+    d = input.shape[-1]
+    w = _param(f"{name}.w_0", (num_total_classes, d))
+    b = _param(f"{name}.b_0", (num_total_classes,), is_bias=True)
+    label = jnp.asarray(label).reshape(-1)
+    batch = label.shape[0]
+    # fresh negatives per step from the framework PRNG (a fixed RandomState
+    # would contrast against the same negative set forever); under jit the
+    # key folds per-trace — pass seed for reproducible eager sampling
+    from ..framework.random import get_rng_key
+    key = jax.random.PRNGKey(seed) if seed else get_rng_key()
+    negs = jax.random.randint(key, (batch, num_neg_samples), 0,
+                              num_total_classes)
+    pos_logit = jnp.sum(input * jnp.take(w, label, axis=0), -1) \
+        + jnp.take(b, label)
+    neg_logit = jnp.einsum("bd,bkd->bk", input, jnp.take(w, negs, axis=0)) \
+        + jnp.take(b, negs)
+    pos_loss = -jax.nn.log_sigmoid(pos_logit)
+    neg_loss = -jnp.sum(jax.nn.log_sigmoid(-neg_logit), axis=-1)
+    return (pos_loss + neg_loss)[:, None]
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, offset=0.5, flip=True,
+                   kernel_size=1, pad=0, stride=1, name=None, **kwargs):
+    """SSD detection head (reference static/nn/common multi_box_head +
+    operators/detection/prior_box_op): per feature map, conv loc/conf heads
+    + prior boxes. Returns (mbox_locs, mbox_confs, boxes, variances)."""
+    name = name or _uname("multi_box_head")
+    locs, confs, priors, vars_ = [], [], [], []
+    img_h, img_w = image.shape[2], image.shape[3]
+    n_in = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule
+        min_ratio = min_ratio or 20
+        max_ratio = max_ratio or 90
+        step = int((max_ratio - min_ratio) / max(n_in - 2, 1))
+        min_sizes, max_sizes = [base_size * 0.1], [base_size * 0.2]
+        for r in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+        min_sizes = min_sizes[:n_in]
+        max_sizes = max_sizes[:n_in]
+    for i, feat in enumerate(inputs):
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i], (list, tuple)) \
+            else [aspect_ratios[i]]
+        n_prior = 2 + len(ar) * (2 if flip else 1)
+        fh, fw = feat.shape[2], feat.shape[3]
+        # conv heads
+        loc = conv2d(feat, n_prior * 4, kernel_size, stride=stride,
+                     padding=pad, name=f"{name}.loc{i}")
+        conf = conv2d(feat, n_prior * num_classes, kernel_size, stride=stride,
+                      padding=pad, name=f"{name}.conf{i}")
+        locs.append(jnp.reshape(jnp.transpose(loc, (0, 2, 3, 1)),
+                                (feat.shape[0], -1, 4)))
+        confs.append(jnp.reshape(jnp.transpose(conf, (0, 2, 3, 1)),
+                                 (feat.shape[0], -1, num_classes)))
+        # prior boxes
+        sk = min_sizes[i] / img_w
+        sk2 = (max_sizes[i] / img_w) if max_sizes else sk
+        widths = [sk, float(np.sqrt(sk * sk2))]
+        heights = [sk, float(np.sqrt(sk * sk2))]
+        for a in ar:
+            widths.append(sk * float(np.sqrt(a)))
+            heights.append(sk / float(np.sqrt(a)))
+            if flip:
+                widths.append(sk / float(np.sqrt(a)))
+                heights.append(sk * float(np.sqrt(a)))
+        cy, cx = np.meshgrid((np.arange(fh) + offset) / fh,
+                             (np.arange(fw) + offset) / fw, indexing="ij")
+        boxes_i = []
+        for wd, ht in zip(widths, heights):
+            boxes_i.append(np.stack([cx - wd / 2, cy - ht / 2,
+                                     cx + wd / 2, cy + ht / 2], -1))
+        box = np.clip(np.stack(boxes_i, 2).reshape(-1, 4), 0, 1)
+        priors.append(jnp.asarray(box, jnp.float32))
+        vars_.append(jnp.full((box.shape[0], 4), 0.1, jnp.float32))
+    return (jnp.concatenate(locs, 1), jnp.concatenate(confs, 1),
+            jnp.concatenate(priors, 0), jnp.concatenate(vars_, 0))
+
+
+# -- sequence ops on padded (x, length) --------------------------------------
+def _len_mask(x, length, dtype=None):
+    t = x.shape[1]
+    mask = F.sequence_mask(length, maxlen=t, dtype="float32")
+    return mask.astype(dtype or x.dtype)
+
+
+def sequence_softmax(input, length=None, name=None):
+    if length is None:
+        return jax.nn.softmax(input, axis=1)
+    mask = _len_mask(input, length)
+    while mask.ndim < input.ndim:
+        mask = mask[..., None]
+    neg = jnp.finfo(input.dtype).min
+    return jax.nn.softmax(jnp.where(mask > 0, input, neg), axis=1) * mask
+
+
+def sequence_pool(input, pool_type, length=None, pad_value=0.0):
+    pool_type = pool_type.lower()
+    if length is None:
+        length = jnp.full((input.shape[0],), input.shape[1])
+    mask = _len_mask(input, length)
+    while mask.ndim < input.ndim:
+        mask = mask[..., None]
+    ln = jnp.maximum(jnp.asarray(length).astype(input.dtype), 1)
+    ln = ln.reshape((-1,) + (1,) * (input.ndim - 2))
+    if pool_type == "sum":
+        return jnp.sum(input * mask, axis=1)
+    if pool_type == "average":
+        return jnp.sum(input * mask, axis=1) / ln
+    if pool_type == "sqrt":
+        return jnp.sum(input * mask, axis=1) / jnp.sqrt(ln)
+    if pool_type == "max":
+        neg = jnp.finfo(input.dtype).min
+        return jnp.max(jnp.where(mask > 0, input, neg), axis=1)
+    if pool_type == "first":
+        return input[:, 0]
+    if pool_type == "last":
+        idx = (jnp.asarray(length) - 1).reshape(-1)
+        return jnp.take_along_axis(
+            input, idx.reshape((-1, 1) + (1,) * (input.ndim - 2)).astype(
+                jnp.int32).repeat(input.shape[-1], -1) if input.ndim > 2
+            else idx[:, None], axis=1).squeeze(1) if input.ndim > 2 else \
+            input[jnp.arange(input.shape[0]), idx]
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+def sequence_first_step(input, length=None):
+    return sequence_pool(input, "first", length)
+
+
+def sequence_last_step(input, length=None):
+    return sequence_pool(input, "last", length)
+
+
+def sequence_concat(input: Sequence, lengths=None, name=None):
+    """Concatenate along time; with lengths, packs valid steps contiguously
+    (host-side; ragged packing is not XLA-shapeable)."""
+    if lengths is None:
+        return jnp.concatenate(list(input), axis=1)
+    outs = []
+    for b in range(input[0].shape[0]):
+        parts = [np.asarray(x[b, :int(l[b])])
+                 for x, l in zip(input, lengths)]
+        outs.append(np.concatenate(parts, 0))
+    maxlen = max(o.shape[0] for o in outs)
+    padded = [np.pad(o, [(0, maxlen - o.shape[0])] + [(0, 0)] * (o.ndim - 1))
+              for o in outs]
+    return jnp.asarray(np.stack(padded))
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-row slice [offset, offset+length) along time (reference
+    operators/sequence_ops/sequence_slice_op)."""
+    offset = jnp.asarray(offset).reshape(-1)
+    length = jnp.asarray(length).reshape(-1)
+    out_t = int(jnp.max(length))
+    idx = offset[:, None] + jnp.arange(out_t)[None]
+    gathered = jnp.take_along_axis(
+        input, idx[..., None].repeat(input.shape[-1], -1) if input.ndim > 2
+        else idx, axis=1)
+    mask = (jnp.arange(out_t)[None] < length[:, None])
+    while mask.ndim < gathered.ndim:
+        mask = mask[..., None]
+    return gathered * mask.astype(gathered.dtype)
+
+
+def sequence_expand(x, y, ref_level=-1, length=None, name=None):
+    """Repeat each row of x per the ragged row-count of y (reference
+    sequence_expand_op). Padded form: length (batch,) gives repeats."""
+    reps = jnp.asarray(length).reshape(-1) if length is not None else \
+        jnp.full((x.shape[0],), y.shape[1])
+    # static max for XLA; host fallback for ragged
+    out = np.repeat(np.asarray(x), np.asarray(reps), axis=0)
+    return jnp.asarray(out)
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y, length=jnp.full((x.shape[0],), y.shape[1]))
+
+
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    """Pad a packed ragged batch into dense (batch, maxlen, ...) + lengths
+    (reference sequence_pad_op). x is a list of variable-length arrays or a
+    (rows, dim) packed array + length."""
+    if isinstance(x, (list, tuple)):
+        seqs = [np.asarray(s) for s in x]
+    else:
+        assert length is not None, "packed input needs length"
+        flat = np.asarray(x)
+        offs = np.concatenate([[0], np.cumsum(np.asarray(length))])
+        seqs = [flat[offs[i]:offs[i + 1]] for i in range(len(length))]
+    ml = maxlen or max(s.shape[0] for s in seqs)
+    out = np.full((len(seqs), ml) + seqs[0].shape[1:],
+                  np.asarray(pad_value), dtype=seqs[0].dtype)
+    lens = []
+    for i, s in enumerate(seqs):
+        out[i, :s.shape[0]] = s[:ml]
+        lens.append(min(s.shape[0], ml))
+    return jnp.asarray(out), jnp.asarray(lens)
+
+
+def sequence_unpad(x, length, name=None):
+    """Dense padded (batch, maxlen, ...) → list of per-row arrays."""
+    length = np.asarray(length).reshape(-1)
+    return [jnp.asarray(np.asarray(x)[i, :int(length[i])])
+            for i in range(x.shape[0])]
+
+
+def sequence_reshape(input, new_dim, name=None):
+    rows = int(np.prod(input.shape[:2]) * input.shape[-1] // new_dim) \
+        // input.shape[0] if input.ndim > 2 else None
+    flat = jnp.reshape(input, (input.shape[0], -1))
+    return jnp.reshape(flat, (input.shape[0], -1, new_dim))
+
+
+def sequence_scatter(input, index, updates, length=None, name=None):
+    """Scatter-add updates at (row, index) positions (reference
+    sequence_scatter_op). index (batch, k), updates (batch, k)."""
+    idx = jnp.asarray(index)
+    upd = jnp.asarray(updates)
+    rows = jnp.arange(idx.shape[0])[:, None].repeat(idx.shape[1], 1)
+    return jnp.asarray(input).at[rows.reshape(-1),
+                                 idx.reshape(-1)].add(upd.reshape(-1))
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """All win_size-grams per row (reference sequence_enumerate_op):
+    (batch, t) → (batch, t, win_size) padded with pad_value."""
+    x = jnp.asarray(input)
+    t = x.shape[1]
+    pad = jnp.pad(x, ((0, 0), (0, win_size - 1)),
+                  constant_values=pad_value)
+    return jnp.stack([pad[:, i:i + t] for i in range(win_size)], axis=-1)
+
+
+def sequence_reverse(x, length=None, name=None):
+    """Reverse valid prefix per row (reference sequence_reverse_op)."""
+    if length is None:
+        return x[:, ::-1]
+    t = x.shape[1]
+    length = jnp.asarray(length).reshape(-1)
+    idx = jnp.where(jnp.arange(t)[None] < length[:, None],
+                    length[:, None] - 1 - jnp.arange(t)[None],
+                    jnp.arange(t)[None])
+    if x.ndim > 2:
+        idx_e = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+        return jnp.take_along_axis(x, jnp.broadcast_to(idx_e, x.shape), axis=1)
+    return jnp.take_along_axis(x, idx, axis=1)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, act=None,
+                  param_attr=None, bias_attr=None, name=None):
+    """Context-window convolution over time (reference sequence_conv_op):
+    concat [t+padding_start, ...] context rows then project."""
+    name = name or _uname("sequence_conv")
+    d = input.shape[-1]
+    start = padding_start if padding_start is not None \
+        else -(filter_size // 2)
+    w = _param(f"{name}.w_0", (filter_size * d, num_filters))
+    b = None if bias_attr is False else _param(f"{name}.b_0", (num_filters,),
+                                               is_bias=True)
+    t = input.shape[1]
+    cols = []
+    for k in range(filter_size):
+        shift = start + k
+        if shift < 0:
+            sl = jnp.pad(input[:, :t + shift], ((0, 0), (-shift, 0), (0, 0)))
+        elif shift > 0:
+            sl = jnp.pad(input[:, shift:], ((0, 0), (0, shift), (0, 0)))
+        else:
+            sl = input
+        cols.append(sl)
+    ctx = jnp.concatenate(cols, axis=-1)
+    out = jnp.einsum("btd,df->btf", ctx, w)
+    if b is not None:
+        out = out + b
+    if act:
+        out = getattr(F, act)(out)
+    return out

@@ -1,0 +1,260 @@
+"""Tests for static.nn layer functions and static scope/serialization APIs.
+
+Reference surfaces: python/paddle/static/nn/__init__.py (40 names),
+fluid/executor.py global_scope/scope_guard, fluid/io.py program state
+save/load, details/build_strategy.h shims.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import jax.numpy as jnp
+
+st = paddle.static
+
+
+def test_scope_guard_and_global_scope():
+    s = st.global_scope()
+    sub = st.Scope()
+    with st.scope_guard(sub):
+        assert st.global_scope() is sub
+        st.global_scope().var("a", jnp.ones(3))
+        assert st.global_scope().find_var("a") is not None
+    assert st.global_scope() is s
+    # parent chain
+    child = sub.new_scope()
+    assert child.find_var("a") is not None
+
+
+def test_fc_param_reuse_and_activation():
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype("float32"))
+    a = st.nn.fc(x, 16, name="fc_reuse")
+    b = st.nn.fc(x, 16, name="fc_reuse")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    r = st.nn.fc(x, 16, name="fc_relu", activation="relu")
+    assert float(np.asarray(r).min()) >= 0.0
+
+
+def test_embedding_padding_idx():
+    ids = np.array([[0, 1], [2, 0]])
+    emb = st.nn.embedding(ids, (5, 4), padding_idx=0, name="emb_pad")
+    out = np.asarray(emb)
+    np.testing.assert_allclose(out[0, 0], np.zeros(4))
+    np.testing.assert_allclose(out[1, 1], np.zeros(4))
+    assert np.abs(out[0, 1]).sum() > 0
+
+
+def test_norms_and_prelu():
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4, 8, 8)
+                         .astype("float32"))
+    bn = st.nn.batch_norm(x, name="bn_t")
+    assert bn.shape == x.shape
+    gn = st.nn.group_norm(x, groups=2, name="gn_t")
+    assert gn.shape == x.shape
+    ln = st.nn.layer_norm(x, begin_norm_axis=1, name="ln_t")
+    assert ln.shape == x.shape
+    inorm = st.nn.instance_norm(x, name="in_t")
+    assert inorm.shape == x.shape
+    pr = st.nn.prelu(x, mode="channel", name="pr_t")
+    assert pr.shape == x.shape
+    w = np.random.RandomState(1).randn(6, 3, 3, 3).astype("float32")
+    sn = st.nn.spectral_norm(w, power_iters=3)
+    assert sn.shape == w.shape
+    # spectral norm of the normalized matrix ~ 1
+    mat = np.asarray(sn).reshape(6, -1)
+    assert abs(np.linalg.svd(mat, compute_uv=False)[0] - 1.0) < 0.2
+
+
+def test_convs():
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 8, 8)
+                         .astype("float32"))
+    c = st.nn.conv2d(x, 6, 3, padding=1, name="c2")
+    assert c.shape == (1, 6, 8, 8)
+    ct = st.nn.conv2d_transpose(x, 6, filter_size=2, stride=2, name="c2t")
+    assert ct.shape == (1, 6, 16, 16)
+    x3 = paddle.to_tensor(np.random.RandomState(0).randn(1, 2, 4, 4, 4)
+                          .astype("float32"))
+    c3 = st.nn.conv3d(x3, 4, 3, padding=1, name="c3")
+    assert c3.shape == (1, 4, 4, 4, 4)
+
+
+def test_control_flow():
+    out = st.nn.while_loop(lambda i, s: i < 4, lambda i, s: (i + 1, s + i),
+                           (jnp.asarray(0), jnp.asarray(0)))
+    assert int(out[1]) == 0 + 1 + 2 + 3
+    t = st.nn.cond(jnp.asarray(True), lambda: jnp.ones(2), lambda: jnp.zeros(2))
+    np.testing.assert_allclose(np.asarray(t), [1, 1])
+    sw = st.nn.switch_case(2, [lambda: jnp.asarray(0), lambda: jnp.asarray(1),
+                               lambda: jnp.asarray(2)])
+    assert int(sw) == 2
+    cs = st.nn.case([(jnp.asarray(False), lambda: jnp.asarray(1)),
+                     (jnp.asarray(True), lambda: jnp.asarray(2))],
+                    default=lambda: jnp.asarray(3))
+    assert int(cs) == 2
+
+
+def test_py_func():
+    def host_fn(a):
+        return np.asarray(a) * 2
+
+    x = jnp.ones((3,), jnp.float32)
+    out = st.nn.py_func(host_fn, x)
+    np.testing.assert_allclose(np.asarray(out), [2, 2, 2])
+
+
+def test_crf_decoding_prefers_high_scores():
+    # 3 tags; emissions strongly prefer tag sequence [0,1,2]
+    emis = np.full((1, 3, 3), -5.0, dtype="float32")
+    emis[0, 0, 0] = emis[0, 1, 1] = emis[0, 2, 2] = 5.0
+    trans = np.zeros((5, 3), dtype="float32")
+    path = np.asarray(st.nn.crf_decoding(emis, trans))
+    np.testing.assert_array_equal(path[0], [0, 1, 2])
+
+
+def test_nce_positive_loss():
+    x = np.random.RandomState(0).randn(4, 8).astype("float32")
+    y = np.array([1, 2, 3, 4])
+    loss = st.nn.nce(paddle.to_tensor(x), y, num_total_classes=10,
+                     name="nce_t")
+    assert loss.shape == (4, 1)
+    assert np.all(np.asarray(loss) > 0)
+
+
+def test_sequence_ops():
+    x = paddle.to_tensor(np.arange(24, dtype="float32").reshape(2, 4, 3))
+    length = np.array([2, 4])
+    pooled = st.nn.sequence_pool(x, "average", length=length)
+    np.testing.assert_allclose(np.asarray(pooled)[0],
+                               np.arange(24).reshape(2, 4, 3)[0, :2].mean(0))
+    last = st.nn.sequence_last_step(x, length=length)
+    np.testing.assert_allclose(np.asarray(last)[0],
+                               np.arange(24).reshape(2, 4, 3)[0, 1])
+    rev = st.nn.sequence_reverse(x, length=length)
+    np.testing.assert_allclose(np.asarray(rev)[0, 0],
+                               np.arange(24).reshape(2, 4, 3)[0, 1])
+    np.testing.assert_allclose(np.asarray(rev)[0, 2],
+                               np.arange(24).reshape(2, 4, 3)[0, 2])
+    sm = st.nn.sequence_softmax(x, length=length)
+    s = np.asarray(sm)
+    assert abs(s[0, :, 0].sum() - 1.0) < 1e-5  # masked softmax over 2 steps
+    assert s[0, 2:].sum() == 0.0
+    padded, lens = st.nn.sequence_pad(
+        [np.ones((2, 3)), np.ones((4, 3))], 0.0)
+    assert padded.shape == (2, 4, 3)
+    np.testing.assert_array_equal(np.asarray(lens), [2, 4])
+    unp = st.nn.sequence_unpad(padded, lens)
+    assert unp[0].shape == (2, 3) and unp[1].shape == (4, 3)
+    eng = st.nn.sequence_enumerate(np.array([[1, 2, 3]]), 2, pad_value=0)
+    np.testing.assert_array_equal(np.asarray(eng)[0],
+                                  [[1, 2], [2, 3], [3, 0]])
+    sc = st.nn.sequence_conv(x, 5, filter_size=3, name="sconv")
+    assert sc.shape == (2, 4, 5)
+
+
+def test_row_conv():
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 5, 3)
+                         .astype("float32"))
+    out = st.nn.row_conv(x, 2, name="rc_t")
+    assert out.shape == (2, 5, 3)
+
+
+def test_multi_box_head():
+    feats = [paddle.to_tensor(np.random.RandomState(i).randn(1, 4, s, s)
+                              .astype("float32")) for i, s in enumerate([8, 4])]
+    img = paddle.to_tensor(np.zeros((1, 3, 64, 64), dtype="float32"))
+    locs, confs, boxes, vars_ = st.nn.multi_box_head(
+        feats, img, base_size=64, num_classes=3, aspect_ratios=[[2.0], [2.0]],
+        name="mbh")
+    assert locs.shape[0] == 1 and locs.shape[2] == 4
+    assert confs.shape[2] == 3
+    assert boxes.shape[0] == locs.shape[1]
+    assert vars_.shape == boxes.shape
+
+
+def test_program_state_roundtrip(tmp_path):
+    scope = st.Scope()
+    with st.scope_guard(scope):
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4)
+                             .astype("float32"))
+        out = st.nn.fc(x, 8, name="fc_rt")
+        prog = st.default_main_program()
+        path = str(tmp_path / "model")
+        st.save(prog, path)
+        state = st.load_program_state(path)
+        assert "fc_rt.w_0" in state
+        # mutate then restore
+        scope.var("fc_rt.w_0", jnp.zeros_like(scope.find_var("fc_rt.w_0")))
+        st.load(prog, path)
+        out2 = st.nn.fc(x, 8, name="fc_rt")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out2))
+
+
+def test_serialize_bytes_roundtrip(tmp_path):
+    data = st.serialize_persistables()
+    st.deserialize_persistables(st.default_main_program(), data)
+    pb = st.serialize_program()
+    prog = st.deserialize_program(pb)
+    assert isinstance(prog, st.Program)
+    p = str(tmp_path / "blob.bin")
+    st.save_to_file(p, b"abc")
+    assert st.load_from_file(p) == b"abc"
+
+
+def test_strategies_and_places():
+    bs = st.BuildStrategy()
+    bs.fuse_all_reduce_ops = False
+    es = st.ExecutionStrategy()
+    es.num_threads = 4
+    assert st.cuda_places()
+    assert st.xpu_places()
+    with st.device_guard("cpu"):
+        pass
+    st.WeightNormParamAttr(dim=0)
+    acc = st.accuracy(jnp.asarray(np.eye(4, 5, dtype="float32")),
+                      jnp.asarray(np.arange(4)))
+    assert float(acc) == 1.0
+
+
+def test_create_global_var_and_parameter():
+    scope = st.Scope()
+    with st.scope_guard(scope):
+        v = st.create_global_var([2, 3], 1.5, "float32", name="gv")
+        assert scope.find_var("gv").shape == (2, 3)
+        st.create_parameter([4], "float32", name="pp")
+        assert scope.find_var("pp") is not None
+        assert repr(v).startswith("Variable")
+
+
+def test_program_trace_creates_concrete_params():
+    # regression: _param under Program.trace must not leak tracers into the
+    # scope or the global RNG (ensure_compile_time_eval path)
+    scope = st.Scope()
+    with st.scope_guard(scope):
+        def net(x):
+            return st.nn.fc(x, 4, name="traced_fc")
+
+        prog = st.Program.trace(net, st.data("x", [2, 3]))
+        out = st.Executor().run(prog,
+                                feed={"x": np.ones((2, 3), "float32")})
+        assert out[0].shape == (2, 4)
+        w = scope.find_var("traced_fc.w_0")
+        assert hasattr(w, "dtype") and not hasattr(w, "aval") or \
+            not str(type(w)).count("Tracer")
+    # global RNG still usable
+    paddle.rand([2])
+
+
+def test_case_no_default_uses_last_fn():
+    r = st.nn.case([(jnp.asarray(False), lambda: jnp.asarray(1)),
+                    (jnp.asarray(False), lambda: jnp.asarray(2))])
+    assert int(r) == 2
+
+
+def test_nce_fresh_negatives_eager():
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype("float32"))
+    y = np.arange(1, 5)
+    l1 = np.asarray(st.nn.nce(x, y, 50, name="nce_fresh"))
+    l2 = np.asarray(st.nn.nce(x, y, 50, name="nce_fresh"))
+    # same weights, different sampled negatives -> different loss values
+    assert not np.allclose(l1, l2)
