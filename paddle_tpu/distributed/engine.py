@@ -167,16 +167,17 @@ class ParallelTrainer:
             if K > 1:
                 # gradient merge: grads averaged over K sequential chunks
                 # (activation memory is 1/K; same numerics as the big batch)
-                ins = jnp.reshape(inputs, (K, inputs.shape[0] // K)
-                                  + inputs.shape[1:])
-                lbs = jnp.reshape(labels, (K, labels.shape[0] // K)
-                                  + labels.shape[1:])
+                chunk = jax.tree_util.tree_map(
+                    lambda x: jnp.reshape(x, (K, x.shape[0] // K)
+                                          + x.shape[1:]), (inputs, labels))
                 keys = jax.random.split(key, K)
                 loss = 0.0
                 grads = None
                 for i in range(K):
+                    ins_i, lbs_i = jax.tree_util.tree_map(
+                        lambda x: x[i], chunk)
                     l_i, g_i = sharded_grads(dict(params), dict(buffers),
-                                             keys[i], ins[i], lbs[i])
+                                             keys[i], ins_i, lbs_i)
                     loss = loss + l_i / K
                     grads = g_i if grads is None else jax.tree_util.tree_map(
                         lambda a, b: a + b, grads, g_i)
@@ -203,14 +204,21 @@ class ParallelTrainer:
     def train_step(self, inputs, labels, lr: Optional[float] = None):
         key = get_rng_key()
         lr = self.optimizer.get_lr() if lr is None else lr
-        if self.accumulate_steps > 1 and \
-                len(jnp.shape(inputs)) and \
-                jnp.shape(inputs)[0] % self.accumulate_steps != 0:
+        leaves = jax.tree_util.tree_leaves(inputs)
+        batch0 = jnp.shape(leaves[0])[0] if leaves and \
+            len(jnp.shape(leaves[0])) else None
+        if self.accumulate_steps > 1 and batch0 is not None and \
+                batch0 % self.accumulate_steps != 0:
             raise ValueError(
-                f"batch size {jnp.shape(inputs)[0]} is not divisible by "
+                f"batch size {batch0} is not divisible by "
                 f"accumulate_steps={self.accumulate_steps}")
-        inputs = jax.device_put(jnp.asarray(inputs), self._data_sharding)
-        labels = jax.device_put(jnp.asarray(labels), self._data_sharding)
+        # inputs/labels may be arbitrary pytrees (e.g. (mlm, nsp) labels)
+        inputs = jax.tree_util.tree_map(
+            lambda x: jax.device_put(jnp.asarray(x), self._data_sharding),
+            inputs)
+        labels = jax.tree_util.tree_map(
+            lambda x: jax.device_put(jnp.asarray(x), self._data_sharding),
+            labels)
         loss, new_params, new_opt = self._step(
             self.state["params"], self.state["buffers"], self.state["opt"],
             key, lr, inputs, labels)

@@ -1,3 +1,8 @@
 from .gpt import (  # noqa: F401
     GPTBlock, GPTForPretraining, GPTLMHead, GPTModel, gpt_1p3b,
     gpt_pipeline_descs, gpt_tiny)
+from .bert import (  # noqa: F401
+    BertEmbeddings, BertEncoderLayer, BertForPretraining,
+    BertForSequenceClassification, BertModel, BertPooler,
+    BertPretrainingHeads, ErnieForPretraining, ErnieModel, bert_base,
+    bert_large)

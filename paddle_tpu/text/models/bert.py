@@ -1,0 +1,230 @@
+"""BERT/ERNIE model family — bidirectional encoder for BASELINE.md config 3
+("ERNIE/BERT-base AMP pretrain").
+
+The reference trains ERNIE (a BERT-architecture encoder with
+knowledge-masking pretraining) through the same fleet hybrid-parallel stack
+as GPT (SURVEY.md §2 C50 TP layers, C43 AMP). This implementation is
+TPU-first, sharing the GPT building blocks:
+- attention via F.scaled_dot_product_attention → Pallas flash attention
+  (bidirectional, is_causal=False);
+- QKV/MLP matmuls Column/RowParallelLinear on the "model" mesh axis;
+- bf16 compute via amp.auto_cast, master-fp32 weights;
+- MLM + NSP pretraining heads (the reference's ernie pretrain objective
+  class), parallel (vocab-sharded) cross entropy for the MLM loss.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import nn
+from ...nn import functional as F
+from ...nn.initializer import Normal
+from ...nn.layer import Layer
+from ...distributed.meta_parallel.parallel_layers.mp_layers import (
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding)
+from .gpt import GPTAttention, GPTMLP
+
+__all__ = [
+    "BertEmbeddings", "BertEncoderLayer", "BertModel", "BertPooler",
+    "BertPretrainingHeads", "BertForPretraining",
+    "BertForSequenceClassification", "ErnieModel", "ErnieForPretraining",
+    "bert_base", "bert_large",
+]
+
+
+class BertEmbeddings(Layer):
+    """word + position + token-type embeddings, LN, dropout (reference BERT
+    embedding; ernie shares the layout)."""
+
+    def __init__(self, vocab_size, hidden_size, max_position_embeddings=512,
+                 type_vocab_size=2, hidden_dropout_prob=0.1,
+                 layer_norm_epsilon=1e-12, tensor_parallel=True):
+        super().__init__()
+        emb_cls = VocabParallelEmbedding if tensor_parallel else nn.Embedding
+        self.word_embeddings = emb_cls(vocab_size, hidden_size)
+        self.position_embeddings = nn.Embedding(max_position_embeddings,
+                                                hidden_size)
+        self.token_type_embeddings = nn.Embedding(type_vocab_size, hidden_size)
+        self.layer_norm = nn.LayerNorm(hidden_size,
+                                       epsilon=layer_norm_epsilon)
+        self.dropout = nn.Dropout(hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[-1]
+        if position_ids is None:
+            position_ids = jnp.arange(s, dtype=jnp.int32)[None, :]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertEncoderLayer(Layer):
+    """Post-LN transformer encoder layer (BERT layout: residual→LN, unlike
+    GPT's pre-LN). Attention is bidirectional."""
+
+    def __init__(self, hidden_size, num_heads, intermediate_size=None,
+                 attn_dropout=0.1, hidden_dropout=0.1,
+                 layer_norm_epsilon=1e-12, tensor_parallel=True,
+                 mp_degree=1):
+        super().__init__()
+        intermediate_size = intermediate_size or 4 * hidden_size
+        self.attn = GPTAttention(hidden_size, num_heads, attn_dropout,
+                                 hidden_dropout, tensor_parallel, mp_degree,
+                                 causal=False)
+        self.ln_1 = nn.LayerNorm(hidden_size, epsilon=layer_norm_epsilon)
+        self.mlp = GPTMLP(hidden_size, intermediate_size, hidden_dropout,
+                          tensor_parallel)
+        self.ln_2 = nn.LayerNorm(hidden_size, epsilon=layer_norm_epsilon)
+
+    def forward(self, x, attn_mask=None):
+        x = self.ln_1(x + self.attn(x, attn_mask))
+        x = self.ln_2(x + self.mlp(x))
+        return x
+
+
+class BertPooler(Layer):
+    def __init__(self, hidden_size):
+        super().__init__()
+        self.dense = nn.Linear(hidden_size, hidden_size)
+
+    def forward(self, hidden_states):
+        return jnp.tanh(self.dense(hidden_states[:, 0]))
+
+
+class BertModel(Layer):
+    """Bidirectional encoder trunk + pooler."""
+
+    def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=None,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 attn_dropout=0.1, hidden_dropout=0.1,
+                 layer_norm_epsilon=1e-12, tensor_parallel=True,
+                 mp_degree=1, with_pool=True):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.embeddings = BertEmbeddings(
+            vocab_size, hidden_size, max_position_embeddings,
+            type_vocab_size, hidden_dropout, layer_norm_epsilon,
+            tensor_parallel)
+        self.encoder = nn.LayerList([
+            BertEncoderLayer(hidden_size, num_heads, intermediate_size,
+                             attn_dropout, hidden_dropout,
+                             layer_norm_epsilon, tensor_parallel, mp_degree)
+            for _ in range(num_layers)])
+        self.pooler = BertPooler(hidden_size) if with_pool else None
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attn_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.encoder:
+            x = layer(x, attn_mask)
+        pooled = self.pooler(x) if self.pooler is not None else None
+        return x, pooled
+
+
+class BertPretrainingHeads(Layer):
+    """MLM transform + (tied) vocab projection and NSP binary head."""
+
+    def __init__(self, hidden_size, vocab_size, embedding_weight=None,
+                 layer_norm_epsilon=1e-12, tensor_parallel=True):
+        super().__init__()
+        self.transform = nn.Linear(hidden_size, hidden_size)
+        self.layer_norm = nn.LayerNorm(hidden_size,
+                                       epsilon=layer_norm_epsilon)
+        if embedding_weight is not None:
+            self.decoder_weight = embedding_weight  # tied (vocab, hidden)
+            vocab_size = embedding_weight.shape[0]
+        else:
+            self.decoder_weight = self.create_parameter(
+                (vocab_size, hidden_size), initializer=Normal(0.0, 0.02))
+            if tensor_parallel:
+                from jax.sharding import PartitionSpec as P
+                self.decoder_weight.pspec = P("model", None)
+        self.decoder_bias = self.create_parameter(
+            (vocab_size,), is_bias=True)
+        if tensor_parallel:
+            # logits arrive vocab-sharded under shard_map TP — the bias must
+            # shard the same way (cf. ColumnParallelLinear bias.pspec)
+            from jax.sharding import PartitionSpec as P
+            self.decoder_bias.pspec = P("model")
+        self.seq_relationship = nn.Linear(hidden_size, 2)
+
+    def forward(self, sequence_output, pooled_output):
+        h = self.layer_norm(F.gelu(self.transform(sequence_output),
+                                   approximate=True))
+        mlm_logits = jnp.matmul(
+            h, jnp.swapaxes(self.decoder_weight.value, 0, 1)) \
+            + self.decoder_bias.value
+        nsp_logits = self.seq_relationship(pooled_output)
+        return mlm_logits, nsp_logits
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP pretraining objective (reference ernie pretrain task)."""
+
+    def __init__(self, bert: BertModel = None, tensor_parallel=True,
+                 **kwargs):
+        super().__init__()
+        self.bert = bert or BertModel(tensor_parallel=tensor_parallel,
+                                      **kwargs)
+        self.cls = BertPretrainingHeads(
+            self.bert.hidden_size, 0,
+            embedding_weight=self.bert.embeddings.word_embeddings.weight,
+            tensor_parallel=tensor_parallel)
+        self.parallel_loss = ParallelCrossEntropy()
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attn_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                                attn_mask)
+        return self.cls(seq, pooled)
+
+    def loss(self, mlm_logits, nsp_logits, mlm_labels, nsp_labels,
+             ignore_index=-100):
+        """Masked-LM CE (ignoring unmasked positions) + NSP CE."""
+        per_tok = self.parallel_loss(mlm_logits, jnp.maximum(mlm_labels, 0))
+        mask = (mlm_labels != ignore_index).astype(per_tok.dtype)
+        mlm = jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        nsp = jnp.mean(F.cross_entropy(nsp_logits, nsp_labels,
+                                       reduction="none"))
+        return mlm + nsp
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, bert: BertModel = None, num_classes=2, dropout=0.1,
+                 tensor_parallel=False, **kwargs):
+        super().__init__()
+        self.bert = bert or BertModel(tensor_parallel=tensor_parallel,
+                                      **kwargs)
+        self.dropout = nn.Dropout(dropout)
+        self.classifier = nn.Linear(self.bert.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attn_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                              attn_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+# ERNIE is the BERT architecture with knowledge-masked pretraining data; the
+# network classes are shared (reference ernie uses the same encoder stack).
+ErnieModel = BertModel
+ErnieForPretraining = BertForPretraining
+
+
+def bert_base(**kw):
+    cfg = dict(vocab_size=30522, hidden_size=768, num_layers=12,
+               num_heads=12, max_position_embeddings=512)
+    cfg.update(kw)
+    return cfg
+
+
+def bert_large(**kw):
+    cfg = dict(vocab_size=30522, hidden_size=1024, num_layers=24,
+               num_heads=16, max_position_embeddings=512)
+    cfg.update(kw)
+    return cfg

@@ -60,9 +60,10 @@ class GPTEmbeddings(Layer):
 class GPTAttention(Layer):
     def __init__(self, hidden_size, num_heads, attn_dropout=0.1,
                  resid_dropout=0.1, tensor_parallel=True, mp_degree=1,
-                 use_flash=True):
+                 use_flash=True, causal=True):
         super().__init__()
         self.num_heads = num_heads
+        self.causal = causal
         self.head_dim = hidden_size // num_heads
         self.mp_degree = mp_degree if tensor_parallel else 1
         self.local_heads = num_heads // max(self.mp_degree, 1)
@@ -96,11 +97,12 @@ class GPTAttention(Layer):
                     "and attn_dropout=0.0: ring attention has no mask/"
                     "dropout support, and local attention would be wrong")
             from ...ops.ring_attention import ring_flash_attention
-            out = ring_flash_attention(q, k, v, causal=True)
+            out = ring_flash_attention(q, k, v, causal=self.causal)
         else:
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout,
-                is_causal=attn_mask is None, training=self.training)
+                is_causal=self.causal and attn_mask is None,
+                training=self.training)
         out = jnp.reshape(out, (b, s, local_h))
         return self.resid_dropout(self.out_proj(out))
 

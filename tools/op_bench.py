@@ -1,0 +1,133 @@
+"""Standalone op micro-benchmark harness.
+
+Reference: paddle/fluid/operators/benchmark/op_tester.cc (C64 in SURVEY.md §2)
+— runs a single op from a config N times and reports latency. TPU
+translation: jit-compile the op once, time steady-state iterations with a
+device sync per batch, report op name / shapes / mean latency / achieved
+GB/s + GFLOP/s where derivable.
+
+Usage:
+    python tools/op_bench.py                      # built-in suite
+    python tools/op_bench.py matmul --m 1024 --n 1024 --k 1024 --dtype bf16
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _sync(x):
+    import jax
+    leaves = jax.tree_util.tree_leaves(x)
+    if leaves:
+        np.asarray(leaves[0])  # host fetch = reliable sync (see bench.py)
+
+
+def time_op(fn, args, iters=50, warmup=5):
+    import jax
+    jfn = jax.jit(fn)
+    for _ in range(warmup):
+        out = jfn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = jfn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_case(name, fn, args, flops=None, bytes_moved=None, iters=50):
+    dt = time_op(fn, args, iters=iters)
+    rec = {"op": name, "mean_us": round(dt * 1e6, 2)}
+    if flops:
+        rec["gflops"] = round(flops / dt / 1e9, 1)
+    if bytes_moved:
+        rec["gbps"] = round(bytes_moved / dt / 1e9, 1)
+    print(json.dumps(rec))
+    return rec
+
+
+def default_suite(dtype="bfloat16", iters=50):
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    rng = np.random.RandomState(0)
+    dt = jnp.dtype(dtype)
+    results = []
+
+    m = k = n = 2048
+    a = jnp.asarray(rng.randn(m, k), dt)
+    b = jnp.asarray(rng.randn(k, n), dt)
+    results.append(bench_case(
+        f"matmul_{m}x{k}x{n}_{dtype}", jnp.matmul, (a, b),
+        flops=2 * m * k * n, bytes_moved=(m * k + k * n + m * n) * dt.itemsize,
+        iters=iters))
+
+    x = jnp.asarray(rng.randn(8, 3, 224, 224), dt)
+    w = jnp.asarray(rng.randn(64, 3, 7, 7), dt)
+    results.append(bench_case(
+        "conv2d_resnet_stem", lambda x, w: nn.functional.conv2d(
+            x, w, stride=2, padding=3), (x, w), iters=iters))
+
+    h = jnp.asarray(rng.randn(8, 1024, 1024), dt)
+    wln = jnp.ones((1024,), dt)
+    bln = jnp.zeros((1024,), dt)
+    results.append(bench_case(
+        "layer_norm_8x1024x1024",
+        lambda h, w, b: nn.functional.layer_norm(h, (1024,), w, b),
+        (h, wln, bln), bytes_moved=2 * h.size * dt.itemsize, iters=iters))
+
+    q = jnp.asarray(rng.randn(4, 1024, 8, 64), dt)
+    results.append(bench_case(
+        "flash_attention_s1024",
+        lambda q: nn.functional.scaled_dot_product_attention(
+            q, q, q, is_causal=True, training=False), (q,),
+        # causal: only the lower triangle is computed -> half the dense count
+        flops=4 * 4 * 8 * 1024 * 1024 * 64 // 2, iters=iters))
+
+    e = jnp.asarray(rng.randn(50304, 768), dt)
+    ids = jnp.asarray(rng.randint(0, 50304, (8, 1024)), jnp.int32)
+    results.append(bench_case(
+        "embedding_50k", lambda e, i: jnp.take(e, i, axis=0), (e, ids),
+        bytes_moved=8 * 1024 * 768 * dt.itemsize, iters=iters))
+
+    sm_x = jnp.asarray(rng.randn(8192, 50304), dt)
+    results.append(bench_case(
+        "softmax_8192x50304", lambda x: paddle.nn.functional.softmax(x, -1),
+        (sm_x,), bytes_moved=2 * sm_x.size * dt.itemsize, iters=iters))
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("op", nargs="?", help="matmul | suite (default)")
+    ap.add_argument("--m", type=int, default=1024)
+    ap.add_argument("--n", type=int, default=1024)
+    ap.add_argument("--k", type=int, default=1024)
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=["float32", "bfloat16", "float16"])
+    args = ap.parse_args()
+    if args.op in (None, "suite"):
+        default_suite(args.dtype, iters=args.iters)
+        return
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    dt = jnp.dtype(args.dtype)
+    if args.op == "matmul":
+        a = jnp.asarray(rng.randn(args.m, args.k), dt)
+        b = jnp.asarray(rng.randn(args.k, args.n), dt)
+        bench_case(f"matmul_{args.m}x{args.k}x{args.n}_{args.dtype}",
+                   jnp.matmul, (a, b), flops=2 * args.m * args.k * args.n,
+                   iters=args.iters)
+    else:
+        raise SystemExit(f"unknown op {args.op!r} (use: matmul | suite)")
+
+
+if __name__ == "__main__":
+    main()
