@@ -20,3 +20,17 @@ def unique_name(prefix: str = "tmp") -> str:
 
 def reset():
     _namer.counters = {}
+
+
+class guard:
+    """Save/restore the counter state (reference unique_name.guard). Used by
+    the static tier so re-tracing a Program generates the SAME auto names
+    (otherwise every retrace would mint fresh fc_0 → fc_1 parameters)."""
+
+    def __enter__(self):
+        self._saved = dict(_namer.counters)
+        return self
+
+    def __exit__(self, *exc):
+        _namer.counters = self._saved
+        return False

@@ -65,18 +65,41 @@ class Program:
               static_batch: Optional[int] = None) -> "Program":
         """Capture ``fn(*arrays) -> output(s)`` as a Program. ``specs`` come
         from ``static.data`` (order = positional argument order)."""
+        from ..framework import naming
         prog = cls()
         prog._fn = fn
+        # auto-generated layer names must be IDENTICAL on every (re)trace of
+        # this program, or each trace would mint a fresh parameter set
+        prog._name_state = dict(naming._namer.counters)
         for i, s in enumerate(specs):
             name = s.name or f"x{i}"
             prog._specs[name] = s
         shapes = [s.to_shape_dtype(static_batch or 1) for s in specs]
-        prog._jaxpr = jax.make_jaxpr(fn)(*shapes)
-        outs = jax.eval_shape(fn, *shapes)
+        with prog._naming():
+            prog._jaxpr = jax.make_jaxpr(fn)(*shapes)
+        with prog._naming():
+            outs = jax.eval_shape(fn, *shapes)
         n_out = len(outs) if isinstance(outs, (tuple, list)) else 1
         prog._fetch_names = list(fetch_names or
                                  [f"fetch_{i}" for i in range(n_out)])
         return prog
+
+    def _naming(self):
+        """Context: run with the name counters this program was traced
+        under, restoring them after (so retraces reuse fc_0 not fc_1)."""
+        import contextlib
+        from ..framework import naming
+
+        @contextlib.contextmanager
+        def cm():
+            saved = dict(naming._namer.counters)
+            naming._namer.counters = dict(
+                getattr(self, "_name_state", saved))
+            try:
+                yield
+            finally:
+                naming._namer.counters = saved
+        return cm()
 
     # -- introspection (ProgramDesc analogues) ----------------------------
     @property
@@ -165,10 +188,22 @@ class Executor:
             raise KeyError(f"missing feed {e} (program feeds: "
                            f"{program.feed_names})") from None
         # compiled executable lives on the Program (an id()-keyed cache here
-        # could alias a new Program at a recycled address)
+        # could alias a new Program at a recycled address). Scope parameters
+        # enter as jit ARGUMENTS (not closure constants) so static.load /
+        # set_program_state take effect without retracing.
+        scope = global_scope()
         if program._compiled is None:
-            program._compiled = jax.jit(program._fn)
-        outs = program._compiled(*args)
+            def pure(state, *feed_args):
+                overlay = _OverlayScope(scope, state)
+                _scope_stack.append(overlay)
+                try:
+                    with program._naming():
+                        return program._fn(*feed_args)
+                finally:
+                    _scope_stack.pop()
+            program._compiled = jax.jit(pure)
+        state = _scope_state(scope)
+        outs = program._compiled(state, *args)
         if not isinstance(outs, (tuple, list)):
             outs = (outs,)
         if fetch_list:
@@ -312,6 +347,43 @@ class Scope:
 
 _global_scope = Scope()
 _scope_stack: List[Scope] = [_global_scope]
+
+
+class _OverlayScope(Scope):
+    """Trace-time view of a Scope: reads come from a (possibly traced) state
+    dict so parameters are jit inputs; writes (new-parameter creation during
+    trace, which _param keeps concrete) land in the base scope."""
+
+    def __init__(self, base: Scope, state: Dict[str, object]):
+        super().__init__(parent=base)
+        self._base = base
+        self._state = state
+
+    def find_var(self, name: str):
+        if name in self._state:
+            return self._state[name]
+        return self._base.find_var(name)
+
+    def var(self, name: str, value=None):
+        return self._base.var(name, value)
+
+    def local_var_names(self):
+        return list(self._state) + self._base.local_var_names()
+
+
+def _scope_state(scope: Scope) -> Dict[str, object]:
+    """Array-valued vars visible from ``scope`` (walking the parent chain)."""
+    state = {}
+    cur = scope
+    while cur is not None:
+        for k in cur.local_var_names():
+            if k not in state:
+                v = cur.find_var(k)
+                if v is not None and hasattr(v, "shape") and \
+                        hasattr(v, "dtype"):
+                    state[k] = v
+        cur = cur._parent
+    return state
 
 
 def global_scope() -> Scope:

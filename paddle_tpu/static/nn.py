@@ -421,17 +421,28 @@ def crf_decoding(input, transition, length=None, label=None, name=None):
     trans = jnp.asarray(transition)
     start, stop, pair = trans[0], trans[1], trans[2:]
     b, t, n = emis.shape
+    if length is None:
+        lens = jnp.full((b,), t)
+    else:
+        lens = jnp.asarray(length).reshape(-1)
 
-    def step(carry, e_t):
+    def step(carry, et_t):
+        e_t, t_idx = et_t
         alpha = carry  # (b, n)
         scores = alpha[:, :, None] + pair[None]  # (b, n_prev, n)
         best_prev = jnp.argmax(scores, axis=1)
         alpha_t = jnp.max(scores, axis=1) + e_t
+        # beyond a row's length: freeze alpha and thread identity backptrs so
+        # pad emissions cannot contaminate the valid prefix's backtrace
+        valid = (t_idx < lens)[:, None]
+        alpha_t = jnp.where(valid, alpha_t, alpha)
+        best_prev = jnp.where(valid, best_prev, jnp.arange(n)[None, :])
         return alpha_t, best_prev
 
     alpha0 = start[None] + emis[:, 0]
-    alpha_T, backptrs = jax.lax.scan(step, alpha0,
-                                     jnp.moveaxis(emis[:, 1:], 1, 0))
+    alpha_T, backptrs = jax.lax.scan(
+        step, alpha0, (jnp.moveaxis(emis[:, 1:], 1, 0),
+                       jnp.arange(1, t)))
     alpha_T = alpha_T + stop[None]
     last = jnp.argmax(alpha_T, axis=-1)  # (b,)
 
@@ -514,16 +525,18 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         confs.append(jnp.reshape(jnp.transpose(conf, (0, 2, 3, 1)),
                                  (feat.shape[0], -1, num_classes)))
         # prior boxes
-        sk = min_sizes[i] / img_w
-        sk2 = (max_sizes[i] / img_w) if max_sizes else sk
-        widths = [sk, float(np.sqrt(sk * sk2))]
-        heights = [sk, float(np.sqrt(sk * sk2))]
+        sk_w = min_sizes[i] / img_w
+        sk_h = min_sizes[i] / img_h
+        sk2_w = (max_sizes[i] / img_w) if max_sizes else sk_w
+        sk2_h = (max_sizes[i] / img_h) if max_sizes else sk_h
+        widths = [sk_w, float(np.sqrt(sk_w * sk2_w))]
+        heights = [sk_h, float(np.sqrt(sk_h * sk2_h))]
         for a in ar:
-            widths.append(sk * float(np.sqrt(a)))
-            heights.append(sk / float(np.sqrt(a)))
+            widths.append(sk_w * float(np.sqrt(a)))
+            heights.append(sk_h / float(np.sqrt(a)))
             if flip:
-                widths.append(sk / float(np.sqrt(a)))
-                heights.append(sk * float(np.sqrt(a)))
+                widths.append(sk_w / float(np.sqrt(a)))
+                heights.append(sk_h * float(np.sqrt(a)))
         cy, cx = np.meshgrid((np.arange(fh) + offset) / fh,
                              (np.arange(fw) + offset) / fw, indexing="ij")
         boxes_i = []
@@ -667,8 +680,6 @@ def sequence_unpad(x, length, name=None):
 
 
 def sequence_reshape(input, new_dim, name=None):
-    rows = int(np.prod(input.shape[:2]) * input.shape[-1] // new_dim) \
-        // input.shape[0] if input.ndim > 2 else None
     flat = jnp.reshape(input, (input.shape[0], -1))
     return jnp.reshape(flat, (input.shape[0], -1, new_dim))
 

@@ -258,3 +258,62 @@ def test_nce_fresh_negatives_eager():
     l2 = np.asarray(st.nn.nce(x, y, 50, name="nce_fresh"))
     # same weights, different sampled negatives -> different loss values
     assert not np.allclose(l1, l2)
+
+
+def test_executor_uses_loaded_state(tmp_path):
+    # regression: scope params must be jit INPUTS, so load/set_program_state
+    # changes the executed weights without retracing
+    scope = st.Scope()
+    with st.scope_guard(scope):
+        def net(x):
+            return st.nn.fc(x, 4, name="exec_fc", bias_attr=False)
+
+        prog = st.Program.trace(net, st.data("x", [2, 3]))
+        exe = st.Executor()
+        feed = {"x": np.ones((2, 3), "float32")}
+        out1 = exe.run(prog, feed=feed)[0]
+        scope.var("exec_fc.w_0", jnp.zeros((3, 4), jnp.float32))
+        out2 = exe.run(prog, feed=feed)[0]
+        np.testing.assert_allclose(out2, np.zeros((2, 4)))
+        assert not np.allclose(out1, out2)
+
+
+def test_unnamed_layers_stable_across_retrace(tmp_path):
+    # regression: auto-named params must be identical on every retrace
+    scope = st.Scope()
+    with st.scope_guard(scope):
+        def net(x):
+            return st.nn.fc(x, 4)  # no explicit name
+
+        prog = st.Program.trace(net, st.data("x", [2, 3]))
+        names_after_trace = set(scope.local_var_names())
+        exe = st.Executor()
+        exe.run(prog, feed={"x": np.ones((2, 3), "float32")})
+        assert set(scope.local_var_names()) == names_after_trace
+
+
+def test_crf_decoding_respects_length():
+    rng = np.random.RandomState(3)
+    n = 4
+    for _ in range(10):
+        emis = rng.randn(2, 6, n).astype("float32")
+        trans = rng.randn(n + 2, n).astype("float32")
+        full = np.asarray(st.nn.crf_decoding(emis[:1, :3], trans))
+        masked = np.asarray(st.nn.crf_decoding(
+            np.concatenate([emis[:1], emis[1:]], 0), trans,
+            length=np.array([3, 6])))
+        np.testing.assert_array_equal(masked[0, :3], full[0])
+
+
+def test_multi_box_head_nonsquare_heights():
+    feats = [paddle.to_tensor(np.zeros((1, 4, 4, 4), dtype="float32"))]
+    img = paddle.to_tensor(np.zeros((1, 3, 100, 200), dtype="float32"))
+    _, _, boxes, _ = st.nn.multi_box_head(
+        feats, img, base_size=100, num_classes=2, aspect_ratios=[[1.0]],
+        min_sizes=[20.0], max_sizes=[40.0], flip=False, name="mbh_ns")
+    b = np.asarray(boxes)
+    # first prior of the first cell: square min_size box
+    w = b[0, 2] - b[0, 0]
+    h = b[0, 3] - b[0, 1]
+    assert abs(w - 20.0 / 200) < 1e-6
+    assert abs(h - 20.0 / 100) < 1e-6
