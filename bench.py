@@ -16,7 +16,6 @@ import numpy as np
 
 def main():
     import jax
-    import jax.numpy as jnp
 
     import paddle_tpu as paddle
     from paddle_tpu import nn
@@ -43,8 +42,10 @@ def main():
     opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters())
 
     def loss_fn(logits, labels):
-        return nn.functional.cross_entropy(
-            logits.astype(jnp.float32), labels)
+        # bf16 logits straight into the fused lse-gather CE fast path
+        # (fp32 accumulation happens inside; an astype here would
+        # materialize a full fp32 (b, s, vocab) tensor)
+        return nn.functional.cross_entropy(logits, labels)
 
     trainer = ParallelTrainer(model, opt, loss_fn)
     rng = np.random.RandomState(0)

@@ -23,14 +23,27 @@ def reset():
 
 
 class guard:
-    """Save/restore the counter state (reference unique_name.guard). Used by
-    the static tier so re-tracing a Program generates the SAME auto names
-    (otherwise every retrace would mint fresh fc_0 → fc_1 parameters)."""
+    """Counter save/restore (reference unique_name.guard). The static tier
+    replays a Program's trace-time counters on retrace so auto names stay
+    stable (fc_0 stays fc_0 instead of minting fc_1).
+
+    ``initial``: counters to install on enter (default: keep current).
+    ``commit``: if True, keep the advanced counters on exit (first trace of
+    a program must advance the global namer or the NEXT program traced would
+    collide on the same names); if False, restore the previous state
+    (retraces must not re-advance)."""
+
+    def __init__(self, initial=None, commit=False):
+        self._initial = initial
+        self._commit = commit
 
     def __enter__(self):
         self._saved = dict(_namer.counters)
+        if self._initial is not None:
+            _namer.counters = dict(self._initial)
         return self
 
     def __exit__(self, *exc):
-        _namer.counters = self._saved
+        if not self._commit:
+            _namer.counters = self._saved
         return False

@@ -75,7 +75,9 @@ class Program:
             name = s.name or f"x{i}"
             prog._specs[name] = s
         shapes = [s.to_shape_dtype(static_batch or 1) for s in specs]
-        with prog._naming():
+        # first trace COMMITS its counter advance (the next program traced
+        # must not collide on fc_0); replays below restore
+        with naming.guard(initial=prog._name_state, commit=True):
             prog._jaxpr = jax.make_jaxpr(fn)(*shapes)
         with prog._naming():
             outs = jax.eval_shape(fn, *shapes)
@@ -85,21 +87,11 @@ class Program:
         return prog
 
     def _naming(self):
-        """Context: run with the name counters this program was traced
-        under, restoring them after (so retraces reuse fc_0 not fc_1)."""
-        import contextlib
+        """Replay the trace-time name counters (restoring after), so
+        retraces bind fc_0 to the same parameters instead of minting fc_1."""
         from ..framework import naming
-
-        @contextlib.contextmanager
-        def cm():
-            saved = dict(naming._namer.counters)
-            naming._namer.counters = dict(
-                getattr(self, "_name_state", saved))
-            try:
-                yield
-            finally:
-                naming._namer.counters = saved
-        return cm()
+        return naming.guard(
+            initial=getattr(self, "_name_state", None), commit=False)
 
     # -- introspection (ProgramDesc analogues) ----------------------------
     @property
@@ -192,7 +184,13 @@ class Executor:
         # enter as jit ARGUMENTS (not closure constants) so static.load /
         # set_program_state take effect without retracing.
         scope = global_scope()
-        if program._compiled is None:
+        # compiled cache is keyed by the scope OBJECT: the jitted closure
+        # binds one base scope (for new-parameter writes at trace time), so
+        # running under a different scope_guard must compile a fresh entry
+        if not isinstance(program._compiled, dict):
+            program._compiled = {}
+        entry = program._compiled.get(id(scope))
+        if entry is None or entry[0] is not scope:
             def pure(state, *feed_args):
                 overlay = _OverlayScope(scope, state)
                 _scope_stack.append(overlay)
@@ -201,9 +199,10 @@ class Executor:
                         return program._fn(*feed_args)
                 finally:
                     _scope_stack.pop()
-            program._compiled = jax.jit(pure)
+            entry = (scope, jax.jit(pure))
+            program._compiled[id(scope)] = entry
         state = _scope_state(scope)
-        outs = program._compiled(state, *args)
+        outs = entry[1](state, *args)
         if not isinstance(outs, (tuple, list)):
             outs = (outs,)
         if fetch_list:

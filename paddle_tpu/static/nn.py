@@ -680,8 +680,7 @@ def sequence_unpad(x, length, name=None):
 
 
 def sequence_reshape(input, new_dim, name=None):
-    flat = jnp.reshape(input, (input.shape[0], -1))
-    return jnp.reshape(flat, (input.shape[0], -1, new_dim))
+    return jnp.reshape(input, (input.shape[0], -1, new_dim))
 
 
 def sequence_scatter(input, index, updates, length=None, name=None):

@@ -317,3 +317,31 @@ def test_multi_box_head_nonsquare_heights():
     h = b[0, 3] - b[0, 1]
     assert abs(w - 20.0 / 200) < 1e-6
     assert abs(h - 20.0 / 100) < 1e-6
+
+
+def test_two_programs_do_not_collide_on_auto_names():
+    # regression: first trace must COMMIT its name-counter advance
+    scope = st.Scope()
+    with st.scope_guard(scope):
+        p1 = st.Program.trace(lambda x: st.nn.fc(x, 4), st.data("x", [2, 3]))
+        p2 = st.Program.trace(lambda x: st.nn.fc(x, 8), st.data("x", [2, 5]))
+        o1 = st.Executor().run(p1, feed={"x": np.ones((2, 3), "float32")})[0]
+        o2 = st.Executor().run(p2, feed={"x": np.ones((2, 5), "float32")})[0]
+        assert o1.shape == (2, 4) and o2.shape == (2, 8)
+
+
+def test_executor_rebinds_to_current_scope():
+    # regression: compiled cache must be per-scope, not first-scope-wins
+    a, b = st.Scope(), st.Scope()
+    feed = {"x": np.ones((2, 3), "float32")}
+    with st.scope_guard(a):
+        prog = st.Program.trace(
+            lambda x: st.nn.fc(x, 4, name="sc_fc", bias_attr=False),
+            st.data("x", [2, 3]))
+        exe = st.Executor()
+        out_a = exe.run(prog, feed=feed)[0]
+    with st.scope_guard(b):
+        b.var("sc_fc.w_0", jnp.zeros((3, 4), jnp.float32))
+        out_b = exe.run(prog, feed=feed)[0]
+    np.testing.assert_allclose(out_b, np.zeros((2, 4)))
+    assert not np.allclose(out_a, out_b)
