@@ -175,4 +175,5 @@ distributed_model = fleet.distributed_model
 distributed_optimizer = fleet.distributed_optimizer
 get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
 from . import elastic  # noqa: F401,E402
+from . import fs  # noqa: F401
 from .elastic import ElasticManager, ElasticStatus  # noqa: F401,E402

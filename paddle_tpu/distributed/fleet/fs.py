@@ -1,0 +1,220 @@
+"""Filesystem clients for checkpoint/dataset IO.
+
+Reference: python/paddle/distributed/fleet/utils/fs.py — ``LocalFS`` and
+``HDFSClient`` with a common surface (ls_dir/is_file/is_dir/is_exist/upload/
+download/mkdirs/delete/touch/mv/list_dirs), used by auto-checkpoint (C45)
+and dataset ingest.
+
+TPU translation: on Cloud TPU the shared store is GCS/NFS mounted paths, so
+``LocalFS`` covers the POSIX case; ``HDFSClient`` keeps the reference
+surface and shells out to a configured ``hadoop`` binary when one exists
+(zero-egress boxes won't have one — constructing is fine, operations raise
+with a clear error).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+__all__ = ["FS", "LocalFS", "HDFSClient"]
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FS:
+    """Abstract surface (reference fs.py FS)."""
+
+    def ls_dir(self, path):
+        raise NotImplementedError
+
+    def is_file(self, path):
+        raise NotImplementedError
+
+    def is_dir(self, path):
+        raise NotImplementedError
+
+    def is_exist(self, path):
+        raise NotImplementedError
+
+    def mkdirs(self, path):
+        raise NotImplementedError
+
+    def delete(self, path):
+        raise NotImplementedError
+
+    def touch(self, path, exist_ok=True):
+        raise NotImplementedError
+
+    def mv(self, src, dst, overwrite=False):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """reference fs.py LocalFS — POSIX filesystem client."""
+
+    def ls_dir(self, path) -> Tuple[List[str], List[str]]:
+        if not self.is_exist(path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, name))
+             else files).append(name)
+        return dirs, files
+
+    def is_file(self, path) -> bool:
+        return os.path.isfile(path)
+
+    def is_dir(self, path) -> bool:
+        return os.path.isdir(path)
+
+    def is_exist(self, path) -> bool:
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if self.is_dir(path):
+            shutil.rmtree(path)
+        elif self.is_file(path):
+            os.remove(path)
+
+    def touch(self, path, exist_ok=True):
+        if self.is_exist(path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(path)
+        with open(path, "a"):
+            pass
+
+    def mv(self, src, dst, overwrite=False, test_exists=True):
+        if test_exists and not self.is_exist(src):
+            raise FSFileNotExistsError(src)
+        if self.is_exist(dst):
+            if not overwrite:
+                raise FSFileExistsError(dst)
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def list_dirs(self, path) -> List[str]:
+        return self.ls_dir(path)[0]
+
+    def upload(self, local_path, fs_path):
+        if self.is_dir(local_path):
+            shutil.copytree(local_path, fs_path)
+        else:
+            shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+
+class HDFSClient(FS):
+    """reference fs.py HDFSClient — shells out to ``hadoop fs`` commands.
+
+    Keeps the constructor surface (hadoop_home, configs). On hosts without a
+    hadoop install, constructing succeeds (so imports and configs parse) and
+    operations raise ExecuteError with a clear message.
+    """
+
+    def __init__(self, hadoop_home: Optional[str] = None, configs=None,
+                 time_out=5 * 60 * 1000, sleep_inter=1000):
+        self._hadoop = None
+        hadoop_home = hadoop_home or os.environ.get("HADOOP_HOME")
+        if hadoop_home:
+            cand = os.path.join(hadoop_home, "bin", "hadoop")
+            if os.path.exists(cand):
+                self._hadoop = cand
+        self._config_args = []
+        for k, v in (configs or {}).items():
+            self._config_args += ["-D", f"{k}={v}"]
+        # retry budget for transient namenode failures (reference client
+        # semantics): total time_out ms, sleep_inter ms between attempts
+        self._time_out = time_out / 1000.0
+        self._sleep_inter = sleep_inter / 1000.0
+
+    def _run(self, *cmd, retry: bool = True) -> str:
+        if self._hadoop is None:
+            raise ExecuteError(
+                "no hadoop binary found (set hadoop_home or $HADOOP_HOME); "
+                "on Cloud TPU use LocalFS over a mounted GCS/NFS path")
+        import time as _time
+        deadline = _time.time() + (self._time_out if retry else 0.0)
+        while True:
+            out = subprocess.run(
+                [self._hadoop, "fs", *self._config_args, *cmd],
+                capture_output=True, text=True)
+            if out.returncode == 0:
+                return out.stdout
+            if not retry or _time.time() + self._sleep_inter >= deadline:
+                raise ExecuteError(out.stderr.strip())
+            _time.sleep(self._sleep_inter)
+
+    def ls_dir(self, path):
+        dirs, files = [], []
+        for line in self._run("-ls", path).splitlines():
+            # 7 fixed fields precede the path; maxsplit keeps names with
+            # spaces intact
+            parts = line.split(None, 7)
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[7])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def _test(self, flag, path) -> bool:
+        # misconfiguration (no hadoop) must RAISE, not read as "absent" —
+        # checkpoint logic would otherwise silently re-train/overwrite
+        if self._hadoop is None:
+            raise ExecuteError(
+                "no hadoop binary found (set hadoop_home or $HADOOP_HOME)")
+        try:
+            self._run("-test", flag, path, retry=False)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_exist(self, path) -> bool:
+        return self._test("-e", path)
+
+    def is_file(self, path) -> bool:
+        return self._test("-f", path)
+
+    def is_dir(self, path) -> bool:
+        return self._test("-d", path)
+
+    def mkdirs(self, path):
+        self._run("-mkdir", "-p", path)
+
+    def delete(self, path):
+        self._run("-rm", "-r", "-f", path)
+
+    def touch(self, path, exist_ok=True):
+        if self.is_exist(path):
+            if exist_ok:
+                return  # -touchz fails on non-empty existing files
+            raise FSFileExistsError(path)
+        self._run("-touchz", path)
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        self._run("-mv", src, dst)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)

@@ -1,0 +1,76 @@
+"""Tests for fleet.fs (LocalFS/HDFSClient surface — reference
+fleet/utils/fs.py) and framework.io_crypto (model encryption — reference
+framework/io/crypto/)."""
+import os
+
+import pytest
+
+from paddle_tpu.distributed.fleet.fs import (ExecuteError, FSFileExistsError,
+                                             HDFSClient, LocalFS)
+from paddle_tpu.framework.io_crypto import (Cipher, CipherFactory,
+                                            decrypt_bytes, encrypt_bytes)
+
+
+def test_localfs_roundtrip(tmp_path):
+    fs = LocalFS()
+    d = str(tmp_path / "d")
+    fs.mkdirs(d)
+    assert fs.is_dir(d) and fs.is_exist(d)
+    f = os.path.join(d, "a.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    with pytest.raises(FSFileExistsError):
+        fs.touch(f, exist_ok=False)
+    dirs, files = fs.ls_dir(d)
+    assert files == ["a.txt"] and dirs == []
+    f2 = os.path.join(d, "b.txt")
+    fs.mv(f, f2)
+    assert fs.is_file(f2) and not fs.is_exist(f)
+    fs.delete(f2)
+    assert not fs.is_exist(f2)
+    assert fs.ls_dir(str(tmp_path / "missing")) == ([], [])
+
+
+def test_localfs_upload_download(tmp_path):
+    fs = LocalFS()
+    src = str(tmp_path / "src.bin")
+    with open(src, "wb") as f:
+        f.write(b"payload")
+    dst = str(tmp_path / "dst.bin")
+    fs.upload(src, dst)
+    assert open(dst, "rb").read() == b"payload"
+
+
+def test_hdfs_client_without_hadoop():
+    c = HDFSClient()  # constructing must work on hadoop-less hosts
+    with pytest.raises(ExecuteError):
+        c.mkdirs("/tmp/x")
+    # misconfiguration must surface, not read as "absent"
+    with pytest.raises(ExecuteError):
+        c.is_exist("/tmp/x")
+
+
+def test_crypto_roundtrip_and_tamper():
+    key = CipherFactory.generate_key()
+    data = os.urandom(1000) + b"params"
+    blob = encrypt_bytes(data, key)
+    assert blob != data and data not in blob
+    assert decrypt_bytes(blob, key) == data
+    # wrong key
+    with pytest.raises(ValueError):
+        decrypt_bytes(blob, CipherFactory.generate_key())
+    # tamper
+    bad = blob[:-1] + bytes([blob[-1] ^ 1])
+    with pytest.raises(ValueError):
+        decrypt_bytes(bad, key)
+    with pytest.raises(ValueError):
+        decrypt_bytes(b"garbage", key)
+
+
+def test_cipher_file_roundtrip(tmp_path):
+    c = Cipher()
+    path = str(tmp_path / "model.enc")
+    c.encrypt_to_file(b"model-bytes", path)
+    assert c.decrypt_from_file(path) == b"model-bytes"
+    # at rest the plaintext is absent
+    assert b"model-bytes" not in open(path, "rb").read()
