@@ -145,7 +145,7 @@ class HDFSClient(FS):
         self._time_out = time_out / 1000.0
         self._sleep_inter = sleep_inter / 1000.0
 
-    def _run(self, *cmd, retry: bool = True) -> str:
+    def _run(self, *cmd, retry: bool = True):
         if self._hadoop is None:
             raise ExecuteError(
                 "no hadoop binary found (set hadoop_home or $HADOOP_HOME); "
@@ -159,8 +159,20 @@ class HDFSClient(FS):
             if out.returncode == 0:
                 return out.stdout
             if not retry or _time.time() + self._sleep_inter >= deadline:
-                raise ExecuteError(out.stderr.strip())
+                raise ExecuteError(out.stderr.strip() or
+                                   f"hadoop fs {' '.join(cmd)} failed "
+                                   f"(exit {out.returncode})")
             _time.sleep(self._sleep_inter)
+
+    def _run_raw(self, *cmd):
+        """Single attempt; returns (returncode, stderr)."""
+        if self._hadoop is None:
+            raise ExecuteError(
+                "no hadoop binary found (set hadoop_home or $HADOOP_HOME)")
+        out = subprocess.run(
+            [self._hadoop, "fs", *self._config_args, *cmd],
+            capture_output=True, text=True)
+        return out.returncode, out.stderr.strip()
 
     def ls_dir(self, path):
         dirs, files = [], []
@@ -175,16 +187,16 @@ class HDFSClient(FS):
         return dirs, files
 
     def _test(self, flag, path) -> bool:
-        # misconfiguration (no hadoop) must RAISE, not read as "absent" —
-        # checkpoint logic would otherwise silently re-train/overwrite
-        if self._hadoop is None:
-            raise ExecuteError(
-                "no hadoop binary found (set hadoop_home or $HADOOP_HOME)")
-        try:
-            self._run("-test", flag, path, retry=False)
+        # Only a clean 'hadoop fs -test' exit 1 with no stderr means "path
+        # absent". Infra failures (namenode down, auth, bad configs) emit
+        # stderr or exotic exit codes and must RAISE — reading them as
+        # "absent" would make checkpoint logic silently re-train/overwrite.
+        rc, err = self._run_raw("-test", flag, path)
+        if rc == 0:
             return True
-        except ExecuteError:
+        if rc == 1 and not err:
             return False
+        raise ExecuteError(err or f"hadoop fs -test exited {rc}")
 
     def is_exist(self, path) -> bool:
         return self._test("-e", path)
@@ -208,10 +220,13 @@ class HDFSClient(FS):
             raise FSFileExistsError(path)
         self._run("-touchz", path)
 
-    def mv(self, src, dst, overwrite=False):
+    def mv(self, src, dst, overwrite=False, test_exists=True):
+        if test_exists and not self.is_exist(src):
+            raise FSFileNotExistsError(src)
         if overwrite and self.is_exist(dst):
             self.delete(dst)
-        self._run("-mv", src, dst)
+        # missing-src failures are permanent; don't burn the retry budget
+        self._run("-mv", src, dst, retry=False)
 
     def upload(self, local_path, fs_path):
         self._run("-put", local_path, fs_path)

@@ -26,16 +26,24 @@ _NONCE = 16
 _TAG = 32
 
 
-def _keystream(key: bytes, nonce: bytes, n: int) -> bytes:
-    # one extendable-output call generates the whole stream in C
-    return hashlib.shake_256(key + nonce).digest(n)
+_CHUNK = 64 * 1024 * 1024
 
 
-def _xor(a: bytes, b: bytes) -> bytes:
-    # big-int XOR: C-level, no per-byte Python loop
-    n = len(a)
-    return (int.from_bytes(a, "little")
-            ^ int.from_bytes(b, "little")).to_bytes(n, "little")
+def _xor_stream(data: bytes, key: bytes, nonce: bytes) -> bytes:
+    """XOR ``data`` with the SHAKE-256 keystream in bounded chunks: numpy
+    bitwise_xor per 64MB block keeps peak memory ~1 chunk above the output
+    (a whole-buffer big-int XOR would peak at ~5x the plaintext)."""
+    import numpy as _np
+    out = bytearray(len(data))
+    view = memoryview(data)
+    for off in range(0, len(data), _CHUNK):
+        block = view[off:off + _CHUNK]
+        ks = hashlib.shake_256(
+            key + nonce + off.to_bytes(8, "little")).digest(len(block))
+        out[off:off + _CHUNK] = _np.bitwise_xor(
+            _np.frombuffer(block, dtype=_np.uint8),
+            _np.frombuffer(ks, dtype=_np.uint8)).tobytes()
+    return bytes(out)
 
 
 def _derive(key: bytes, purpose: bytes) -> bytes:
@@ -46,7 +54,7 @@ def encrypt_bytes(data: bytes, key: bytes) -> bytes:
     nonce = os.urandom(_NONCE)
     enc_key = _derive(key, b"enc")
     mac_key = _derive(key, b"mac")
-    ct = _xor(data, _keystream(enc_key, nonce, len(data)))
+    ct = _xor_stream(data, enc_key, nonce)
     tag = hmac.new(mac_key, nonce + ct, hashlib.sha256).digest()
     return _MAGIC + nonce + ct + tag
 
@@ -62,7 +70,7 @@ def decrypt_bytes(blob: bytes, key: bytes) -> bytes:
     if not hmac.compare_digest(tag, expect):
         raise ValueError("decryption failed: wrong key or corrupted data")
     enc_key = _derive(key, b"enc")
-    return _xor(ct, _keystream(enc_key, nonce, len(ct)))
+    return _xor_stream(ct, enc_key, nonce)
 
 
 def encrypt_file(src: str, dst: str, key: bytes):

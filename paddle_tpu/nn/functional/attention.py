@@ -40,11 +40,11 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  name=None):
     """query/key/value: (batch, seq, num_heads, head_dim)."""
     from ...ops.pallas.flash_attention import flash_attention, flash_supported
-    # Crossover measured on TPU (tools/op_bench.py): XLA's fused attention
-    # wins fwd+bwd up to seq~2048; the Pallas kernel wins beyond (3.7x at
-    # 4096) and is O(S) memory. Use flash only where it pays.
+    # Measured on-chip with the swept (256, 512) kernel blocks: flash wins
+    # fwd+bwd from seq>=1024 (17.3 vs 21.7 ms at 1024; 3.7x at 4096) and is
+    # O(S) memory. Below that the S x S XLA attention is cheap enough.
     use_flash = (attn_mask is None and dropout_p == 0.0 and
-                 flash_supported(query, key, min_seq=2048))
+                 flash_supported(query, key, min_seq=1024))
     if use_flash:
         try:
             return flash_attention(query, key, value, causal=is_causal)

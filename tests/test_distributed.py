@@ -293,7 +293,8 @@ class TestFlashAttentionInterpret:
         k = jnp.asarray(rs.randn(1, 256, 2, 64), dtype=jnp.float32)
         v = jnp.asarray(rs.randn(1, 256, 2, 64), dtype=jnp.float32)
         for causal in (False, True):
-            out = flash_attention(q, k, v, causal=causal, interpret=True)
+            out = flash_attention(q, k, v, causal=causal, interpret=True,
+                                  block_q=128, block_k=128)
             ref = _xla_attention(q, k, v, causal=causal)
             np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                        rtol=1e-4, atol=1e-4)
@@ -306,7 +307,7 @@ class TestFlashAttentionInterpret:
         k = jnp.asarray(rs.randn(1, 128, 1, 64), dtype=jnp.float32)
         v = jnp.asarray(rs.randn(1, 128, 1, 64), dtype=jnp.float32)
         gf = jax.grad(lambda a, b, c: jnp.sum(
-            flash_attention(a, b, c, causal=True, interpret=True) ** 2),
+            flash_attention(a, b, c, causal=True, interpret=True, block_q=128, block_k=128) ** 2),
             argnums=(0, 1, 2))(q, k, v)
         gr = jax.grad(lambda a, b, c: jnp.sum(
             _xla_attention(a, b, c, causal=True) ** 2),
