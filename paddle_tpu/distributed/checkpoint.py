@@ -120,10 +120,14 @@ class CheckpointManager:
 
 
 class TrainEpochRange:
-    """Transparent epoch-level auto-checkpoint/resume
-    (reference: incubate/checkpoint/auto_checkpoint.py:265 TrainEpochRange —
-    snapshots exe/program state per epoch keyed by job id, so a preempted job
-    relaunched with the same id continues where it stopped).
+    """Manual epoch-level checkpoint/resume over CheckpointManager.
+
+    This is the explicit-control variant: the caller decides when to
+    ``save``. The reference-faithful env-gated variant (PADDLE_JOB_ID
+    activation, save-interval seconds, add_state registration) is
+    ``incubate.checkpoint.auto_checkpoint.TrainEpochRange``, which builds on
+    the same CheckpointManager — use that one for transparent resume
+    (reference: incubate/checkpoint/auto_checkpoint.py:265).
 
     Usage::
 

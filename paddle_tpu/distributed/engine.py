@@ -88,10 +88,28 @@ class ParallelTrainer:
             return jax.device_put(jnp.array(v, copy=True),
                                   NamedSharding(self.mesh, spec))
 
+        n_shard = self.mesh.shape.get("sharding", 1)
+        # ZeRO-3: shard parameter STORAGE over the "sharding" axis. Inside
+        # the step each shard is all-gathered right before use (and the
+        # gather's transpose reduce-scatters the grad), so per-device param
+        # memory is 1/n_shard — the sharding_optimizer.py:43 capability done
+        # the GSPMD way. Params already sharded by ShardingParallel stage 3
+        # (pspec on the sharding axis) are honored too.
+        if self.zero_stage >= 3 and n_shard > 1:
+            from .meta_parallel.sharding_parallel import shard_spec_for
+            for k in list(self.param_specs):
+                if self.trainable[k] and self.param_specs[k] == P():
+                    self.param_specs[k] = shard_spec_for(params[k], n_shards=n_shard)
+        self.zero3_dims = {}
+        if n_shard > 1:
+            for k, spec in self.param_specs.items():
+                for d, ax in enumerate(spec):
+                    if ax == "sharding" or (isinstance(ax, tuple)
+                                            and "sharding" in ax):
+                        self.zero3_dims[k] = d
         params = OrderedDict((k, put(v, self.param_specs[k]))
                              for k, v in params.items())
         buffers = OrderedDict((k, put(v, P())) for k, v in buffers.items())
-        n_shard = self.mesh.shape.get("sharding", 1)
         if self.zero_stage >= 1 and n_shard > 1:
             self.opt_specs = opt_state_shardings(opt_state, n_shard)
         else:
@@ -127,6 +145,9 @@ class ParallelTrainer:
             out, _ = fwd(model, params, buffers, inputs, rng=key)
             return loss_fn(out, labels)
 
+        zero3_dims = self.zero3_dims
+        n_shard = mesh.shape.get("sharding", 1)
+
         def grads_fn(params, buffers, key, inputs, labels):
             tparams = {k: v for k, v in params.items() if self.trainable[k]}
             frozen = {k: v for k, v in params.items() if not self.trainable[k]}
@@ -134,6 +155,11 @@ class ParallelTrainer:
             def lf(tp):
                 merged = dict(frozen)
                 merged.update(tp)
+                # ZeRO-3 storage shards -> full params for this step's
+                # compute; the all_gather transpose reduce-scatters grads
+                for k, d in zero3_dims.items():
+                    merged[k] = lax.all_gather(merged[k], "sharding",
+                                               axis=d, tiled=True)
                 loss = local_loss(merged, buffers, key, inputs, labels)
                 # mean over the data axes (each device saw 1/N of the batch)
                 for ax in DATA_AXES:
@@ -144,10 +170,18 @@ class ParallelTrainer:
             loss, grads = jax.value_and_grad(lf)(tparams)
             # DP grad averaging (pmean over data axes); 'model'/'pipe' grads
             # are handled by shard_map transposition of the collectives.
-            for ax in DATA_AXES:
-                if mesh.shape.get(ax, 1) > 1:
-                    grads = jax.tree_util.tree_map(
-                        lambda g: lax.pmean(g, ax), grads)
+            # ZeRO-3 leaves already carry the SUM over the sharding axis
+            # (all_gather transpose = reduce-scatter): divide for the mean
+            # and only pmean over the remaining data axes.
+            for k in grads:
+                if k in zero3_dims:
+                    grads[k] = grads[k] / n_shard
+                    if mesh.shape.get("data", 1) > 1:
+                        grads[k] = lax.pmean(grads[k], "data")
+                else:
+                    for ax in DATA_AXES:
+                        if mesh.shape.get(ax, 1) > 1:
+                            grads[k] = lax.pmean(grads[k], ax)
             return loss, grads
 
         tspecs = OrderedDict((k, s) for k, s in self.param_specs.items()

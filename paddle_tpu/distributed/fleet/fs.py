@@ -187,14 +187,15 @@ class HDFSClient(FS):
         return dirs, files
 
     def _test(self, flag, path) -> bool:
-        # Only a clean 'hadoop fs -test' exit 1 with no stderr means "path
-        # absent". Infra failures (namenode down, auth, bad configs) emit
-        # stderr or exotic exit codes and must RAISE — reading them as
-        # "absent" would make checkpoint logic silently re-train/overwrite.
+        # 'hadoop fs -test' contract: exit 0 = true, exit 1 = false; any
+        # other exit is an infra failure (namenode down, auth, bad configs)
+        # and must RAISE — reading it as "absent" would make checkpoint
+        # logic silently re-train/overwrite. stderr alone is NOT a failure
+        # signal (hadoop prints benign native-loader/log4j warnings there).
         rc, err = self._run_raw("-test", flag, path)
         if rc == 0:
             return True
-        if rc == 1 and not err:
+        if rc == 1:
             return False
         raise ExecuteError(err or f"hadoop fs -test exited {rc}")
 

@@ -8,9 +8,10 @@ TPU translation: pure-stdlib authenticated stream cipher (SHAKE-256
 keystream, HMAC-SHA256 tag, encrypt-then-MAC). No external crypto
 dependency is baked into the image, so AES-NI is traded for a stdlib
 construction with the same API shape and at-rest-confidentiality purpose.
-Keystream generation and XOR are single C-level calls (shake digest +
-big-int XOR), so multi-hundred-MB checkpoints encrypt at memory speed.
-Format: ``magic || nonce(16) || ciphertext || tag(32)``.
+The keystream is generated per 64MB chunk (SHAKE-256 over
+key||nonce||chunk_offset — offset domain separation) and XORed via numpy,
+bounding peak memory to ~one chunk above the output while staying at
+C speed. Format: ``magic || nonce(16) || ciphertext || tag(32)``.
 """
 from __future__ import annotations
 
@@ -21,7 +22,8 @@ import os
 __all__ = ["Cipher", "CipherFactory", "encrypt_bytes", "decrypt_bytes",
            "encrypt_file", "decrypt_file"]
 
-_MAGIC = b"PTPUENC1"
+_MAGIC = b"PTPUENC2"  # v2: chunked offset-keyed keystream
+_MAGIC_V1 = b"PTPUENC1"  # pre-release whole-buffer keystream (unsupported)
 _NONCE = 16
 _TAG = 32
 
@@ -60,6 +62,13 @@ def encrypt_bytes(data: bytes, key: bytes) -> bytes:
 
 
 def decrypt_bytes(blob: bytes, key: bytes) -> bytes:
+    if blob.startswith(_MAGIC_V1):
+        # v1 used a different keystream derivation; XORing with the v2
+        # stream would return garbage that still passes the (ciphertext)
+        # MAC — fail loudly instead
+        raise ValueError(
+            "blob uses the pre-release PTPUENC1 format, which this version "
+            "no longer decrypts — re-encrypt with the current release")
     if not blob.startswith(_MAGIC):
         raise ValueError("not an encrypted paddle_tpu blob")
     nonce = blob[len(_MAGIC):len(_MAGIC) + _NONCE]

@@ -47,6 +47,8 @@ class TrainEpochRange:
     """reference auto_checkpoint.py:265. Iterate epochs; on entry restores
     the newest snapshot and resumes after its epoch; saves every
     ``save_checkpoint_inter`` seconds (and on the final epoch).
+    Storage is distributed.checkpoint.CheckpointManager (shared with the
+    manual distributed.checkpoint.TrainEpochRange variant).
 
     The caller registers state via ``add_state(get_fn, set_fn)`` — get_fn
     returns the pytree to snapshot, set_fn restores it.
