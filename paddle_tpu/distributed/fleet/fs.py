@@ -186,18 +186,28 @@ class HDFSClient(FS):
             (dirs if parts[0].startswith("d") else files).append(name)
         return dirs, files
 
+    # stderr lines that do NOT indicate failure: hadoop prints these on
+    # every invocation on common installs
+    _BENIGN_STDERR = ("WARN", "SLF4J", "log4j", "Unable to load native",
+                      "DeprecationWarning", "deprecated")
+
     def _test(self, flag, path) -> bool:
-        # 'hadoop fs -test' contract: exit 0 = true, exit 1 = false; any
-        # other exit is an infra failure (namenode down, auth, bad configs)
-        # and must RAISE — reading it as "absent" would make checkpoint
-        # logic silently re-train/overwrite. stderr alone is NOT a failure
-        # signal (hadoop prints benign native-loader/log4j warnings there).
+        # FsShell exits 1 BOTH for "test is false" and for most runtime
+        # errors (connection refused, auth failure — printed to stderr as
+        # 'test: ...'). Misreading an infra failure as "absent" would make
+        # checkpoint logic silently re-train/overwrite, so on exit 1 the
+        # stderr is scanned: benign warning lines are ignored, anything
+        # else (the FsShell error line) raises.
         rc, err = self._run_raw("-test", flag, path)
         if rc == 0:
             return True
-        if rc == 1:
+        real_errors = [ln for ln in err.splitlines()
+                       if ln.strip() and not any(b in ln
+                                                 for b in self._BENIGN_STDERR)]
+        if rc == 1 and not real_errors:
             return False
-        raise ExecuteError(err or f"hadoop fs -test exited {rc}")
+        raise ExecuteError("\n".join(real_errors)
+                           or f"hadoop fs -test exited {rc}")
 
     def is_exist(self, path) -> bool:
         return self._test("-e", path)

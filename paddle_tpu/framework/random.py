@@ -22,9 +22,24 @@ import jax
 
 
 class _RNGState(threading.local):
+    """Global PRNG key holder. The key is created LAZILY: materializing it
+    in __init__ would initialize the jax backend at ``import paddle_tpu``
+    time (slow on a tunneled TPU, and wrong for launcher subprocesses that
+    only read env vars)."""
+
     def __init__(self):
-        self.key = jax.random.key(0)
+        self._key = None
         self.scoped: list = []  # stack of (key, counter) for rng_guard scopes
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.key(0)
+        return self._key
+
+    @key.setter
+    def key(self, v):
+        self._key = v
 
 
 _state = _RNGState()

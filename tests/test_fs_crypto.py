@@ -74,3 +74,26 @@ def test_cipher_file_roundtrip(tmp_path):
     assert c.decrypt_from_file(path) == b"model-bytes"
     # at rest the plaintext is absent
     assert b"model-bytes" not in open(path, "rb").read()
+
+
+def test_hdfs_test_stderr_discrimination(tmp_path, monkeypatch):
+    """exit 1 + benign warnings => absent; exit 1 + FsShell error => raise."""
+    c = HDFSClient()
+    c._hadoop = "/bin/true"  # pretend a binary exists
+
+    def fake_run_raw(*cmd):
+        return fake_run_raw.result
+
+    c._run_raw = fake_run_raw
+    fake_run_raw.result = (1, "WARN util.NativeCodeLoader: Unable to load "
+                              "native-hadoop library\nSLF4J: defaulted")
+    assert not c.is_exist("/x")
+    fake_run_raw.result = (1, "WARN something\ntest: Call From host failed "
+                              "on connection exception")
+    with pytest.raises(ExecuteError, match="connection"):
+        c.is_exist("/x")
+    fake_run_raw.result = (0, "")
+    assert c.is_exist("/x")
+    fake_run_raw.result = (255, "")
+    with pytest.raises(ExecuteError):
+        c.is_exist("/x")
