@@ -36,7 +36,8 @@ from ..framework.random import get_rng_key
 from ..jit.functionalization import functional_call, state_of
 from .mesh import require_mesh
 from .meta_parallel.pipeline_parallel import PipelineParallel
-from .meta_parallel.sharding_parallel import opt_state_shardings
+from .meta_parallel.sharding_parallel import (opt_state_shardings,
+                                              shard_spec_for)
 
 DATA_AXES = ("data", "sharding")  # batch is split over both (ZeRO ⊂ DP)
 
@@ -96,7 +97,6 @@ class ParallelTrainer:
         # the GSPMD way. Params already sharded by ShardingParallel stage 3
         # (pspec on the sharding axis) are honored too.
         if self.zero_stage >= 3 and n_shard > 1:
-            from .meta_parallel.sharding_parallel import shard_spec_for
             for k in list(self.param_specs):
                 if self.trainable[k] and self.param_specs[k] == P():
                     self.param_specs[k] = shard_spec_for(params[k], n_shards=n_shard)
@@ -107,6 +107,27 @@ class ParallelTrainer:
                     if ax == "sharding" or (isinstance(ax, tuple)
                                             and "sharding" in ax):
                         self.zero3_dims[k] = d
+        # ZeRO-2: gradients leave the step SHARDED over the sharding axis
+        # (reduce-scatter instead of all-reduce), so the grad buffers held
+        # across gradient-merge accumulation are 1/n_shard per device
+        # (reference sharding_optimizer stage os_g). zero-3 leaves are
+        # already sharded; this covers the remaining trainable params.
+        self.zero2_dims = {}
+        if self.zero_stage >= 2 and n_shard > 1:
+            for k in self.param_specs:
+                if not self.trainable[k] or k in self.zero3_dims:
+                    continue
+                # only fully-replicated params are eligible: a TP-sharded
+                # param (e.g. P(None, "model")) must keep its axis — naively
+                # overwriting a dim with "sharding" would declare the grad
+                # replicated over "model" while ranks hold different slices
+                cur = self.param_specs[k]
+                if any(ax is not None for ax in cur):
+                    continue
+                spec = shard_spec_for(params[k], n_shards=n_shard)
+                for d, ax in enumerate(spec):
+                    if ax == "sharding":
+                        self.zero2_dims[k] = d
         params = OrderedDict((k, put(v, self.param_specs[k]))
                              for k, v in params.items())
         buffers = OrderedDict((k, put(v, P())) for k, v in buffers.items())
@@ -146,6 +167,7 @@ class ParallelTrainer:
             return loss_fn(out, labels)
 
         zero3_dims = self.zero3_dims
+        zero2_dims = self.zero2_dims
         n_shard = mesh.shape.get("sharding", 1)
 
         def grads_fn(params, buffers, key, inputs, labels):
@@ -178,13 +200,32 @@ class ParallelTrainer:
                     grads[k] = grads[k] / n_shard
                     if mesh.shape.get("data", 1) > 1:
                         grads[k] = lax.pmean(grads[k], "data")
+                elif k in zero2_dims:
+                    # reduce-scatter (mean) over sharding; pmean over data
+                    grads[k] = lax.psum_scatter(
+                        grads[k], "sharding",
+                        scatter_dimension=zero2_dims[k],
+                        tiled=True) / n_shard
+                    if mesh.shape.get("data", 1) > 1:
+                        grads[k] = lax.pmean(grads[k], "data")
                 else:
                     for ax in DATA_AXES:
                         if mesh.shape.get(ax, 1) > 1:
                             grads[k] = lax.pmean(grads[k], ax)
             return loss, grads
 
-        tspecs = OrderedDict((k, s) for k, s in self.param_specs.items()
+        def _grad_spec(k):
+            if k in zero2_dims:
+                # grads leave the step sharded on the zero-2 dim
+                d = zero2_dims[k]
+                spec = list(self.param_specs[k]) + [None] * (
+                    d + 1 - len(self.param_specs[k]))
+                spec[d] = "sharding"
+                return P(*spec)
+            return self.param_specs[k]
+
+        tspecs = OrderedDict((k, _grad_spec(k))
+                             for k in self.param_specs
                              if self.trainable[k])
         sharded_grads = shard_map(
             grads_fn, mesh=mesh,

@@ -188,8 +188,9 @@ class HDFSClient(FS):
 
     # stderr lines that do NOT indicate failure: hadoop prints these on
     # every invocation on common installs
-    _BENIGN_STDERR = ("WARN", "SLF4J", "log4j", "Unable to load native",
-                      "DeprecationWarning", "deprecated")
+    _BENIGN_STDERR = ("WARN", "INFO", "SLF4J", "log4j",
+                      "Unable to load native", "DeprecationWarning",
+                      "deprecated")
 
     def _test(self, flag, path) -> bool:
         # FsShell exits 1 BOTH for "test is false" and for most runtime
