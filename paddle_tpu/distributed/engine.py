@@ -148,7 +148,13 @@ class ParallelTrainer:
         is_pp = isinstance(model, PipelineParallel) or (
             hasattr(model, "_layers") and isinstance(model._layers, PipelineParallel))
         pp = model if isinstance(model, PipelineParallel) else None
-        data_spec = P(DATA_AXES)  # batch dim split over data×sharding
+        sep = mesh.shape.get("sep", 1) > 1
+        # batch dim split over data×sharding; with context parallelism the
+        # SEQUENCE dim (dim 1) additionally splits over "sep" — ring
+        # attention (ops/ring_attention.py) rotates K/V chunks around that
+        # axis inside the model
+        data_spec = P(DATA_AXES, "sep") if sep else P(DATA_AXES)
+        reduce_axes = DATA_AXES + ("sep",) if sep else DATA_AXES
 
         if pp is not None:
             pp_loss = pp.build_pipeline_loss_fn(loss_fn, M)
@@ -183,8 +189,9 @@ class ParallelTrainer:
                     merged[k] = lax.all_gather(merged[k], "sharding",
                                                axis=d, tiled=True)
                 loss = local_loss(merged, buffers, key, inputs, labels)
-                # mean over the data axes (each device saw 1/N of the batch)
-                for ax in DATA_AXES:
+                # mean over the data axes (each device saw 1/N of the batch;
+                # under context parallelism also 1/n_sep of the sequence)
+                for ax in reduce_axes:
                     if mesh.shape.get(ax, 1) > 1:
                         loss = lax.pmean(loss, ax)
                 return loss
@@ -198,18 +205,20 @@ class ParallelTrainer:
             for k in grads:
                 if k in zero3_dims:
                     grads[k] = grads[k] / n_shard
-                    if mesh.shape.get("data", 1) > 1:
-                        grads[k] = lax.pmean(grads[k], "data")
+                    for ax in ("data", "sep"):
+                        if ax in reduce_axes and mesh.shape.get(ax, 1) > 1:
+                            grads[k] = lax.pmean(grads[k], ax)
                 elif k in zero2_dims:
                     # reduce-scatter (mean) over sharding; pmean over data
                     grads[k] = lax.psum_scatter(
                         grads[k], "sharding",
                         scatter_dimension=zero2_dims[k],
                         tiled=True) / n_shard
-                    if mesh.shape.get("data", 1) > 1:
-                        grads[k] = lax.pmean(grads[k], "data")
+                    for ax in ("data", "sep"):
+                        if ax in reduce_axes and mesh.shape.get(ax, 1) > 1:
+                            grads[k] = lax.pmean(grads[k], ax)
                 else:
-                    for ax in DATA_AXES:
+                    for ax in reduce_axes:
                         if mesh.shape.get(ax, 1) > 1:
                             grads[k] = lax.pmean(grads[k], ax)
             return loss, grads

@@ -37,6 +37,55 @@ def _in_shard_map(axis=MODEL_AXIS) -> bool:
         return False
 
 
+@jax.custom_vjp
+def copy_to_model_parallel(x):
+    """Megatron's "f" operator (reference mp_layers.py identity_in_
+    model_parallel / c_identity op): identity forward, psum-over-model
+    backward. Entering a model-parallel region, each rank's cotangent for
+    the REPLICATED input is only its shard's partial contribution — the
+    backward all-reduce makes dL/dx (and hence every upstream replicated
+    parameter's grad) complete and identical across model ranks."""
+    return x
+
+
+def _ctmp_fwd(x):
+    return x, None
+
+
+def _ctmp_bwd(_, g):
+    return (lax.psum(g, MODEL_AXIS),)
+
+
+copy_to_model_parallel.defvjp(_ctmp_fwd, _ctmp_bwd)
+
+
+def reduce_from_parallel_region(x, axis=MODEL_AXIS):
+    """Megatron's "g" operator (reference c_allreduce in forward of row
+    linear / vocab embedding): psum forward, IDENTITY backward.
+
+    Plain ``lax.psum`` must NOT be used for forward reductions under
+    shard_map: its transpose is another psum (cotangents are treated as
+    device-varying with check_vma off), which multiplies an
+    already-replicated cotangent by the axis size — every upstream gradient
+    would be scaled by n. The custom VJP pins the backward to identity
+    (the cotangent of the replicated output IS the cotangent of each
+    local partial term).
+    """
+
+    @jax.custom_vjp
+    def _reduce(v):
+        return lax.psum(v, axis)
+
+    def _fwd(v):
+        return lax.psum(v, axis), None
+
+    def _bwd(_, g):
+        return (g,)
+
+    _reduce.defvjp(_fwd, _bwd)
+    return _reduce(x)
+
+
 def _constraint(x, *spec):
     mesh = get_mesh()
     if mesh is None or axis_size(MODEL_AXIS) <= 1:
@@ -73,7 +122,7 @@ class VocabParallelEmbedding(Layer):
             safe = jnp.where(mask, local_ids, 0)
             out = jnp.take(self.weight.value, safe, axis=0)
             out = out * mask[..., None].astype(out.dtype)
-            return lax.psum(out, MODEL_AXIS)
+            return reduce_from_parallel_region(out)
         out = F.embedding(x, self.weight)
         return _constraint(out, None, None, None)
 
@@ -101,6 +150,7 @@ class ColumnParallelLinear(Layer):
     def forward(self, x):
         if _in_shard_map():
             # weights arrive as local shards inside shard_map
+            x = copy_to_model_parallel(x)
             y = jnp.matmul(x, self.weight.value)
             if self.bias is not None:
                 y = y + self.bias.value
@@ -136,12 +186,13 @@ class RowParallelLinear(Layer):
         if _in_shard_map():
             if not self.input_is_parallel:
                 # split the replicated input over the model axis
+                x = copy_to_model_parallel(x)
                 n = lax.axis_size(MODEL_AXIS)
                 idx = lax.axis_index(MODEL_AXIS)
                 per = x.shape[-1] // n
                 x = lax.dynamic_slice_in_dim(x, idx * per, per, axis=x.ndim - 1)
             y = jnp.matmul(x, self.weight.value)
-            y = lax.psum(y, MODEL_AXIS)
+            y = reduce_from_parallel_region(y)
             if self.bias is not None:
                 y = y + self.bias.value
             return y
@@ -176,7 +227,8 @@ class ParallelCrossEntropy(Layer):
         # zero through pmax)
         gmax = lax.pmax(lax.stop_gradient(local_max), MODEL_AXIS)
         shifted = x - gmax
-        sumexp = lax.psum(jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True),
+        sumexp = reduce_from_parallel_region(
+            jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True),
                           MODEL_AXIS)
         logz = jnp.log(sumexp) + gmax
         lbl = label.astype(jnp.int32)
@@ -186,7 +238,7 @@ class ParallelCrossEntropy(Layer):
         safe = jnp.where(in_range, local_lbl, 0)
         picked = jnp.take_along_axis(x, safe[..., None], axis=-1)[..., 0]
         picked = jnp.where(in_range, picked, 0.0)
-        picked = lax.psum(picked, MODEL_AXIS)
+        picked = reduce_from_parallel_region(picked)
         return logz[..., 0] - picked
 
 

@@ -126,7 +126,9 @@ class PipelineParallel(Layer):
             (h_last, loss_acc), _ = lax.scan(
                 tick, (h0, jnp.zeros((), jnp.float32)), jnp.arange(M + S - 1))
             # only the last stage accumulated loss; broadcast it
-            total = lax.psum(loss_acc, PIPE_AXIS)
+            from .parallel_layers.mp_layers import \
+                reduce_from_parallel_region
+            total = reduce_from_parallel_region(loss_acc, axis=PIPE_AXIS)
             return total / M
 
         def _remat_branch(branch):

@@ -154,8 +154,12 @@ class BertPretrainingHeads(Layer):
         self.seq_relationship = nn.Linear(hidden_size, 2)
 
     def forward(self, sequence_output, pooled_output):
+        from ...distributed.meta_parallel.parallel_layers.mp_layers import (
+            _in_shard_map, copy_to_model_parallel)
         h = self.layer_norm(F.gelu(self.transform(sequence_output),
                                    approximate=True))
+        if _in_shard_map():
+            h = copy_to_model_parallel(h)  # see GPTLMHead
         mlm_logits = jnp.matmul(
             h, jnp.swapaxes(self.decoder_weight.value, 0, 1)) \
             + self.decoder_bias.value

@@ -52,6 +52,11 @@ class GPTEmbeddings(Layer):
         if position_ids is None:
             s = input_ids.shape[-1]
             position_ids = jnp.arange(s, dtype=jnp.int32)[None, :]
+            if _sep_axis_bound():
+                # context parallelism: this device holds sequence chunk
+                # [i*s_local, (i+1)*s_local) — positions must be GLOBAL
+                import jax.lax as lax
+                position_ids = position_ids + lax.axis_index("sep") * s
         w = self.word_embeddings(input_ids)
         p = self.position_embeddings(position_ids)
         return self.dropout(w + p)
@@ -191,6 +196,12 @@ class GPTLMHead(Layer):
                 self.weight.pspec = P("model", None)
 
     def forward(self, x):
+        from ...distributed.meta_parallel.parallel_layers.mp_layers import (
+            _in_shard_map, copy_to_model_parallel)
+        if _in_shard_map():
+            # vocab-sharded projection: backward needs the psum-over-model
+            # identity so upstream (replicated) grads are complete
+            x = copy_to_model_parallel(x)
         return jnp.matmul(x, jnp.swapaxes(self.weight.value, 0, 1))
 
 
