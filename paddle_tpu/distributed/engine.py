@@ -64,7 +64,6 @@ class ParallelTrainer:
         # gradient_merge_optimizer + DistributedStrategy.gradient_merge):
         # split each batch into k chunks, accumulate grads, one optimizer step
         self.accumulate_steps = accumulate_steps
-        self._step = None
         self.state = None
         self._init_state()
         self._build()
@@ -149,11 +148,6 @@ class ParallelTrainer:
             hasattr(model, "_layers") and isinstance(model._layers, PipelineParallel))
         pp = model if isinstance(model, PipelineParallel) else None
         sep = mesh.shape.get("sep", 1) > 1
-        # batch dim split over data×sharding; with context parallelism the
-        # SEQUENCE dim (dim 1) additionally splits over "sep" — ring
-        # attention (ops/ring_attention.py) rotates K/V chunks around that
-        # axis inside the model
-        data_spec = P(DATA_AXES, "sep") if sep else P(DATA_AXES)
         reduce_axes = DATA_AXES + ("sep",) if sep else DATA_AXES
 
         if pp is not None:
@@ -236,53 +230,82 @@ class ParallelTrainer:
         tspecs = OrderedDict((k, _grad_spec(k))
                              for k in self.param_specs
                              if self.trainable[k])
-        sharded_grads = shard_map(
-            grads_fn, mesh=mesh,
-            in_specs=(dict(self.param_specs), dict(self.buffer_specs),
-                      P(), data_spec, data_spec),
-            out_specs=(P(), dict(tspecs)),
-            check_vma=False)
 
         opt = self.optimizer
 
         K = self.accumulate_steps
 
-        def train_step(params, buffers, opt_state, key, lr, inputs, labels):
-            if K > 1:
-                # gradient merge: grads averaged over K sequential chunks
-                # (activation memory is 1/K; same numerics as the big batch)
-                chunk = jax.tree_util.tree_map(
-                    lambda x: jnp.reshape(x, (K, x.shape[0] // K)
-                                          + x.shape[1:]), (inputs, labels))
-                keys = jax.random.split(key, K)
-                loss = 0.0
-                grads = None
-                for i in range(K):
-                    ins_i, lbs_i = jax.tree_util.tree_map(
-                        lambda x: x[i], chunk)
-                    l_i, g_i = sharded_grads(dict(params), dict(buffers),
-                                             keys[i], ins_i, lbs_i)
-                    loss = loss + l_i / K
-                    grads = g_i if grads is None else jax.tree_util.tree_map(
-                        lambda a, b: a + b, grads, g_i)
-                grads = jax.tree_util.tree_map(lambda g: g / K, grads)
-            else:
-                loss, grads = sharded_grads(dict(params), dict(buffers), key,
-                                            inputs, labels)
-            tparams = {k: v for k, v in params.items() if self.trainable[k]}
-            new_t, new_opt = opt.apply_gradients(tparams, grads, opt_state,
-                                                 lr=lr)
-            new_params = dict(params)
-            new_params.update(new_t)
-            # keep optimizer slots on their ZeRO shardings
-            new_opt = jax.tree_util.tree_map(
-                lambda v, s: lax.with_sharding_constraint(
-                    v, NamedSharding(mesh, s)),
-                new_opt, self.opt_specs)
-            return loss, new_params, new_opt
+        def make_step(input_specs, label_specs):
+            """Jitted step for one concrete (inputs, labels) pytree shape.
 
-        self._step = jax.jit(train_step, donate_argnums=(0, 2))
-        self._data_sharding = NamedSharding(mesh, data_spec)
+            Data specs are per-LEAF: the batch dim always splits over
+            data×sharding; with context parallelism ("sep" axis) rank>=2
+            leaves additionally split their SEQUENCE dim (dim 1) over "sep"
+            — ring attention (ops/ring_attention.py) rotates K/V chunks
+            around that axis inside the model. Rank-1 leaves (e.g. per-row
+            labels) carry no sequence dim, so they only batch-split — this
+            is why specs cannot be a single P for all leaves.
+            """
+            sharded_grads = shard_map(
+                grads_fn, mesh=mesh,
+                in_specs=(dict(self.param_specs), dict(self.buffer_specs),
+                          P(), input_specs, label_specs),
+                out_specs=(P(), dict(tspecs)),
+                check_vma=False)
+
+            def train_step(params, buffers, opt_state, key, lr, inputs,
+                           labels):
+                if K > 1:
+                    # gradient merge: grads averaged over K sequential
+                    # chunks (activation memory is 1/K; same numerics as
+                    # the big batch)
+                    chunk = jax.tree_util.tree_map(
+                        lambda x: jnp.reshape(x, (K, x.shape[0] // K)
+                                              + x.shape[1:]),
+                        (inputs, labels))
+                    keys = jax.random.split(key, K)
+                    loss = 0.0
+                    grads = None
+                    for i in range(K):
+                        ins_i, lbs_i = jax.tree_util.tree_map(
+                            lambda x: x[i], chunk)
+                        l_i, g_i = sharded_grads(dict(params), dict(buffers),
+                                                 keys[i], ins_i, lbs_i)
+                        loss = loss + l_i / K
+                        grads = g_i if grads is None else \
+                            jax.tree_util.tree_map(
+                                lambda a, b: a + b, grads, g_i)
+                    grads = jax.tree_util.tree_map(lambda g: g / K, grads)
+                else:
+                    loss, grads = sharded_grads(dict(params), dict(buffers),
+                                                key, inputs, labels)
+                tparams = {k: v for k, v in params.items()
+                           if self.trainable[k]}
+                new_t, new_opt = opt.apply_gradients(tparams, grads,
+                                                     opt_state, lr=lr)
+                new_params = dict(params)
+                new_params.update(new_t)
+                # keep optimizer slots on their ZeRO shardings
+                new_opt = jax.tree_util.tree_map(
+                    lambda v, s: lax.with_sharding_constraint(
+                        v, NamedSharding(mesh, s)),
+                    new_opt, self.opt_specs)
+                return loss, new_params, new_opt
+
+            return jax.jit(train_step, donate_argnums=(0, 2))
+
+        self._make_step = make_step
+        self._sep = sep
+        self._step_cache = {}
+
+    def _leaf_spec(self, x):
+        """Per-leaf data PartitionSpec (see make_step docstring)."""
+        r = len(jnp.shape(x))
+        if r == 0:
+            return P()
+        if self._sep and r >= 2:
+            return P(DATA_AXES, "sep")
+        return P(DATA_AXES)
 
     # -- run ----------------------------------------------------------------
     def train_step(self, inputs, labels, lr: Optional[float] = None):
@@ -297,13 +320,25 @@ class ParallelTrainer:
                 f"batch size {batch0} is not divisible by "
                 f"accumulate_steps={self.accumulate_steps}")
         # inputs/labels may be arbitrary pytrees (e.g. (mlm, nsp) labels)
+        inputs = jax.tree_util.tree_map(lambda x: jnp.asarray(x), inputs)
+        labels = jax.tree_util.tree_map(lambda x: jnp.asarray(x), labels)
+        in_specs = jax.tree_util.tree_map(self._leaf_spec, inputs)
+        lb_specs = jax.tree_util.tree_map(self._leaf_spec, labels)
         inputs = jax.tree_util.tree_map(
-            lambda x: jax.device_put(jnp.asarray(x), self._data_sharding),
-            inputs)
+            lambda x, s: jax.device_put(
+                x, NamedSharding(self.mesh, s)), inputs, in_specs)
         labels = jax.tree_util.tree_map(
-            lambda x: jax.device_put(jnp.asarray(x), self._data_sharding),
-            labels)
-        loss, new_params, new_opt = self._step(
+            lambda x, s: jax.device_put(
+                x, NamedSharding(self.mesh, s)), labels, lb_specs)
+        cache_key = (jax.tree_util.tree_structure((inputs, labels)),
+                     tuple(len(jnp.shape(l))
+                           for l in jax.tree_util.tree_leaves(
+                               (inputs, labels))))
+        step = self._step_cache.get(cache_key)
+        if step is None:
+            step = self._make_step(in_specs, lb_specs)
+            self._step_cache[cache_key] = step
+        loss, new_params, new_opt = step(
             self.state["params"], self.state["buffers"], self.state["opt"],
             key, lr, inputs, labels)
         self.state["params"] = new_params

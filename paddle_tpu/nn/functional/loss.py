@@ -292,7 +292,11 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
     logits = jnp.einsum("nd,nkd->nk", x, w)
     if bias is not None:
         logits = logits + jnp.take(jnp.asarray(bias).reshape(-1), rows, axis=0)
-    # code==1 → right child → target 1; log sigmoid of signed logit
-    sign = 1.0 - 2.0 * codes
+    # reference clips pre_out to [-40, 40] (hierarchical_sigmoid_op.h:107)
+    logits = jnp.clip(logits, -40.0, 40.0)
+    # reference loss_j = softplus(z) - bit*z = -log sigmoid((2*bit-1) * z):
+    # bit==1 is trained toward +inf (matrix_bit_code.h calc_bit +
+    # hierarchical_sigmoid_op.h:112-115 Sum(scale=-1) + softplus row-sum)
+    sign = 2.0 * codes - 1.0
     loss = -jax.nn.log_sigmoid(sign * logits) * mask
     return jnp.sum(loss, axis=1, keepdims=True)

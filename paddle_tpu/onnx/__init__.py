@@ -14,19 +14,31 @@ def export(layer, path: str, input_spec=None, opset_version: int = 9,
            **configs):
     """Export ``layer`` for interchange.
 
-    Mirrors paddle.onnx.export(layer, path, input_spec). Writes a StableHLO
-    program + weights via jit.save; returns the artifact prefix.
+    Mirrors paddle.onnx.export(layer, path, input_spec). Always writes a
+    StableHLO program + weights via jit.save. Returns the ``.onnx`` file
+    path when ONNX conversion succeeds, else (with a warning) the StableHLO
+    artifact prefix.
     """
-    try:
-        import onnx  # noqa: F401  (not in this image; gated)
-        have_onnx = True
-    except ImportError:
-        have_onnx = False
     from .. import jit
     prefix = path[:-5] if path.endswith(".onnx") else path
     jit.save(layer, prefix, input_spec=input_spec)
-    if have_onnx:
-        raise NotImplementedError(
-            "ONNX serialization of StableHLO is not wired; the StableHLO "
-            f"artifact at {prefix!r} is the supported interchange format.")
+    # Real ONNX emission for supported layer graphs — a dependency-free
+    # wire-format writer (reference capability: paddle2onnx per-op
+    # conversion). Falls back to the StableHLO artifact with a warning for
+    # structures the converter does not cover.
+    import warnings
+    try:
+        from ._writer import export_layer_to_onnx
+        onnx_path = prefix + ".onnx"
+        export_layer_to_onnx(layer, onnx_path, input_spec=input_spec,
+                             opset_version=max(opset_version, 13))
+        return onnx_path
+    except NotImplementedError as e:
+        warnings.warn(
+            f"ONNX conversion not available for this model ({e}); the "
+            f"StableHLO artifact at {prefix!r} is the exported format.")
+    except Exception as e:  # converter defects must never break export:
+        warnings.warn(       # the StableHLO artifact is already written
+            f"ONNX conversion failed ({type(e).__name__}: {e}); the "
+            f"StableHLO artifact at {prefix!r} is the exported format.")
     return prefix

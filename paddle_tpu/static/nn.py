@@ -57,6 +57,26 @@ def _param(name, shape, dtype="float32", initializer=None, is_bias=False):
     return value
 
 
+def _is_traced(*vals):
+    """True if any value is a JAX tracer (we are under jit/Program.trace)."""
+    return any(isinstance(v, jax.core.Tracer) for v in vals)
+
+
+def _host_only(op_name, *vals):
+    """Raise a clear error when a host-side (ragged, numpy round-trip)
+    sequence op is hit under tracing — these ops produce data-dependent
+    shapes and cannot be staged into an XLA program (reference LoDTensor
+    raggedness has no static-shape equivalent). Use the padded+length
+    variants (sequence_pool/sequence_slice/...) inside jit instead."""
+    if _is_traced(*vals):
+        raise TypeError(
+            f"paddle_tpu.static.nn.{op_name} is eager-only: its output shape "
+            "depends on runtime data (ragged sequences), which cannot be "
+            "staged under jax.jit / Program.trace. Call it outside jit, or "
+            "use a padded+mask formulation (e.g. sequence_pool with a "
+            "length vector) which is traceable.")
+
+
 def _uname(prefix):
     from ..framework.naming import unique_name
     return unique_name(prefix)
@@ -610,6 +630,8 @@ def sequence_concat(input: Sequence, lengths=None, name=None):
     (host-side; ragged packing is not XLA-shapeable)."""
     if lengths is None:
         return jnp.concatenate(list(input), axis=1)
+    _host_only("sequence_concat", *list(input),
+               *[l for l in lengths])
     outs = []
     for b in range(input[0].shape[0]):
         parts = [np.asarray(x[b, :int(l[b])])
@@ -623,14 +645,23 @@ def sequence_concat(input: Sequence, lengths=None, name=None):
 
 def sequence_slice(input, offset, length, name=None):
     """Per-row slice [offset, offset+length) along time (reference
-    operators/sequence_ops/sequence_slice_op)."""
+    operators/sequence_ops/sequence_slice_op).
+
+    Traceable: under jit the output time dim is the static upper bound
+    ``input.shape[1]`` (XLA needs static shapes); positions past ``length``
+    are zero-masked. Eagerly the tight ``max(length)`` is used."""
     offset = jnp.asarray(offset).reshape(-1)
     length = jnp.asarray(length).reshape(-1)
-    out_t = int(jnp.max(length))
+    if _is_traced(input, offset, length):
+        out_t = input.shape[1]
+    else:
+        out_t = int(jnp.max(length))
     idx = offset[:, None] + jnp.arange(out_t)[None]
+    # clip OOB gathers (default fill mode yields NaN, which the zero mask
+    # below would propagate instead of zeroing)
     gathered = jnp.take_along_axis(
         input, idx[..., None].repeat(input.shape[-1], -1) if input.ndim > 2
-        else idx, axis=1)
+        else idx, axis=1, mode="clip")
     mask = (jnp.arange(out_t)[None] < length[:, None])
     while mask.ndim < gathered.ndim:
         mask = mask[..., None]
@@ -642,7 +673,7 @@ def sequence_expand(x, y, ref_level=-1, length=None, name=None):
     sequence_expand_op). Padded form: length (batch,) gives repeats."""
     reps = jnp.asarray(length).reshape(-1) if length is not None else \
         jnp.full((x.shape[0],), y.shape[1])
-    # static max for XLA; host fallback for ragged
+    _host_only("sequence_expand", x, reps)
     out = np.repeat(np.asarray(x), np.asarray(reps), axis=0)
     return jnp.asarray(out)
 
@@ -656,9 +687,11 @@ def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
     (reference sequence_pad_op). x is a list of variable-length arrays or a
     (rows, dim) packed array + length."""
     if isinstance(x, (list, tuple)):
+        _host_only("sequence_pad", *x)
         seqs = [np.asarray(s) for s in x]
     else:
         assert length is not None, "packed input needs length"
+        _host_only("sequence_pad", x, length)
         flat = np.asarray(x)
         offs = np.concatenate([[0], np.cumsum(np.asarray(length))])
         seqs = [flat[offs[i]:offs[i + 1]] for i in range(len(length))]
@@ -674,6 +707,7 @@ def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
 
 def sequence_unpad(x, length, name=None):
     """Dense padded (batch, maxlen, ...) → list of per-row arrays."""
+    _host_only("sequence_unpad", x, length)
     length = np.asarray(length).reshape(-1)
     return [jnp.asarray(np.asarray(x)[i, :int(length[i])])
             for i in range(x.shape[0])]

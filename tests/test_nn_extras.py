@@ -106,6 +106,18 @@ def test_hsigmoid_custom_path():
                                       path_table=path_table,
                                       path_code=path_code)
     assert out.shape == (3, 1) and np.isfinite(np.asarray(out)).all()
+    # parity vs hand-computed reference math (hierarchical_sigmoid_op.h:
+    # loss = sum_j softplus(z_j) - bit_j * z_j over valid path positions)
+    expected = np.zeros((3, 1), dtype="float64")
+    for i in range(3):
+        nodes = path_table[int(y[i])]
+        bits = path_code[int(y[i])]
+        for j, (node, bit) in enumerate(zip(nodes, bits)):
+            if node < 0:
+                continue
+            z = float(np.clip(np.dot(x[i], w[node]), -40.0, 40.0))
+            expected[i, 0] += np.log1p(np.exp(z)) - bit * z
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4)
 
 
 class _CellWrap:

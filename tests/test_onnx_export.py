@@ -1,0 +1,95 @@
+"""ONNX export: real wire-format emission for Sequential models, StableHLO
+fallback otherwise (reference: python/paddle/onnx/export.py -> paddle2onnx).
+
+The emitted bytes are validated with the dependency-free protobuf decoder in
+paddle_tpu.onnx._pb (the `onnx` package is not in this image); when `onnx`
+IS importable the checker test runs too.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.onnx import _pb
+from paddle_tpu.static import InputSpec
+
+
+def _decode_model(path):
+    with open(path, "rb") as f:
+        buf = f.read()
+    model = _pb.decode(buf)
+    assert model[1][0] == 8  # ir_version
+    graph = _pb.decode(model[7][0])
+    nodes = [_pb.decode(n) for n in graph.get(1, [])]
+    inits = [_pb.decode(t) for t in graph.get(5, [])]
+    return model, graph, nodes, inits
+
+
+def _op_types(nodes):
+    return [n[4][0].decode() for n in nodes]
+
+
+def test_onnx_export_sequential_mlp(tmp_path):
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2),
+                      nn.Softmax())
+    m.eval()
+    out = paddle.onnx.export(m, str(tmp_path / "mlp.onnx"),
+                             input_spec=[InputSpec([None, 4], "float32")])
+    assert out.endswith(".onnx") and os.path.exists(out)
+    _, graph, nodes, inits = _decode_model(out)
+    ops = _op_types(nodes)
+    assert ops == ["Gemm", "Relu", "Gemm", "Softmax", "Identity"]
+    # initializers: 2 weights + 2 biases, with correct dims
+    dims = sorted(tuple(t.get(1, [])) for t in inits)
+    assert ((4, 8) in dims) and ((8, 2) in dims)
+    # weight payload round-trips bit-exact
+    w0 = np.asarray(m[0].weight.value, dtype=np.float32)
+    blobs = [np.frombuffer(t[9][0], dtype=np.float32) for t in inits]
+    assert any(b.size == w0.size and
+               np.array_equal(b.reshape(w0.shape), w0) for b in blobs)
+
+
+def test_onnx_export_lenet(tmp_path):
+    from paddle_tpu.vision.models import LeNet
+    m = LeNet()
+    m.eval()
+    out = paddle.onnx.export(m, str(tmp_path / "lenet.onnx"),
+                             input_spec=[InputSpec([None, 1, 28, 28],
+                                                   "float32")])
+    assert out.endswith(".onnx")
+    _, graph, nodes, _ = _decode_model(out)
+    ops = _op_types(nodes)
+    assert ops.count("Conv") == 2 and ops.count("MaxPool") == 2
+    assert ops.count("Gemm") == 3 and "Flatten" in ops
+    # graph input/output value_info present
+    vi_in = _pb.decode(graph[11][0])
+    assert vi_in[1][0] == b"input"
+
+
+def test_onnx_export_fallback_warns(tmp_path):
+    class Residual(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return x + self.fc(x)
+
+    m = Residual()
+    with pytest.warns(UserWarning, match="ONNX conversion not available"):
+        prefix = paddle.onnx.export(
+            m, str(tmp_path / "res.onnx"),
+            input_spec=[InputSpec([2, 4], "float32")])
+    assert not prefix.endswith(".onnx")
+    assert os.path.exists(prefix + ".stablehlo")
+
+
+def test_onnx_checker_if_available(tmp_path):
+    onnx = pytest.importorskip("onnx")
+    m = nn.Sequential(nn.Linear(4, 2))
+    out = paddle.onnx.export(m, str(tmp_path / "chk.onnx"),
+                             input_spec=[InputSpec([1, 4], "float32")])
+    model = onnx.load(out)
+    onnx.checker.check_model(model)
