@@ -3,18 +3,34 @@ fleet/meta_parallel/parallel_layers/pp_layers.py — LayerDesc, SharedLayerDesc:
 SegmentLayers:23 uniform/param-count partition, PipelineLayer:76).
 
 TPU-native: PipelineLayer partitions a LayerDesc list into pp_degree stages.
-The SPMD pipeline engine (pipeline_parallel.py) requires the *middle* stages
-to be structurally identical (the classic stacked-stage trick: per-stage
-params carry a leading "pipe" dim sharded over the pipe axis); embedding and
-head live on the first/last stage via the engine's cond-dispatch.
+The reference materializes only the local stage's layers per rank
+(pp_layers.py:76); the SPMD equivalent here is the *stacked-stage* trick:
+contiguous runs of structurally identical layers (the transformer body)
+whose members distribute evenly over the stages are stored as ONE set of
+parameters with a leading member dim, sharded over the "pipe" mesh axis
+(P("pipe", ...)). Each device physically holds only its own stage's slice —
+per-device parameter and optimizer-slot memory for those layers is 1/pp,
+matching the reference's per-rank materialization. Layers that cannot stack
+(embedding on the first stage, norm+head on the last) stay replicated over
+the pipe axis; the engine reduces their gradients with a psum over "pipe"
+so the replication is genuine.
+
+SharedLayerDesc (tied embeddings) keeps ONE owner copy of the shared
+parameters — replicated over the pipe axis — and the non-owner occurrence
+applies ``forward_func`` against the owner's weight. The pipe-axis grad
+psum accumulates both stages' contributions, which is the TPU form of the
+reference's allreduce over the shared-comm group (pp_layers.py:62).
 """
 from __future__ import annotations
 
 from typing import Callable, List, Optional
 
+import jax.numpy as jnp
 import numpy as np
 
-from ....nn.layer import Layer
+from jax.sharding import PartitionSpec as P
+
+from ....nn.layer import Layer, Parameter
 
 
 class LayerDesc:
@@ -28,16 +44,22 @@ class LayerDesc:
     def build_layer(self) -> Layer:
         return self.layer_cls(*self.inputs, **self.kwargs)
 
+    def signature(self):
+        """Structural identity: two descs with equal signatures build
+        structurally identical layers (stackable)."""
+        return (self.layer_cls, self.inputs, tuple(sorted(self.kwargs.items())))
+
     def __repr__(self):
         return f"LayerDesc({self.layer_cls.__name__})"
 
 
 class SharedLayerDesc(LayerDesc):
     """Tied layers across stages (reference: pp_layers.py:62 — e.g. embedding
-    weights shared with the LM head). The engine keeps ONE copy of the shared
-    params (replicated over the pipe axis) and psums their grads over the
-    stages that use them — the TPU version of the reference's allreduce over
-    the shared-comm group."""
+    weights shared with the LM head). The first occurrence builds and owns
+    the parameters; later occurrences apply ``forward_func(x, owner_weight)``
+    (or the owner layer itself). Owner params stay replicated over the pipe
+    axis and the engine's pipe-axis grad psum sums the contributions from
+    every stage that uses them."""
 
     def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight",
                  *inputs, **kwargs):
@@ -46,10 +68,13 @@ class SharedLayerDesc(LayerDesc):
         self.forward_func = forward_func
         self.shared_weight_attr = shared_weight_attr
 
+    def signature(self):
+        return ("shared", self.layer_name)
+
 
 class SegmentLayers:
     """Partition N layer descs into `num_parts` stages (reference:
-    pp_layers.py:23): uniform or parameter-count weighted."""
+    pp_layers.py:23): uniform or layer-type-count weighted."""
 
     def __init__(self, layers_desc, num_parts, method="uniform"):
         self.layers_desc = layers_desc
@@ -81,13 +106,84 @@ class SegmentLayers:
                 for i in range(num_parts + 1)]
 
 
-class PipelineLayer(Layer):
-    """Holds the full desc list + this build's stage assignment.
+def _escape(name: str) -> str:
+    return name.replace(".", "__")
 
-    Unlike the reference (which materializes only the local stage's layers per
-    rank), the single-controller SPMD engine materializes ALL stages' layers
-    and shards their (stacked) parameters over the "pipe" mesh axis — each
-    device stores only its own stage's shard, same memory as the reference.
+
+class _StackedStage(Layer):
+    """N structurally identical member layers stored as stacked parameters.
+
+    Parameter ``p`` of the member template becomes one stacked array of
+    shape ``(N, *p.shape)`` with pspec ``P("pipe", *p.pspec)`` — the leading
+    member dim is sharded over the pipe mesh axis, so each device stores
+    only its own stage's contiguous chunk of members. Member TP specs
+    (e.g. P(None, "model")) are preserved in the trailing dims.
+    """
+
+    def __init__(self, members: List[Layer]):
+        super().__init__()
+        self.size = len(members)
+        # the template is intentionally NOT registered as a sublayer: its
+        # per-member parameters are replaced by the stacks below, and it is
+        # only used as the functional skeleton for apply
+        object.__setattr__(self, "_template", members[0])
+        self.param_names = [n for n, _ in members[0].named_parameters()]
+        self.buffer_names = [n for n, _ in members[0].named_buffers()]
+        for name, p0 in members[0].named_parameters():
+            vals = [dict(m.named_parameters())[name].value for m in members]
+            sp = Parameter(jnp.stack(vals), trainable=p0.trainable)
+            member_spec = tuple(p0.pspec) if p0.pspec is not None else \
+                (None,) * (vals[0].ndim)
+            sp.pspec = P("pipe", *member_spec)
+            self.add_parameter(_escape(name), sp)
+        # stacked buffers shard over pipe like the params (the engine reads
+        # buffer_pspecs; without it the P() default would hand the scan a
+        # full-length buffer stack against k-length param slices)
+        self.buffer_pspecs = {}
+        for name in self.buffer_names:
+            vals = [dict(m.named_buffers())[name] for m in members]
+            self.register_buffer(_escape(name), jnp.stack(vals))
+            self.buffer_pspecs[_escape(name)] = P(
+                "pipe", *((None,) * vals[0].ndim))
+
+    # -- functional application -------------------------------------------
+    def member_state(self, j, params=None, buffers=None):
+        """(params, buffers) of member j, un-escaped for the template.
+        `params`/`buffers` default to this module's own stacked values but
+        may be the (possibly traced, possibly local-sliced) stacks extracted
+        from an engine state dict."""
+        if params is None:
+            params = {n: self._parameters[n].value
+                      for n in self._parameters}
+        if buffers is None:
+            buffers = dict(self._buffers)
+        pj = {n: params[_escape(n)][j] for n in self.param_names}
+        bj = {n: buffers[_escape(n)][j] for n in self.buffer_names}
+        return pj, bj
+
+    def apply_member(self, j, x, params=None, buffers=None, rng=None):
+        from ....jit.functionalization import functional_call
+        pj, bj = self.member_state(j, params, buffers)
+        out, _ = functional_call(self._template, pj, bj, x, rng=rng)
+        return out
+
+    def forward(self, x):
+        """Apply all members sequentially (single-device dense semantics)."""
+        for j in range(self.size):
+            x = self.apply_member(j, x)
+        return x
+
+
+class PipelineLayer(Layer):
+    """Holds the full desc list + the stage plan.
+
+    Storage (see module docstring): stackable runs -> ``stack{g}``
+    (_StackedStage, pipe-sharded); everything else -> ``mod{i}`` replicated
+    over pipe; SharedLayerDesc non-owner occurrences hold no params.
+    ``self.plan[i]`` describes desc i:
+      ("layer", i)            — apply mod{i}
+      ("stacked", gid, m)     — apply member m (global index) of stack{gid}
+      ("shared", owner_i, fw, attr) — apply fw(x, owner weight) / owner
     """
 
     def __init__(self, layers: List[LayerDesc], num_stages: int,
@@ -98,31 +194,97 @@ class PipelineLayer(Layer):
         self.num_stages = num_stages
         self.loss_fn = loss_fn
         self.segment = SegmentLayers(layers, num_stages, seg_method).do_segment()
-        from ....nn.layers.container import LayerList
-        built = [d.build_layer() for d in layers]
-        self.runs = LayerList(built)
-        self.shared_keys = {d.layer_name for d in layers
-                            if isinstance(d, SharedLayerDesc)}
+        owners = {}
+        built: List[Optional[Layer]] = []
+        plan = []
+        for i, d in enumerate(layers):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in owners:
+                    built.append(None)
+                    plan.append(("shared", owners[d.layer_name],
+                                 d.forward_func, d.shared_weight_attr))
+                    continue
+                owners[d.layer_name] = i
+            built.append(d.build_layer())
+            plan.append(("layer", i))
+        self.shared_keys = set(owners)
+        # stackable groups: contiguous identical plain descs whose members
+        # distribute evenly (k per stage) over ALL stages
+        self.groups = []           # [(a, b, k)]
+        for a, b in self._identical_runs(layers, plan):
+            counts = [max(0, min(b, self.segment[s + 1]) -
+                          max(a, self.segment[s]))
+                      for s in range(num_stages)]
+            if min(counts) >= 1 and len(set(counts)) == 1 \
+                    and sum(counts) == b - a:
+                gid = len(self.groups)
+                self.groups.append((a, b, counts[0]))
+                stack = _StackedStage(built[a:b])
+                self.add_sublayer(f"stack{gid}", stack)
+                for i in range(a, b):
+                    plan[i] = ("stacked", gid, i - a)
+        for i, ent in enumerate(plan):
+            if ent[0] == "layer":
+                self.add_sublayer(f"mod{i}", built[i])
+        self.plan = plan
 
-    def stage_layers(self, stage_id: int):
+    @staticmethod
+    def _identical_runs(layers, plan):
+        """Maximal contiguous runs (a, b) of >1 identical plain LayerDescs."""
+        runs, a = [], 0
+        n = len(layers)
+        while a < n:
+            b = a + 1
+            if plan[a][0] == "layer" and \
+                    not isinstance(layers[a], SharedLayerDesc):
+                sig = layers[a].signature()
+                while b < n and plan[b][0] == "layer" and \
+                        not isinstance(layers[b], SharedLayerDesc) and \
+                        layers[b].signature() == sig:
+                    b += 1
+            if b - a > 1:
+                runs.append((a, b))
+            a = b
+        return runs
+
+    def named_buffer_pspecs(self):
+        """Full-name -> PartitionSpec for buffers that must not default to
+        replicated (the pipe-stacked stage buffers)."""
+        out = {}
+        for gid in range(len(self.groups)):
+            stack = getattr(self, f"stack{gid}")
+            for esc, spec in stack.buffer_pspecs.items():
+                out[f"stack{gid}.{esc}"] = spec
+        return out
+
+    # -- stage structure ----------------------------------------------------
+    def stage_items(self, stage_id: int):
         lo, hi = self.segment[stage_id], self.segment[stage_id + 1]
-        return list(self.runs)[lo:hi]
+        return [(i, self.plan[i]) for i in range(lo, hi)]
+
+    def owner_weight_key(self, owner_i: int, attr: str) -> str:
+        """Flat param-dict key of a shared owner's weight."""
+        return f"mod{owner_i}.{attr}"
+
+    def _apply_item(self, i, ent, x):
+        """Eager/dense application of one plan item (own parameter values)."""
+        kind = ent[0]
+        if kind == "layer":
+            return getattr(self, f"mod{i}")(x)
+        if kind == "stacked":
+            _, gid, m = ent
+            return getattr(self, f"stack{gid}").apply_member(m, x)
+        _, owner_i, fw, attr = ent
+        owner = getattr(self, f"mod{owner_i}")
+        if fw is not None:
+            w = owner
+            for part in attr.split("."):
+                w = getattr(w, part)
+            return fw(x, getattr(w, "value", w))
+        return owner(x)
 
     def forward(self, x):
         """Non-pipelined reference forward (single-device semantics)."""
-        shared = {}
-        for desc, layer in zip(self.descs, self.runs):
-            if isinstance(desc, SharedLayerDesc):
-                if desc.layer_name not in shared:
-                    shared[desc.layer_name] = layer
-                    x = layer(x)
-                else:
-                    owner = shared[desc.layer_name]
-                    if desc.forward_func is not None:
-                        x = desc.forward_func(
-                            x, getattr(owner, desc.shared_weight_attr))
-                    else:
-                        x = owner(x)
-            else:
-                x = layer(x)
+        for i, ent in enumerate(self.plan):
+            x = self._apply_item(i, ent, x)
         return x

@@ -11,27 +11,39 @@ steady state). The *backward* pipeline is not hand-written: jax AD
 differentiates through the scan, transposing every ppermute into the
 reverse-direction hop — producing exactly the reversed communication pattern
 that pipeline_parallel.py:199 implements manually. Per-microbatch activation
-memory is bounded with jax.checkpoint (remat) over each stage application,
-which is how 1F1B's memory advantage is recovered on TPU (remat trades the
-stashed activations for recompute, reference C54 recompute).
+memory is bounded with jax.checkpoint (remat) over each stage application.
+
+Parameter memory: the transformer body lives in _StackedStage parameters
+(pp_layers.py) whose leading member dim is sharded over "pipe" — inside the
+shard_map each device's slice is exactly its own stage's members, applied
+with a lax.scan. First/last-stage layers (embedding, norm, head) are
+replicated over pipe; their gradients are psum'd over "pipe" by the engine
+so the replication is genuine (each stage contributes zeros for layers it
+does not run).
 
 Stage dispatch inside the SPMD program is a lax.switch on the stage id —
-first stage consumes the (replicated) token microbatch, the last computes
-the loss; middle stages are pure activation → activation maps.
+the first stage consumes the (replicated) token microbatch, the last
+computes the loss; middle stages are pure activation → activation maps.
 """
 from __future__ import annotations
 
-import functools
 from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ...jit.functionalization import functional_call, state_of
+from ...jit.functionalization import functional_call
 from ...nn.layer import Layer
 
 PIPE_AXIS = "pipe"
+
+
+def _extract(state, prefix):
+    """Sub-dict of a flat name->array dict under `prefix.`."""
+    plen = len(prefix) + 1
+    return {k[plen:]: v for k, v in state.items()
+            if k.startswith(prefix + ".")}
 
 
 class PipelineParallel(Layer):
@@ -44,43 +56,100 @@ class PipelineParallel(Layer):
         self._hcg = hcg
         self.num_stages = hcg.get_pipe_parallel_world_size()
         self.accumulate_steps = 1
+        self.schedule = "gpipe"
         if strategy is not None:
             self.accumulate_steps = int(
                 strategy.pipeline_configs.get("accumulate_steps", 1))
+            self.schedule = strategy.pipeline_configs.get(
+                "schedule", self.schedule)
         self._compiled = None
 
     # -- single-device semantics (debug/eval) ------------------------------
     def forward(self, x):
         return self._layers(x)
 
+    # -- per-stage functional forward --------------------------------------
+    def _stage_forward_fn(self, s):
+        """Build fwd(params, buffers, h, key) applying stage `s`'s items.
+
+        `params`/`buffers` are the FLAT model dicts as seen inside the
+        active shard_map: _StackedStage entries hold the LOCAL (per-device)
+        member slice — which on the device executing branch `s` is exactly
+        stage s's members — while mod{i} entries are replicated.
+        """
+        layers = self._layers
+        items = layers.stage_items(s)
+        k_local = {gid: k for gid, (_, _, k) in enumerate(layers.groups)}
+
+        def fwd(params, buffers, h, key):
+            x = h
+            idx = 0
+            n = len(items)
+            while idx < n:
+                i, ent = items[idx]
+                kind = ent[0]
+                if kind == "stacked":
+                    _, gid, m0 = ent
+                    stack = getattr(layers, f"stack{gid}")
+                    k = k_local[gid]
+                    # contiguous run of this stack's members in this stage
+                    run = 1
+                    while idx + run < n and items[idx + run][1][0] == "stacked" \
+                            and items[idx + run][1][1] == gid:
+                        run += 1
+                    assert run == k, (
+                        f"stage {s}: stacked run {run} != per-stage k {k}")
+                    sp = _extract(params, f"stack{gid}")
+                    sb = _extract(buffers, f"stack{gid}")
+
+                    def blk(h_c, xs, _stack=stack, _i0=i):
+                        from .parallel_layers.pp_layers import _escape
+                        pj, bj, j = xs
+                        pj = {n: pj[_escape(n)] for n in _stack.param_names}
+                        bj = {n: bj[_escape(n)] for n in _stack.buffer_names}
+                        out, _ = functional_call(
+                            _stack._template, pj, bj, h_c,
+                            rng=jax.random.fold_in(key, _i0 + j))
+                        return out, None
+
+                    js = jnp.arange(k)
+                    x, _ = lax.scan(jax.checkpoint(blk), x, (sp, sb, js))
+                    idx += run
+                    continue
+                if kind == "layer":
+                    mod = getattr(layers, f"mod{i}")
+                    x, _ = functional_call(
+                        mod, _extract(params, f"mod{i}"),
+                        _extract(buffers, f"mod{i}"), x,
+                        rng=jax.random.fold_in(key, i))
+                elif kind == "shared":
+                    _, owner_i, fw, attr = ent
+                    if fw is not None:
+                        w = params[layers.owner_weight_key(owner_i, attr)]
+                        x = fw(x, w)
+                    else:
+                        owner = getattr(layers, f"mod{owner_i}")
+                        x, _ = functional_call(
+                            owner, _extract(params, f"mod{owner_i}"),
+                            _extract(buffers, f"mod{owner_i}"), x,
+                            rng=jax.random.fold_in(key, i))
+                idx += 1
+            return x
+
+        return fwd
+
     # -- the SPMD pipelined loss -------------------------------------------
     def build_pipeline_loss_fn(self, loss_fn, micro_batches: int):
         """Return pure_loss(params, buffers, rng, inputs, labels) that runs
-        the GPipe schedule inside an active shard_map over the pipe axis.
+        the selected schedule inside an active shard_map over the pipe axis.
 
         inputs/labels are the FULL batch (replicated over pipe); they are
         re-split into `micro_batches` microbatches here (reference
         pipeline_parallel.py _load_micro_batch).
         """
-        layers = self._layers
         S = self.num_stages
         M = micro_batches
-        segment = layers.segment
-
-        def stage_forward(stage_id, params, buffers, h, key):
-            """Apply the layers of `stage_id` functionally."""
-            lo, hi = segment[stage_id], segment[stage_id + 1]
-            out = h
-            for i in range(lo, hi):
-                sub = layers.runs[i]
-                sub_prefix = f"runs.{i}"
-                sub_params = {k[len(sub_prefix) + 1:]: v for k, v in params.items()
-                              if k.startswith(sub_prefix + ".")}
-                sub_bufs = {k[len(sub_prefix) + 1:]: v for k, v in buffers.items()
-                            if k.startswith(sub_prefix + ".")}
-                (out), _ = functional_call(sub, sub_params, sub_bufs, out,
-                                           rng=jax.random.fold_in(key, i))
-            return out
+        stage_fns = [self._stage_forward_fn(s) for s in range(S)]
 
         def pure_loss(params, buffers, key, inputs, labels):
             sid = lax.axis_index(PIPE_AXIS)
@@ -89,22 +158,20 @@ class PipelineParallel(Layer):
             micro_lb = labels.reshape((M, mb) + labels.shape[1:])
 
             # probe the carry shape: trace stage0 on microbatch 0
-            h_shape = jax.eval_shape(
-                lambda: stage_forward(0, params, buffers,
-                                      micro_in[0], key)).shape
-            h_dtype = jax.eval_shape(
-                lambda: stage_forward(0, params, buffers,
-                                      micro_in[0], key)).dtype
+            probe = jax.eval_shape(
+                lambda: stage_fns[0](params, buffers, micro_in[0], key))
+            h_shape, h_dtype = probe.shape, probe.dtype
 
-            def apply_stage(s, h_in, m, key):
+            def apply_stage(s, m, key):
                 """Branch for stage s; every branch returns (h, loss)."""
                 def branch(h):
                     x0 = micro_in[m] if s == 0 else h
-                    out = stage_forward(s, params, buffers, x0, key)
+                    out = stage_fns[s](params, buffers, x0, key)
                     if s == S - 1:
                         l = loss_fn(out, micro_lb[m])
-                        return out.astype(h_dtype) if out.shape == h_shape \
-                            else jnp.zeros(h_shape, h_dtype), l
+                        return (out.astype(h_dtype)
+                                if out.shape == h_shape
+                                else jnp.zeros(h_shape, h_dtype)), l
                     return out, jnp.zeros((), jnp.float32)
                 return branch
 
@@ -113,7 +180,7 @@ class PipelineParallel(Layer):
                 m = jnp.clip(t - sid, 0, M - 1)
                 valid = (t - sid >= 0) & (t - sid < M)
                 k_t = jax.random.fold_in(key, t)
-                branches = [_remat_branch(apply_stage(s, h_recv, m, k_t))
+                branches = [jax.checkpoint(apply_stage(s, m, k_t))
                             for s in range(S)]
                 h_out, l = lax.switch(sid, branches, h_recv)
                 l = jnp.where(valid, l, 0.0)
@@ -131,10 +198,155 @@ class PipelineParallel(Layer):
             total = reduce_from_parallel_region(loss_acc, axis=PIPE_AXIS)
             return total / M
 
-        def _remat_branch(branch):
-            return jax.checkpoint(branch)
-
         return pure_loss
+
+    # -- 1F1B schedule (manual VJP) ----------------------------------------
+    def build_pipeline_grads_fn(self, loss_fn, micro_batches: int):
+        """Return pure_grads(params, buffers, rng, inputs, labels, wrt) ->
+        (loss, grads) running the 1F1B schedule (reference:
+        framework/section_worker.cc:139-183 — startup forwards, then
+        alternating backward/forward in steady state).
+
+        Unlike the GPipe scan (whose AD transpose stashes one activation
+        per tick, O(M + S)), this schedule differentiates each stage
+        locally with jax.vjp inside the tick and carries at most S stashed
+        stage inputs plus one gradient accumulator — in-flight microbatches
+        are bounded by num_stages, the 1F1B memory guarantee.
+
+        Timing (stage s, microbatch m, S stages), just-in-time variant:
+          forward:  t = s + 2f       (even t - s parity)
+          backward: t = 2S - 1 - s + 2m   (odd parity — strict 1F1B
+                    alternation; producers run exactly one tick before
+                    consumers in both directions, so one ppermute carry
+                    suffices, no inter-stage queues)
+        Total ticks: 2(M + S - 1). Each backward recomputes its stage
+        forward from the stashed input (remat semantics, like the GPipe
+        path's jax.checkpoint), so a stash slot is one activation, not a
+        residual set.
+        """
+        S = self.num_stages
+        M = micro_batches
+        stage_fns = [self._stage_forward_fn(s) for s in range(S)]
+
+        def pure_grads(params, buffers, key, inputs, labels, wrt):
+            sid = lax.axis_index(PIPE_AXIS)
+            mb = inputs.shape[0] // M
+            micro_in = inputs.reshape((M, mb) + inputs.shape[1:])
+            micro_lb = labels.reshape((M, mb) + labels.shape[1:])
+            wrt_params = {k: params[k] for k in wrt}
+            rest = {k: v for k, v in params.items() if k not in wrt}
+
+            def run_stage(s, wp, x0, m):
+                full = dict(rest)
+                full.update(wp)
+                return stage_fns[s](full, buffers, x0,
+                                    jax.random.fold_in(key, m))
+
+            probe = jax.eval_shape(
+                lambda: run_stage(0, wrt_params, micro_in[0], 0))
+            h_shape, h_dtype = probe.shape, probe.dtype
+            zeros_h = jnp.zeros(h_shape, h_dtype)
+            gzero = jax.tree_util.tree_map(
+                lambda v: jnp.zeros(jnp.shape(v), jnp.float32), wrt_params)
+
+            def fwd_branch(s):
+                def go(ops):
+                    h_recv, m = ops
+                    if s == S - 1:
+                        # last stage defers fwd to its backward's vjp
+                        return zeros_h
+                    x0 = micro_in[m] if s == 0 else h_recv
+                    out = run_stage(s, wrt_params, x0, m)
+                    return out.astype(h_dtype)
+                return go
+
+            def bwd_branch(s):
+                def go(ops):
+                    h_in, cot_in, m = ops
+                    if s == S - 1:
+                        if s == 0:
+                            # single-stage pipeline: input comes from the
+                            # microbatch, not the (never-written) stash
+                            def f0(wp):
+                                out = run_stage(0, wp, micro_in[m], m)
+                                return loss_fn(out, micro_lb[m])
+                            loss_m, vjp = jax.vjp(f0, wrt_params)
+                            (gw,) = vjp(jnp.float32(1.0 / M))
+                            return gw, zeros_h, loss_m
+
+                        def f(wp, h):
+                            out = run_stage(s, wp, h, m)
+                            return loss_fn(out, micro_lb[m])
+                        loss_m, vjp = jax.vjp(f, wrt_params, h_in)
+                        gw, gh = vjp(jnp.float32(1.0 / M))
+                        return gw, gh.astype(h_dtype), loss_m
+                    if s == 0:
+                        def f(wp):
+                            return run_stage(0, wp, micro_in[m], m)
+                        _, vjp = jax.vjp(f, wrt_params)
+                        (gw,) = vjp(cot_in)
+                        return gw, zeros_h, jnp.zeros((), jnp.float32)
+
+                    def f(wp, h):
+                        return run_stage(s, wp, h, m)
+                    _, vjp = jax.vjp(f, wrt_params, h_in)
+                    gw, gh = vjp(cot_in)
+                    return gw, gh.astype(h_dtype), jnp.zeros((), jnp.float32)
+                return go
+
+            fwd_branches = [fwd_branch(s) for s in range(S)]
+            bwd_branches = [bwd_branch(s) for s in range(S)]
+
+            fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+            bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+            def tick(carry, t):
+                h_recv, cot_recv, stash, gacc, loss_acc = carry
+                # -- forward phase: t_f(s, f) = s + 2f (just-in-time 1F1B:
+                # every producer runs exactly one tick before its consumer,
+                # so the single ppermute carry needs no inter-stage queue;
+                # forwards sit on even (t - s) parity, backwards on odd,
+                # so a stage never does both in one tick) --
+                td = t - sid
+                f_idx_raw = td // 2
+                fwd_valid = (td >= 0) & (td % 2 == 0) & (f_idx_raw < M)
+                f_idx = jnp.clip(f_idx_raw, 0, M - 1)
+                h_out = lax.switch(sid, fwd_branches, (h_recv, f_idx))
+                # stash this stage's INPUT for its later backward (in-flight
+                # <= S per stage, so the ring buffer never clobbers a live
+                # slot; stage 0 re-reads micro_in at backward time instead)
+                slot = f_idx % S
+                stash = stash.at[slot].set(
+                    jnp.where(fwd_valid & (sid > 0), h_recv, stash[slot]))
+                # -- backward phase (t = 2S - 1 - s + 2m) --
+                bd = t - (2 * S - 1 - sid)
+                m_num = bd // 2
+                bwd_valid = (bd >= 0) & (bd % 2 == 0) & (m_num < M)
+                m_idx = jnp.clip(m_num, 0, M - 1)
+                h_in = stash[m_idx % S]
+                gw, gh, loss_m = lax.switch(
+                    sid, bwd_branches, (h_in, cot_recv, m_idx))
+                gacc = jax.tree_util.tree_map(
+                    lambda a, g: a + jnp.where(bwd_valid, g, 0.0), gacc, gw)
+                loss_acc = loss_acc + jnp.where(bwd_valid, loss_m, 0.0)
+                # -- communicate --
+                h_next = lax.ppermute(
+                    jnp.where(fwd_valid, h_out, zeros_h), PIPE_AXIS, fwd_perm)
+                cot_next = lax.ppermute(
+                    jnp.where(bwd_valid, gh, zeros_h), PIPE_AXIS, bwd_perm)
+                return (h_next, cot_next, stash, gacc, loss_acc), None
+
+            stash0 = jnp.zeros((S,) + h_shape, h_dtype)
+            carry0 = (zeros_h, zeros_h, stash0, gzero,
+                      jnp.zeros((), jnp.float32))
+            (h_l, c_l, st_l, gacc, loss_acc), _ = lax.scan(
+                tick, carry0, jnp.arange(2 * (M + S - 1)))
+            from .parallel_layers.mp_layers import \
+                reduce_from_parallel_region
+            total = reduce_from_parallel_region(loss_acc, axis=PIPE_AXIS)
+            return total / M, gacc
+
+        return pure_grads
 
     # passthrough
     def state_dict(self, *args, **kwargs):
@@ -148,3 +360,11 @@ class PipelineParallel(Layer):
 
     def named_parameters(self, prefix="", include_sublayers=True):
         return self._layers.named_parameters(prefix, include_sublayers)
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        # delegate like named_parameters: buffer names must match the
+        # mod{i}./stack{g}. prefixes the stage forward extracts
+        return self._layers.named_buffers(prefix, include_sublayers)
+
+    def named_buffer_pspecs(self):
+        return self._layers.named_buffer_pspecs()

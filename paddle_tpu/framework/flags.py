@@ -37,6 +37,10 @@ def _define(name: str, default, help_: str = ""):
 
 # Debugging / numerics (live)
 _define("check_nan_inf", False, "scan outputs/grads for NaN/Inf each step")
+_define("check_replication", False,
+        "verify params declared replicated are bit-identical on every "
+        "device after each step (debug aid for the shard_map "
+        "check_vma=False declarations)")
 _define("benchmark", False, "synchronise device after each op/step")
 _define("check_kernel_launch", False, "alias of benchmark on TPU")
 # Threading / host (live where meaningful)

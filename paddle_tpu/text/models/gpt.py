@@ -25,7 +25,7 @@ from ...distributed.meta_parallel.parallel_layers.mp_layers import (
     ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
     VocabParallelEmbedding)
 from ...distributed.meta_parallel.parallel_layers.pp_layers import (
-    LayerDesc, PipelineLayer)
+    LayerDesc, PipelineLayer, SharedLayerDesc)
 
 
 def _sep_axis_bound() -> bool:
@@ -233,8 +233,8 @@ class _EmbeddingPipe(GPTEmbeddings):
 
 
 class _LNHeadPipe(Layer):
-    """Final LN + untied head for the PP build (tying across stages uses
-    SharedLayerDesc; untied here keeps the dry-run simple)."""
+    """Final LN + untied head for the PP build (the tied variant shares the
+    first stage's embedding weight via SharedLayerDesc instead)."""
 
     def __init__(self, hidden_size, vocab_size, epsilon=1e-5,
                  tensor_parallel=True):
@@ -247,18 +247,55 @@ class _LNHeadPipe(Layer):
         return self.head(self.ln_f(x))
 
 
+def _tied_head_forward(x, weight):
+    """LM head against the (stage-0-owned) embedding weight — the
+    SharedLayerDesc forward_func (reference pp_layers.py:62 tied embedding:
+    the weight lives once; here it is replicated over pipe and the engine's
+    pipe-axis grad psum sums the embedding-stage and head-stage
+    contributions)."""
+    from ...distributed.meta_parallel.parallel_layers.mp_layers import (
+        _in_shard_map, copy_to_model_parallel)
+    if _in_shard_map():
+        # vocab-sharded weight (TP): replicate the activation grad psum
+        x = copy_to_model_parallel(x)
+    return jnp.matmul(x, jnp.swapaxes(weight, 0, 1))
+
+
 def gpt_pipeline_descs(vocab_size=50304, hidden_size=768, num_layers=12,
                        num_heads=12, max_position_embeddings=1024,
-                       dropout=0.1, tensor_parallel=True):
-    """LayerDesc list for PipelineLayer (reference pp_layers.py usage)."""
-    descs = [LayerDesc(_EmbeddingPipe, vocab_size, hidden_size,
-                       max_position_embeddings, dropout,
-                       tensor_parallel=tensor_parallel)]
+                       dropout=0.1, tensor_parallel=True,
+                       tie_embeddings=True):
+    """LayerDesc list for PipelineLayer (reference pp_layers.py usage).
+
+    With ``tie_embeddings`` (reference default) the LM head reuses the word
+    embedding weight across stages via SharedLayerDesc; the final LayerNorm
+    stays a plain last-stage layer."""
+    if tie_embeddings:
+        descs = [SharedLayerDesc(
+            "embed", _EmbeddingPipe,
+            shared_weight_attr="word_embeddings.weight",
+            vocab_size=vocab_size, hidden_size=hidden_size,
+            max_position_embeddings=max_position_embeddings,
+            hidden_dropout_prob=dropout, tensor_parallel=tensor_parallel)]
+    else:
+        descs = [LayerDesc(_EmbeddingPipe, vocab_size, hidden_size,
+                           max_position_embeddings, dropout,
+                           tensor_parallel=tensor_parallel)]
     for _ in range(num_layers):
         descs.append(LayerDesc(GPTBlock, hidden_size, num_heads,
+                               attn_dropout=dropout, resid_dropout=dropout,
                                tensor_parallel=tensor_parallel))
-    descs.append(LayerDesc(_LNHeadPipe, hidden_size, vocab_size,
-                           tensor_parallel=tensor_parallel))
+    if tie_embeddings:
+        descs.append(LayerDesc(nn.LayerNorm, hidden_size))
+        descs.append(SharedLayerDesc(
+            "embed", _EmbeddingPipe, forward_func=_tied_head_forward,
+            shared_weight_attr="word_embeddings.weight",
+            vocab_size=vocab_size, hidden_size=hidden_size,
+            max_position_embeddings=max_position_embeddings,
+            hidden_dropout_prob=dropout, tensor_parallel=tensor_parallel))
+    else:
+        descs.append(LayerDesc(_LNHeadPipe, hidden_size, vocab_size,
+                               tensor_parallel=tensor_parallel))
     return descs
 
 
