@@ -1,22 +1,71 @@
-"""Benchmark: GPT-base (124M) bf16 training throughput on one TPU chip.
+"""Benchmark suite: training throughput on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-The reference publishes no in-repo numbers (BASELINE.md), so vs_baseline is
-reported against BASELINE.json's empty "published" table as 1.0 when the run
-succeeds; the absolute tokens/sec (and derived MFU) is the tracked number.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+The primary metric is GPT-base (124M) bf16 tokens/sec/chip; "extra" carries
+the additional BASELINE.md configs (ResNet-50 images/sec, BERT-base AMP
+samples/sec) so the perf story is not a single model. Each config is
+independently guarded — a failure records {"error": ...} for that config
+instead of crashing the whole bench (round-1 lesson: backend init died and
+the bench emitted nothing).
+
+FLOPs convention (stated per round-2 verdict): MFU uses the 6N
+approximation — 6 FLOPs per parameter per token (fwd 2N + bwd 4N),
+EXCLUDING attention score/context FLOPs (the PaLM-appendix convention
+without the 12·L·H·Q·T term). Peak is the v5e bf16 197 TFLOP/s figure.
+
+The reference publishes no in-repo numbers (BASELINE.md), so vs_baseline
+is 1.0 on success; the absolute numbers are the tracked quantity.
 """
 from __future__ import annotations
 
 import json
 import sys
 import time
+import traceback
 
 import numpy as np
 
+V5E_BF16_PEAK = 197e12
 
-def main():
-    import jax
 
+def _init_backend(retries: int = 4, backoff_s: float = 15.0):
+    """Import jax and force backend init, retrying with backoff.
+
+    Round 1's rc=1 was a one-shot crash in axon backend setup; transient
+    tunnel/plugin failures deserve another attempt, not an empty bench.
+    """
+    last = None
+    for attempt in range(retries):
+        try:
+            import jax
+            devs = jax.devices()  # forces platform/plugin initialization
+            # one tiny computation proves the runtime actually works
+            float(jax.numpy.zeros(()).sum())
+            return jax, devs
+        except Exception as e:  # noqa: BLE001 — anything in init is fatal-ish
+            last = e
+            sys.stderr.write(
+                f"bench: backend init attempt {attempt + 1}/{retries} "
+                f"failed: {e}\n")
+            if attempt < retries - 1:
+                time.sleep(backoff_s * (attempt + 1))
+    raise RuntimeError(f"backend init failed after {retries} attempts: {last}")
+
+
+def _timed_steps(trainer, inputs, labels, warmup: int, iters: int):
+    """Run warmup + timed steps; host-fetch the loss as the sync point
+    (under the axon tunnel block_until_ready can return early)."""
+    for _ in range(warmup):
+        loss = trainer.train_step(inputs, labels)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.train_step(inputs, labels)
+    final_loss = float(loss)
+    return time.perf_counter() - t0, final_loss
+
+
+def bench_gpt(on_tpu: bool):
     import paddle_tpu as paddle
     from paddle_tpu import nn
     from paddle_tpu.distributed.engine import ParallelTrainer
@@ -24,12 +73,9 @@ def main():
     from paddle_tpu.text.models import GPTForPretraining
 
     paddle.seed(0)
-    n_dev = len(jax.devices())
     build_mesh({"data": 1})
-
     vocab, hidden, layers, heads, seq = 50304, 768, 12, 12, 1024
     batch = 8
-    on_tpu = jax.default_backend() == "tpu"
     if not on_tpu:  # CPU smoke config
         vocab, hidden, layers, heads, seq, batch = 1024, 256, 2, 4, 256, 4
 
@@ -38,53 +84,137 @@ def main():
         num_layers=layers, num_heads=heads, max_position_embeddings=seq,
         attn_dropout=0.0, hidden_dropout=0.0)
     model.bfloat16()
-
     opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters())
 
     def loss_fn(logits, labels):
         # bf16 logits straight into the fused lse-gather CE fast path
-        # (fp32 accumulation happens inside; an astype here would
-        # materialize a full fp32 (b, s, vocab) tensor)
+        # (fp32 accumulation inside; astype here would materialize a full
+        # fp32 (b, s, vocab) tensor)
         return nn.functional.cross_entropy(logits, labels)
 
     trainer = ParallelTrainer(model, opt, loss_fn)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, vocab, (batch, seq)).astype("int32")
     labels = rng.randint(0, vocab, (batch, seq)).astype("int32")
-
-    # warmup (compile + flush; NOTE: under the axon tunnel
-    # block_until_ready returns early — a host fetch is the reliable sync)
-    for _ in range(12):
-        loss = trainer.train_step(ids, labels)
-    float(loss)
-
     iters = 20 if on_tpu else 3
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = trainer.train_step(ids, labels)
-    final_loss = float(loss)  # device->host sync
-    dt = time.perf_counter() - t0
-
+    dt, final_loss = _timed_steps(trainer, ids, labels,
+                                  warmup=12 if on_tpu else 2, iters=iters)
     tokens_per_sec = batch * seq * iters / dt
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    flops_per_tok = 6 * n_params
-    mfu = None
+    out = {"tokens_per_sec": round(tokens_per_sec, 1),
+           "params": n_params, "final_loss": round(final_loss, 4)}
     if on_tpu:
-        peak = 197e12  # v5e bf16 peak FLOP/s
-        mfu = tokens_per_sec * flops_per_tok / peak
+        out["mfu_6N"] = round(tokens_per_sec * 6 * n_params / V5E_BF16_PEAK,
+                              4)
+    return out
 
+
+def bench_resnet50(on_tpu: bool):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.engine import ParallelTrainer
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.vision.models import resnet50, resnet18
+
+    paddle.seed(0)
+    build_mesh({"data": 1})
+    if on_tpu:
+        model, batch, size, iters, warmup = resnet50(), 128, 224, 20, 8
+    else:
+        model, batch, size, iters, warmup = resnet18(), 4, 32, 2, 1
+    model.bfloat16()  # TPU AMP O2 equivalent: bf16 params + compute
+    opt = paddle.optimizer.Momentum(0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    trainer = ParallelTrainer(
+        model, opt, lambda o, y: nn.functional.cross_entropy(o, y))
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    # inputs must match the bf16 conv weights (XLA convs are same-dtype)
+    imgs = jnp.asarray(rng.randn(batch, 3, size, size), dtype=jnp.bfloat16)
+    lbls = rng.randint(0, 1000, (batch,)).astype("int32")
+    dt, final_loss = _timed_steps(trainer, imgs, lbls, warmup, iters)
+    return {"images_per_sec": round(batch * iters / dt, 1),
+            "final_loss": round(final_loss, 4)}
+
+
+def bench_bert_amp(on_tpu: bool):
+    """BERT-base MLM+NSP, bf16 (the TPU AMP: reference fp16_utils.py:322
+    cast_model_to_fp16 O2 maps to whole-model bf16 on TPU)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.engine import ParallelTrainer
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.text.models import BertForPretraining
+
+    paddle.seed(0)
+    build_mesh({"data": 1})
+    if on_tpu:
+        cfg = dict(vocab_size=30528, hidden_size=768, num_layers=12,
+                   num_heads=12, max_position_embeddings=512)
+        batch, seq, iters, warmup = 16, 128, 20, 8
+    else:
+        cfg = dict(vocab_size=1024, hidden_size=128, num_layers=2,
+                   num_heads=4, max_position_embeddings=128)
+        batch, seq, iters, warmup = 4, 64, 2, 1
+    model = BertForPretraining(tensor_parallel=False, attn_dropout=0.0,
+                               hidden_dropout=0.0, **cfg)
+    model.bfloat16()
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+
+    def loss_fn(outputs, labels):
+        mlm_logits, nsp_logits = outputs
+        mlm_labels, nsp_labels = labels
+        return model.loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels)
+
+    trainer = ParallelTrainer(model, opt, loss_fn)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg["vocab_size"], (batch, seq)).astype("int32")
+    mlm = np.full((batch, seq), -100, dtype="int32")
+    mlm[:, ::8] = rng.randint(0, cfg["vocab_size"], (batch, seq // 8))
+    nsp = rng.randint(0, 2, (batch,)).astype("int32")
+    dt, final_loss = _timed_steps(trainer, ids, (mlm, nsp), warmup, iters)
+    return {"samples_per_sec": round(batch * iters / dt, 1),
+            "final_loss": round(final_loss, 4)}
+
+
+def main():
+    try:
+        jax, _ = _init_backend()
+    except Exception as e:  # emit a parseable line even on total failure
+        print(json.dumps({
+            "metric": "gpt_base_train_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/sec", "vs_baseline": 0.0,
+            "error": f"backend init failed: {e}"}))
+        return 1
+    on_tpu = jax.default_backend() == "tpu"
+
+    extra = {}
+    for name, fn in (("gpt_base", bench_gpt),
+                     ("resnet50", bench_resnet50),
+                     ("bert_base_amp", bench_bert_amp)):
+        try:
+            extra[name] = fn(on_tpu)
+        except Exception as e:  # partial results beat an empty bench
+            sys.stderr.write(f"bench[{name}] failed:\n"
+                             f"{traceback.format_exc()}\n")
+            extra[name] = {"error": f"{type(e).__name__}: {e}"}
+
+    gpt = extra.get("gpt_base", {})
+    ok = "tokens_per_sec" in gpt
     result = {
         "metric": "gpt_base_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
+        "value": gpt.get("tokens_per_sec", 0.0),
         "unit": "tokens/sec",
-        "vs_baseline": 1.0,
+        "vs_baseline": 1.0 if ok else 0.0,
+        "flops_convention": "6N per token (no attention term)",
+        "extra": extra,
     }
-    if mfu is not None:
-        result["mfu"] = round(mfu, 4)
-        result["params"] = n_params
-        result["final_loss"] = round(final_loss, 4)
+    if "mfu_6N" in gpt:
+        result["mfu"] = gpt["mfu_6N"]
+        result["params"] = gpt["params"]
+        result["final_loss"] = gpt["final_loss"]
     print(json.dumps(result))
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

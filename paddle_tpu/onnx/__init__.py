@@ -29,9 +29,15 @@ def export(layer, path: str, input_spec=None, opset_version: int = 9,
     import warnings
     try:
         from ._writer import export_layer_to_onnx
+        if opset_version < 13:
+            warnings.warn(
+                f"opset_version={opset_version} promoted to 13: the "
+                "wire-format writer emits opset-13 ops (Gemm/Conv/"
+                "BatchNormalization attribute forms)")
+            opset_version = 13
         onnx_path = prefix + ".onnx"
         export_layer_to_onnx(layer, onnx_path, input_spec=input_spec,
-                             opset_version=max(opset_version, 13))
+                             opset_version=opset_version)
         return onnx_path
     except NotImplementedError as e:
         warnings.warn(

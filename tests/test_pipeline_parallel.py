@@ -273,8 +273,12 @@ class TestPipelineEdgeCases:
             opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
             tr = ParallelTrainer(model, opt, mse, micro_batches=m)
             if pp_degree > 1:
-                assert any(k.startswith("stack") for k in
-                           tr.state["buffers"]), "buffer stack missing"
+                bufs = {k: v for k, v in tr.state["buffers"].items()
+                        if k.startswith("stack")}
+                assert bufs, "buffer stack missing"
+                for k, v in bufs.items():  # physically pipe-sharded: 1/pp
+                    assert v.addressable_shards[0].data.shape[0] == \
+                        v.shape[0] // pp_degree, k
             return [float(tr.train_step(x, y)) for _ in range(3)]
 
         dense = run(1, 1)
