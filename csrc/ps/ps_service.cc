@@ -1,0 +1,304 @@
+// TCP RPC layer for the PS tables — the capability of the reference's brpc
+// parameter-server transport (reference behavior modeled:
+// distributed/service/brpc_ps_server.cc / brpc_ps_client.cc — remote
+// pull/push of sharded sparse tables; NOT a port: fresh blocking-socket
+// design, thread-per-connection server, length-prefixed binary frames, C ABI
+// for ctypes. Multi-host key routing happens ABOVE this layer in
+// distributed/ps/service.py by key hash — each server owns one shard.)
+//
+// Protocol (little-endian, fixed header):
+//   request : u8 op | u8 flag | i64 n
+//             op=0 HELLO: no body;              response: i32 dim
+//             op=1 PULL : body n*i64 keys;      response: n*dim f32
+//                         flag=1 -> create missing rows
+//             op=2 PUSH : body n*i64 keys, n*dim f32 grads, f32 lr;
+//                                               response: u8 1
+//             op=3 SIZE : no body;              response: i64 nrows
+// A malformed/short frame closes the connection. The server serves ONE
+// sparse table (its key shard); clients keep one connection per server and
+// serialize requests on it.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+// from sparse_table.cc
+void ps_sparse_pull(void* t, const int64_t* keys, int64_t n, float* out,
+                    int create_missing);
+void ps_sparse_push(void* t, const int64_t* keys, int64_t n,
+                    const float* grads, float lr);
+int64_t ps_sparse_size(void* t);
+}
+
+namespace {
+
+constexpr uint8_t kHello = 0, kPull = 1, kPush = 2, kSize = 3;
+
+bool ReadFull(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Server {
+  void* table = nullptr;
+  int dim = 0;
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::mutex conn_mu;
+  // connection fds and their threads; Serve() only SHUTS DOWN its fd on
+  // exit — the close (and thread join) happens in Reap()/ps_server_stop,
+  // so a stopping server can unblock reads via shutdown() without an
+  // fd-reuse race, and Serve threads never outlive the Server they
+  // dereference. AcceptLoop reaps finished connections so long-lived
+  // servers do not leak an fd + thread record per client.
+  struct Conn {
+    int fd;
+    std::thread th;
+    std::atomic<bool> done{false};
+    Conn(int f) : fd(f) {}
+  };
+  std::vector<std::unique_ptr<Conn>> conns;
+
+  void Serve(Conn* c) {
+    ServeFd(c->fd);
+    c->done.store(true);
+  }
+
+  void Reap() {  // caller holds conn_mu
+    for (auto it = conns.begin(); it != conns.end();) {
+      if ((*it)->done.load()) {
+        if ((*it)->th.joinable()) (*it)->th.join();
+        ::close((*it)->fd);
+        it = conns.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+
+  void ServeFd(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::vector<int64_t> keys;
+    std::vector<float> vals;
+    for (;;) {
+      uint8_t hdr[2];
+      int64_t n = 0;
+      if (!ReadFull(fd, hdr, 2) || !ReadFull(fd, &n, 8)) break;
+      if (n < 0 || n > (int64_t(1) << 28)) break;  // sanity cap
+      if (hdr[0] == kHello) {
+        int32_t d = dim;
+        if (!WriteFull(fd, &d, 4)) break;
+      } else if (hdr[0] == kPull) {
+        keys.resize(static_cast<size_t>(n));
+        vals.resize(static_cast<size_t>(n) * dim);
+        if (!ReadFull(fd, keys.data(), sizeof(int64_t) * n)) break;
+        ps_sparse_pull(table, keys.data(), n, vals.data(), hdr[1] ? 1 : 0);
+        if (!WriteFull(fd, vals.data(), sizeof(float) * n * dim)) break;
+      } else if (hdr[0] == kPush) {
+        keys.resize(static_cast<size_t>(n));
+        vals.resize(static_cast<size_t>(n) * dim);
+        float lr = 0.0f;
+        if (!ReadFull(fd, keys.data(), sizeof(int64_t) * n) ||
+            !ReadFull(fd, vals.data(), sizeof(float) * n * dim) ||
+            !ReadFull(fd, &lr, 4))
+          break;
+        ps_sparse_push(table, keys.data(), n, vals.data(), lr);
+        uint8_t ok = 1;
+        if (!WriteFull(fd, &ok, 1)) break;
+      } else if (hdr[0] == kSize) {
+        int64_t sz = ps_sparse_size(table);
+        if (!WriteFull(fd, &sz, 8)) break;
+      } else {
+        break;
+      }
+    }
+    ::shutdown(fd, SHUT_RDWR);  // close deferred to ps_server_stop
+  }
+
+  void AcceptLoop() {
+    for (;;) {
+      sockaddr_in peer{};
+      socklen_t len = sizeof(peer);
+      int fd = ::accept(listen_fd, reinterpret_cast<sockaddr*>(&peer), &len);
+      if (fd < 0) {
+        if (stop.load()) return;
+        // persistent error (e.g. EMFILE): don't busy-spin the core
+        std::this_thread::sleep_for(std::chrono::milliseconds(10));
+        std::lock_guard<std::mutex> lk(conn_mu);
+        Reap();
+        continue;
+      }
+      std::lock_guard<std::mutex> lk(conn_mu);
+      Reap();
+      conns.emplace_back(new Conn(fd));
+      Conn* c = conns.back().get();
+      c->th = std::thread([this, c]() { Serve(c); });
+    }
+  }
+};
+
+struct Client {
+  int fd = -1;
+  int dim = 0;
+  std::mutex mu;  // serialize request/response pairs
+};
+
+}  // namespace
+
+extern "C" {
+
+// Start serving `sparse_table` (a ps_sparse_create handle) on `port`
+// (0 = ephemeral). Returns a server handle or null.
+void* ps_server_start(void* sparse_table, int dim, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  // all interfaces: servers must be reachable from OTHER hosts (the
+  // multi-host PS topology); the endpoint string advertised to trainers
+  // is chosen by the Python layer
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 64) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  auto* s = new Server();
+  s->table = sparse_table;
+  s->dim = dim;
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread([s]() { s->AcceptLoop(); });
+  return s;
+}
+
+int ps_server_port(void* h) { return static_cast<Server*>(h)->port; }
+
+void ps_server_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->stop.store(true);
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> lk(s->conn_mu);
+    for (auto& c : s->conns) {
+      ::shutdown(c->fd, SHUT_RDWR);  // unblock any in-flight read
+      if (c->th.joinable()) c->th.join();
+      ::close(c->fd);
+    }
+    s->conns.clear();
+  }
+  delete s;
+}
+
+void* ps_client_connect(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  uint8_t hdr[2] = {kHello, 0};
+  int64_t n = 0;
+  int32_t dim = 0;
+  if (!WriteFull(fd, hdr, 2) || !WriteFull(fd, &n, 8) ||
+      !ReadFull(fd, &dim, 4)) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto* c = new Client();
+  c->fd = fd;
+  c->dim = dim;
+  return c;
+}
+
+int ps_client_dim(void* h) { return static_cast<Client*>(h)->dim; }
+
+int ps_client_pull(void* h, const int64_t* keys, int64_t n, float* out,
+                   int create_missing) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint8_t hdr[2] = {kPull, static_cast<uint8_t>(create_missing ? 1 : 0)};
+  if (!WriteFull(c->fd, hdr, 2) || !WriteFull(c->fd, &n, 8) ||
+      !WriteFull(c->fd, keys, sizeof(int64_t) * n) ||
+      !ReadFull(c->fd, out, sizeof(float) * n * c->dim))
+    return 0;
+  return 1;
+}
+
+int ps_client_push(void* h, const int64_t* keys, int64_t n,
+                   const float* grads, float lr) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint8_t hdr[2] = {kPush, 0};
+  uint8_t ok = 0;
+  if (!WriteFull(c->fd, hdr, 2) || !WriteFull(c->fd, &n, 8) ||
+      !WriteFull(c->fd, keys, sizeof(int64_t) * n) ||
+      !WriteFull(c->fd, grads, sizeof(float) * n * c->dim) ||
+      !WriteFull(c->fd, &lr, 4) || !ReadFull(c->fd, &ok, 1))
+    return 0;
+  return ok ? 1 : 0;
+}
+
+int64_t ps_client_size(void* h) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint8_t hdr[2] = {kSize, 0};
+  int64_t n = 0, sz = -1;
+  if (!WriteFull(c->fd, hdr, 2) || !WriteFull(c->fd, &n, 8) ||
+      !ReadFull(c->fd, &sz, 8))
+    return -1;
+  return sz;
+}
+
+void ps_client_close(void* h) {
+  auto* c = static_cast<Client*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

@@ -4,9 +4,10 @@
 // sharded key->row storage with per-row optimizer state, pull auto-creates
 // rows; framework/fleet/heter_ps/hashtable.h — hash-table embedding store;
 // NOT a port: this is a fresh std::unordered_map + std::thread design with a
-// C ABI for ctypes, no RPC/brpc layer — in the single-controller JAX runtime
-// the "server" lives in-process and multi-host sharding is done above by
-// key-hash routing).
+// C ABI for ctypes. The RPC transport lives in ps_service.cc (TCP frames);
+// multi-host sharding is done above by key-hash routing
+// (distributed/ps/service.py DistributedSparseTable), each server owning
+// one hash shard of the key space.
 //
 // Concurrency: keys hash to NUM_SHARDS sub-maps, each with its own mutex.
 // Batched pull/push fan out over worker threads; within one batch a shard

@@ -82,14 +82,19 @@ class DistributedEmbedding(Layer):
     """
 
     def __init__(self, dim: int, optimizer: str = "adagrad", lr: float = 0.05,
-                 seed: int = 0, init_range: float = 0.01, pooling=None):
+                 seed: int = 0, init_range: float = 0.01, pooling=None,
+                 table=None):
         super().__init__()
         from ...nn.initializer import Constant
         self.dim = dim
         self.lr = lr
         self.pooling = pooling  # None | "sum" | "mean"
-        self.table = SparseTable(dim, optimizer=optimizer, seed=seed,
-                                 init_range=init_range)
+        # `table` may be a DistributedSparseTable (service.py): lookups then
+        # route pull/push RPCs to the hash-owning PS server — the
+        # multi-host reference topology (brpc_ps_client fan-out)
+        self.table = table if table is not None else SparseTable(
+            dim, optimizer=optimizer, seed=seed, init_range=init_range)
+        assert self.table.dim == dim
         self.grad_hook = self.create_parameter((), initializer=Constant(0.0))
         self._lookup = make_lookup(self.table)
 

@@ -15,7 +15,7 @@ import threading
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(_HERE)))
 _SRC_DIR = os.path.join(_REPO, "csrc", "ps")
-_SOURCES = ["sparse_table.cc", "datafeed.cc"]
+_SOURCES = ["sparse_table.cc", "datafeed.cc", "ps_service.cc"]
 _LIB_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "lib")
 _LIB = os.path.join(_LIB_DIR, "libpaddle_ps.so")
 
@@ -83,6 +83,24 @@ def lib() -> ctypes.CDLL:
         dll.ps_dense_set.argtypes = [c.c_void_p, p_f32]
         dll.ps_dense_pull.argtypes = [c.c_void_p, p_f32]
         dll.ps_dense_push.argtypes = [c.c_void_p, p_f32, f32]
+
+        dll.ps_server_start.restype = c.c_void_p
+        dll.ps_server_start.argtypes = [c.c_void_p, c.c_int, c.c_int]
+        dll.ps_server_port.restype = c.c_int
+        dll.ps_server_port.argtypes = [c.c_void_p]
+        dll.ps_server_stop.argtypes = [c.c_void_p]
+        dll.ps_client_connect.restype = c.c_void_p
+        dll.ps_client_connect.argtypes = [c.c_char_p, c.c_int]
+        dll.ps_client_dim.restype = c.c_int
+        dll.ps_client_dim.argtypes = [c.c_void_p]
+        dll.ps_client_pull.restype = c.c_int
+        dll.ps_client_pull.argtypes = [c.c_void_p, p_i64, i64, p_f32,
+                                       c.c_int]
+        dll.ps_client_push.restype = c.c_int
+        dll.ps_client_push.argtypes = [c.c_void_p, p_i64, i64, p_f32, f32]
+        dll.ps_client_size.restype = i64
+        dll.ps_client_size.argtypes = [c.c_void_p]
+        dll.ps_client_close.argtypes = [c.c_void_p]
 
         dll.ps_datafeed_parse.restype = c.c_void_p
         dll.ps_datafeed_parse.argtypes = [c.c_char_p, c.c_int, p_int, c.c_int]

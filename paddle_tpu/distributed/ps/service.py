@@ -1,0 +1,215 @@
+"""Multi-host parameter-server service: RPC servers + key-hash routing.
+
+Capability map (reference): distributed/service/brpc_ps_server.cc /
+brpc_ps_client.cc (RPC pull/push of sharded tables) and
+service/communicator.h:197 (the async Communicator: trainers push grads to
+a send queue drained by a background thread — "geo"-style bounded
+staleness). The transport here is the fresh blocking-socket layer in
+csrc/ps/ps_service.cc; this module adds what the reference's
+`table/common_sparse_table.cc` sharding does across hosts: every logical
+key is owned by exactly one server, chosen by the same 64-bit hash mix the
+native table uses internally (``table.shard_keys``).
+
+Topology: each training process typically hosts ONE ``PsServer`` (its key
+shard) and a ``DistributedSparseTable`` client routing to ALL servers —
+rendezvous of "host:port" endpoints is left to the launcher (env vars /
+shared filesystem), mirroring PADDLE_PSERVER_ENDPOINTS.
+"""
+from __future__ import annotations
+
+import ctypes
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .native import lib
+from .table import SparseTable, shard_keys, _as_f32, _as_i64, _fp, _ip
+
+
+class PsServer:
+    """Serves one key shard of a sparse table over TCP (reference:
+    brpc_ps_server.cc). Owns the table; keeps it accessible in-process
+    (e.g. for checkpointing via ``table.save``)."""
+
+    def __init__(self, dim: int, optimizer: str = "adagrad", port: int = 0,
+                 host: str = "127.0.0.1", **table_kwargs):
+        self.table = SparseTable(dim, optimizer, **table_kwargs)
+        self._lib = lib()
+        self._h = self._lib.ps_server_start(self.table._h, dim, port)
+        if not self._h:
+            raise OSError(f"failed to start PS server on port {port}")
+        self.host = host
+        self.port = int(self._lib.ps_server_port(self._h))
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def stop(self):
+        if getattr(self, "_h", None):
+            self._lib.ps_server_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class _Conn:
+    def __init__(self, endpoint: str):
+        host, port = endpoint.rsplit(":", 1)
+        self._lib = lib()
+        self._h = self._lib.ps_client_connect(host.encode(), int(port))
+        if not self._h:
+            raise ConnectionError(f"cannot connect to PS at {endpoint}")
+        self.dim = int(self._lib.ps_client_dim(self._h))
+
+    def pull(self, keys: np.ndarray, create: bool) -> np.ndarray:
+        out = np.empty((keys.size, self.dim), dtype=np.float32)
+        if not self._lib.ps_client_pull(self._h, _ip(keys), keys.size,
+                                        _fp(out), 1 if create else 0):
+            raise ConnectionError("PS pull RPC failed")
+        return out
+
+    def push(self, keys: np.ndarray, grads: np.ndarray, lr: float):
+        if not self._lib.ps_client_push(self._h, _ip(keys), keys.size,
+                                        _fp(grads), lr):
+            raise ConnectionError("PS push RPC failed")
+
+    def size(self) -> int:
+        return int(self._lib.ps_client_size(self._h))
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.ps_client_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class DistributedSparseTable:
+    """Client view of a sparse table sharded across PS servers by key hash.
+
+    ``pull``/``push`` route each key to its owning server (reference:
+    brpc_ps_client pull_sparse/push_sparse fan-out). ``async_mode`` drains
+    pushes from a bounded queue on a background thread — the reference
+    Communicator's geo/async semantics (communicator.h:197): training does
+    not block on the push RPC, staleness is bounded by the queue depth.
+    """
+
+    def __init__(self, endpoints: Sequence[str], async_mode: bool = False,
+                 max_pending: int = 8):
+        assert endpoints, "need at least one PS endpoint"
+        self.conns: List[_Conn] = [_Conn(e) for e in endpoints]
+        self.dim = self.conns[0].dim
+        for e, c in zip(endpoints, self.conns):
+            if c.dim != self.dim:
+                raise ValueError(
+                    f"PS dim mismatch: {endpoints[0]} serves dim "
+                    f"{self.dim} but {e} serves dim {c.dim}")
+        self.n_shards = len(self.conns)
+        # per-shard RPCs fan out concurrently (each _Conn has its own
+        # socket+lock) — the reference brpc client's parallel fan-out;
+        # sequential round trips would cost n_shards x RTT per lookup
+        self._pool = (ThreadPoolExecutor(max_workers=self.n_shards)
+                      if self.n_shards > 1 else None)
+        self.async_mode = async_mode
+        self._err: Optional[BaseException] = None
+        if async_mode:
+            self._q: "queue.Queue" = queue.Queue(maxsize=max_pending)
+            self._worker = threading.Thread(target=self._drain, daemon=True)
+            self._worker.start()
+
+    # -- routing -------------------------------------------------------------
+    def _route(self, keys: np.ndarray):
+        assign = shard_keys(keys, self.n_shards)
+        for s in range(self.n_shards):
+            idx = np.nonzero(assign == s)[0]
+            if idx.size:
+                yield s, idx
+
+    def _fan_out(self, jobs):
+        if self._pool is None or len(jobs) <= 1:
+            for j in jobs:
+                j()
+            return
+        futs = [self._pool.submit(j) for j in jobs]
+        for f in futs:
+            f.result()  # re-raises ConnectionError from any shard
+
+    def pull(self, keys, create_missing: bool = True) -> np.ndarray:
+        keys = _as_i64(keys)
+        flat = keys.reshape(-1)
+        out = np.empty((flat.size, self.dim), dtype=np.float32)
+
+        def job(s, idx):
+            def go():
+                out[idx] = self.conns[s].pull(
+                    np.ascontiguousarray(flat[idx]), create_missing)
+            return go
+
+        self._fan_out([job(s, idx) for s, idx in self._route(flat)])
+        return out.reshape(keys.shape + (self.dim,))
+
+    def _push_sync(self, keys: np.ndarray, grads: np.ndarray, lr: float):
+        def job(s, idx):
+            def go():
+                self.conns[s].push(np.ascontiguousarray(keys[idx]),
+                                   np.ascontiguousarray(grads[idx]), lr)
+            return go
+
+        self._fan_out([job(s, idx) for s, idx in self._route(keys)])
+
+    def push(self, keys, grads, lr: float):
+        keys = _as_i64(keys).reshape(-1)
+        grads = _as_f32(grads).reshape(keys.size, self.dim)
+        if not self.async_mode:
+            self._push_sync(keys, grads, lr)
+            return
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+        # copies: the caller may reuse/donate its buffers
+        self._q.put((keys.copy(), grads.copy(), float(lr)))
+
+    def _drain(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            try:
+                self._push_sync(*item)
+            except BaseException as e:  # surfaced on next push/flush
+                self._err = e
+            finally:
+                self._q.task_done()
+
+    def flush(self):
+        """Barrier for async pushes (reference Communicator barrier)."""
+        if self.async_mode:
+            self._q.join()
+            if self._err is not None:
+                err, self._err = self._err, None
+                raise err
+
+    def shard_sizes(self) -> List[int]:
+        return [c.size() for c in self.conns]
+
+    def close(self):
+        if self.async_mode and self._worker.is_alive():
+            self._q.join()
+            self._q.put(None)
+            self._worker.join(timeout=5)
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+        for c in self.conns:
+            c.close()

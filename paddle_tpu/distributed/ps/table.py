@@ -2,10 +2,10 @@
 
 Capability map (reference): distributed/table/common_sparse_table.cc (sharded
 key->row store, server-side optimizer), common_dense_table.cc,
-framework/fleet/fleet_wrapper.h:69 (pull/push entry points). The brpc RPC
-layer has no analogue here: in single-controller JAX the table lives
-in-process; multi-host deployments shard keys by hash across hosts (see
-``shard_keys``) and route pull/push with jax alltoall at the array level.
+framework/fleet/fleet_wrapper.h:69 (pull/push entry points). These classes
+are the in-process view; the RPC tier (reference brpc_ps_server/client) is
+``service.py`` — PsServer/DistributedSparseTable over csrc/ps/ps_service.cc
+— which hash-routes every key to its owning server via ``shard_keys``.
 """
 from __future__ import annotations
 
