@@ -370,12 +370,18 @@ class TestReviewRegressions:
         np.testing.assert_allclose(np.asarray(out), np.asarray(y_eager),
                                    rtol=1e-5, atol=1e-5)
 
-    def test_flash_attention_ragged_seq_raises(self):
-        import pytest
+    def test_flash_attention_ragged_seq_supported(self):
+        """Round 3: ragged (non-128-multiple) sequences run the kernel via
+        tail padding + in-kernel column masking (previously a ValueError)."""
+        from paddle_tpu.nn.functional.attention import _xla_attention
         from paddle_tpu.ops.pallas.flash_attention import flash_attention
-        z = jnp.zeros((1, 200, 2, 64))
-        with pytest.raises(ValueError, match="divisible"):
-            flash_attention(z, z, z, interpret=True)
+        rs = np.random.RandomState(2)
+        q = jnp.asarray(rs.randn(1, 200, 2, 64), jnp.float32)
+        for causal in (False, True):
+            out = flash_attention(q, q, q, causal=causal, interpret=True)
+            ref = _xla_attention(q, q, q, causal=causal)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=2e-3, atol=2e-3)
 
 
 class TestZeroStage3:
