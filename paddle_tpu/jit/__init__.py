@@ -3,10 +3,12 @@ to_static, fluid/dygraph/jit.py:515 save, :876 load; dy2static AST machinery
 fluid/dygraph/dygraph_to_static/).
 
 The reference rewrites Python ASTs into a static Program. Here staging is
-jax.jit over the functionalized layer — no AST translation; Python control
-flow on traced values must use lax.cond/scan, exactly XLA's contract.
-Export is StableHLO via jax.export (replacing save_inference_model's
-serialized ProgramDesc).
+jax.jit over the functionalized layer, preceded by the dy2static AST pass
+(dy2static.py): Python if/while/for-range over traced values are rewritten
+to lax.cond/while_loop, boolean ops become tensor-aware lazy converters,
+and unsupported constructs raise source-located diagnostics. Export is
+StableHLO via jax.export (replacing save_inference_model's serialized
+ProgramDesc).
 """
 from __future__ import annotations
 
@@ -66,14 +68,33 @@ class TracedLayer:
 
 
 def to_static(layer_or_fn=None, input_spec=None, **jit_kwargs):
-    """Decorator/wrapper: stage a Layer or function with jax.jit
-    (reference: paddle.jit.to_static)."""
+    """Decorator/wrapper: stage a Layer or function with jax.jit after the
+    dy2static AST pass (reference: paddle.jit.to_static ->
+    program_translator.py:232 StaticFunction; AST transformers in
+    dygraph_to_static/ast_transformer.py). Python if/while/for-range over
+    traced tensors become lax.cond/while_loop; see jit/dy2static.py."""
 
     def wrap(obj):
         from ..nn.layer import Layer
+        if not ProgramTranslator.enable_to_static:
+            return obj
         if isinstance(obj, Layer):
+            import types
+
+            from .dy2static import convert_function
+            try:
+                converted = convert_function(type(obj).forward)
+                obj.forward = types.MethodType(converted, obj)
+            except Exception as e:  # uncovered shape: stage the original
+                import warnings
+                warnings.warn(
+                    f"dy2static: AST conversion of "
+                    f"{type(obj).__name__}.forward failed ({e}); staging "
+                    "the original forward (tensor-dependent Python control "
+                    "flow will fail to trace)")
             return TracedLayer(obj, input_spec, jit_kwargs)
-        return jax.jit(obj, **jit_kwargs)
+        from .dy2static import convert_function
+        return jax.jit(convert_function(obj), **jit_kwargs)
 
     if layer_or_fn is None:
         return wrap

@@ -1,0 +1,538 @@
+"""dy2static — Python control flow over traced values staged into lax.
+
+Reference: fluid/dygraph/dygraph_to_static/ast_transformer.py (the
+IfElse/Loop/LogicalOp/Print transformer pipeline) and
+program_translator.py:232 StaticFunction. The reference rewrites Python
+source into ProgramDesc ops; here the same AST rewriting targets JAX:
+
+    if cond: ...            ->  _jst.convert_ifelse(cond, true_fn, false_fn)
+    while cond: ...         ->  _jst.convert_while(cond_fn, body_fn, vars)
+    for i in range(...):    ->  while-form via normalize_range
+    a and b / a or b / not  ->  lazy convert_logical_* (tensor-aware,
+                                Python semantics preserved otherwise)
+    print(x)                ->  convert_print (jax.debug.print when traced)
+
+Each converter picks the lax primitive when the condition is a tracer and
+plain Python otherwise, so converted functions behave identically outside
+jit. Unsupported constructs under a tensor-dependent condition
+(return/break/continue inside the statement) raise Dy2StaticError with the
+original source location — the reference's error.py diagnostics contract.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["convert_function", "Dy2StaticError"]
+
+
+class Dy2StaticError(RuntimeError):
+    pass
+
+
+class _Undefined:
+    """Sentinel for variables assigned in only some branches (reference
+    dygraph_to_static undefined-var handling)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<dy2static undefined>"
+
+    def __bool__(self):
+        raise Dy2StaticError(
+            "variable is undefined on this control-flow path (assigned in "
+            "only one branch of a converted statement)")
+
+
+UNDEFINED = _Undefined()
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _loc(filename, lineno):
+    return f"{filename}:{lineno}" if lineno else filename
+
+
+# ---------------------------------------------------------------------------
+# runtime converters (called by the transformed code as _jst.*)
+# ---------------------------------------------------------------------------
+_STRUCTURE_ERR_HINTS = ("true_fun", "false_fun", "body_fun", "cond_fun",
+                        "pytree", "not a valid JAX type", "tree structure",
+                        "output must have", "must have same type structure",
+                        "differs from", "mismatch")
+
+
+def _is_structure_error(e: TypeError) -> bool:
+    msg = str(e)
+    return any(h in msg for h in _STRUCTURE_ERR_HINTS)
+
+
+def convert_ifelse(cond, true_fn, false_fn, init, names,
+                   filename="<dy2static>", lineno=0):
+    """Branch fns take the CURRENT values of every assigned name as
+    arguments (a branch that reads-then-writes a name would otherwise see
+    it as an unbound local — the reference passes branch inputs the same
+    way)."""
+    if not _is_tracer(cond):
+        return (true_fn if cond else false_fn)(*init)
+    # UNDEFINED placeholders are not JAX types: route them through the
+    # closure, pass only real values as lax.cond operands (a branch that
+    # assigns them returns arrays; a branch that doesn't returns UNDEFINED
+    # and the output-structure mismatch raises the diagnostic below)
+    defined = [i for i, v in enumerate(init) if v is not UNDEFINED]
+    ops = tuple(init[i] for i in defined)
+
+    def call(fn, t):
+        full = list(init)
+        for j, i in enumerate(defined):
+            full[i] = t[j]
+        return fn(*full)
+
+    try:
+        return jax.lax.cond(cond, lambda t: call(true_fn, t),
+                            lambda t: call(false_fn, t), ops)
+    except TypeError as e:
+        if not _is_structure_error(e):
+            raise  # a genuine user error inside a branch, not ours
+        raise Dy2StaticError(
+            f"{_loc(filename, lineno)}: tensor-dependent `if` branches "
+            f"must produce matching variables {list(names)} (a variable "
+            f"assigned in only one branch, or with different shape/dtype "
+            f"per branch, cannot be staged into lax.cond): {e}") from e
+
+
+def convert_while(cond_fn, body_fn, init, names, filename="<dy2static>",
+                  lineno=0):
+    first = cond_fn(*init)
+    if not _is_tracer(first) and not any(_is_tracer(v) for v in init):
+        vars_ = tuple(init)
+        while cond_fn(*vars_):
+            vars_ = tuple(body_fn(*vars_))
+        return vars_
+    for n, v in zip(names, init):
+        if v is UNDEFINED:
+            raise Dy2StaticError(
+                f"{_loc(filename, lineno)}: loop variable {n!r} is not "
+                "defined before this tensor-dependent loop; lax.while_loop "
+                "needs an initial value for every variable assigned in "
+                "the body")
+    init = tuple(jnp.asarray(v) if isinstance(v, (int, float, bool))
+                 else v for v in init)
+    try:
+        return jax.lax.while_loop(lambda t: cond_fn(*t),
+                                  lambda t: tuple(body_fn(*t)), init)
+    except TypeError as e:
+        if not _is_structure_error(e):
+            raise
+        raise Dy2StaticError(
+            f"{_loc(filename, lineno)}: tensor-dependent `while` body must "
+            f"keep every loop variable {list(names)} at a fixed "
+            f"shape/dtype across iterations: {e}") from e
+
+
+def normalize_range(*args):
+    if len(args) == 1:
+        return 0, args[0], 1
+    if len(args) == 2:
+        return args[0], args[1], 1
+    return args[0], args[1], args[2]
+
+
+def range_cond(i, stop, step):
+    if _is_tracer(step):
+        return jnp.where(step > 0, i < stop, i > stop)
+    return (i < stop) if step > 0 else (i > stop)
+
+
+def convert_logical_and(lhs, rhs_fn):
+    if _is_tracer(lhs):
+        return jnp.logical_and(lhs, rhs_fn())
+    return lhs and rhs_fn()
+
+
+def convert_logical_or(lhs, rhs_fn):
+    if _is_tracer(lhs):
+        return jnp.logical_or(lhs, rhs_fn())
+    return lhs or rhs_fn()
+
+
+def convert_logical_not(x):
+    if _is_tracer(x):
+        return jnp.logical_not(x)
+    return not x
+
+
+def convert_print(*args, **kwargs):
+    if any(_is_tracer(a) for a in args):
+        fmt = " ".join("{}" for _ in args)
+        jax.debug.print(fmt, *args)
+        return None
+    return print(*args, **kwargs)
+
+
+def assert_python_value(value, construct, filename="<dy2static>", lineno=0):
+    """Guard for statements left in Python form because they contain
+    constructs lax cannot stage (return/break/continue, or a for-loop that
+    reassigns its own loop variable)."""
+    if _is_tracer(value):
+        raise Dy2StaticError(
+            f"{_loc(filename, lineno)}: this `{construct}` contains "
+            "return/break/continue (or reassigns its loop variable), which "
+            "cannot be staged into a lax-converted control-flow op, but "
+            "its condition depends on a traced tensor. Restructure to "
+            "avoid early exits (accumulate a result and return after the "
+            "statement), or hoist the decision out of the jitted function.")
+    return value
+
+
+# ---------------------------------------------------------------------------
+# AST transformation
+# ---------------------------------------------------------------------------
+_JST = "__jst__"
+
+
+def _assigned_names(stmts):
+    """Names bound in the statement list — Store names, import aliases,
+    nested def/class names — excluding nested function/class BODIES and
+    comprehensions (their own scope in py3)."""
+    names = []
+
+    def add(n):
+        if n not in names:
+            names.append(n)
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            if not node.name.startswith("__dy2s_"):
+                add(node.name)  # the binding, not the body's scope
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            add(node.name)
+
+        def visit_Lambda(self, node):
+            pass
+
+        visit_ListComp = visit_Lambda
+        visit_SetComp = visit_Lambda
+        visit_DictComp = visit_Lambda
+        visit_GeneratorExp = visit_Lambda
+
+        def visit_Import(self, node):
+            for a in node.names:
+                add(a.asname or a.name.split(".")[0])
+
+        def visit_ImportFrom(self, node):
+            for a in node.names:
+                add(a.asname or a.name)
+
+        def visit_Name(self, node):
+            if isinstance(node.ctx, ast.Store):
+                add(node.id)
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return names
+
+
+def _loaded_names(node):
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            out.add(n.id)
+    return out
+
+
+def _has_exits(stmts):
+    """return/break/continue at this statement level (not nested defs)."""
+    found = []
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+        def visit_Return(self, node):
+            found.append("return")
+
+        def visit_Break(self, node):
+            found.append("break")
+
+        def visit_Continue(self, node):
+            found.append("continue")
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return found
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _jst_attr(attr):
+    return ast.Attribute(value=_name(_JST), attr=attr, ctx=ast.Load())
+
+
+def _call(fn_attr, args, keywords=None):
+    return ast.Call(func=_jst_attr(fn_attr), args=args,
+                    keywords=keywords or [])
+
+
+def _const(v):
+    return ast.Constant(value=v)
+
+
+def _undef_guard(name):
+    # vN = locals().get("vN", __jst__.UNDEFINED)
+    return ast.Assign(
+        targets=[_name(name, ast.Store())],
+        value=ast.Call(
+            func=ast.Attribute(
+                value=ast.Call(func=_name("locals"), args=[], keywords=[]),
+                attr="get", ctx=ast.Load()),
+            args=[_const(name), _jst_attr("UNDEFINED")], keywords=[]))
+
+
+def _tuple_of(names, ctx=None):
+    return ast.Tuple(elts=[_name(n, ctx or ast.Load()) for n in names],
+                     ctx=ctx or ast.Load())
+
+
+def _fn_def(name, argnames, body):
+    return ast.FunctionDef(
+        name=name,
+        args=ast.arguments(posonlyargs=[], args=[ast.arg(arg=a)
+                                                 for a in argnames],
+                           kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=body, decorator_list=[], returns=None, type_params=[])
+
+
+class _Transformer(ast.NodeTransformer):
+    def __init__(self, filename):
+        self.filename = filename
+        self.counter = 0
+
+    def _n(self, base):
+        self.counter += 1
+        return f"__dy2s_{base}_{self.counter}"
+
+    # -- boolean ops (lazy, tensor-aware) ----------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        conv = ("convert_logical_and" if isinstance(node.op, ast.And)
+                else "convert_logical_or")
+        out = node.values[-1]
+        for lhs in reversed(node.values[:-1]):
+            out = _call(conv, [lhs, ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=out)])
+        return ast.copy_location(out, node)
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.copy_location(
+                _call("convert_logical_not", [node.operand]), node)
+        return node
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if isinstance(node.func, ast.Name) and node.func.id == "print" \
+                and not node.keywords:
+            return ast.copy_location(
+                _call("convert_print", node.args), node)
+        return node
+
+    # -- if / while / for ---------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        exits = _has_exits(node.body) + _has_exits(node.orelse)
+        if exits:
+            # leave in Python form, but fail loudly (with location) if the
+            # condition turns out to be a tracer
+            node.test = ast.copy_location(
+                _call("assert_python_value",
+                      [node.test, _const("if"), _const(self.filename),
+                       _const(node.lineno)]), node.test)
+            return node
+        names = sorted(set(_assigned_names(node.body) +
+                           _assigned_names(node.orelse)))
+        tf, ff = self._n("true_fn"), self._n("false_fn")
+        ret = ast.Return(value=_tuple_of(names))
+        stmts = [_undef_guard(n) for n in names]
+        stmts.append(_fn_def(tf, names, list(node.body) + [ret]))
+        stmts.append(_fn_def(ff, names, (list(node.orelse) or [ast.Pass()])
+                             + [ret]))
+        assign = ast.Assign(
+            targets=[_tuple_of(names, ast.Store())] if names else
+                    [_name(self._n("void"), ast.Store())],
+            value=_call("convert_ifelse",
+                        [node.test, _name(tf), _name(ff), _tuple_of(names),
+                         ast.Tuple(elts=[_const(n) for n in names],
+                                   ctx=ast.Load()),
+                         _const(self.filename), _const(node.lineno)]))
+        stmts.append(assign)
+        return [ast.copy_location(ast.fix_missing_locations(s), node)
+                for s in stmts]
+
+    def _while_form(self, node, test_expr, body_stmts, extra_loop_names=()):
+        names = sorted(set(_assigned_names(body_stmts))
+                       | set(extra_loop_names)
+                       | (_loaded_names(test_expr)
+                          & set(_assigned_names(body_stmts))))
+        cf, bf = self._n("while_cond"), self._n("while_body")
+        stmts = [_undef_guard(n) for n in names]
+        stmts.append(_fn_def(cf, names, [ast.Return(value=test_expr)]))
+        stmts.append(_fn_def(
+            bf, names, list(body_stmts) + [ast.Return(value=_tuple_of(names))]))
+        assign = ast.Assign(
+            targets=[_tuple_of(names, ast.Store())] if names else
+                    [_name(self._n("void"), ast.Store())],
+            value=_call("convert_while",
+                        [_name(cf), _name(bf), _tuple_of(names),
+                         ast.Tuple(elts=[_const(n) for n in names],
+                                   ctx=ast.Load()),
+                         _const(self.filename), _const(node.lineno)]))
+        stmts.append(assign)
+        return [ast.copy_location(ast.fix_missing_locations(s), node)
+                for s in stmts]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            return node  # while/else: Python-only construct, leave as-is
+        if _has_exits(node.body):
+            node.test = ast.copy_location(
+                _call("assert_python_value",
+                      [node.test, _const("while"), _const(self.filename),
+                       _const(node.lineno)]), node.test)
+            return node
+        return self._while_form(node, node.test, node.body)
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords
+                    and isinstance(node.target, ast.Name)
+                    # a body that reassigns the loop variable would corrupt
+                    # the while-form induction (Python's range reassigns it
+                    # fresh each iteration): leave such loops in Python
+                    and node.target.id not in _assigned_names(node.body))
+        if not is_range or node.orelse or _has_exits(node.body):
+            if isinstance(node.iter, ast.Call) and \
+                    isinstance(node.iter.func, ast.Name) and \
+                    node.iter.func.id == "range" and not node.iter.keywords:
+                node.iter.args = [ast.copy_location(
+                    _call("assert_python_value",
+                          [a, _const("for"), _const(self.filename),
+                           _const(node.lineno)]), a)
+                    for a in node.iter.args]
+            return node
+        t = node.target.id
+        start_n, stop_n, step_n = (self._n("start"), self._n("stop"),
+                                   self._n("step"))
+        setup = [
+            ast.Assign(
+                targets=[ast.Tuple(elts=[_name(start_n, ast.Store()),
+                                         _name(stop_n, ast.Store()),
+                                         _name(step_n, ast.Store())],
+                                   ctx=ast.Store())],
+                value=_call("normalize_range", list(node.iter.args))),
+            ast.Assign(targets=[_name(t, ast.Store())],
+                       value=_name(start_n)),
+        ]
+        setup = [ast.copy_location(ast.fix_missing_locations(s), node)
+                 for s in setup]
+        test = _call("range_cond", [_name(t), _name(stop_n), _name(step_n)])
+        inc = ast.AugAssign(target=_name(t, ast.Store()), op=ast.Add(),
+                            value=_name(step_n))
+        return setup + self._while_form(
+            node, test, list(node.body) + [inc], extra_loop_names=(t,))
+
+
+class _GlobalsProxy(dict):
+    """exec namespace that falls through to the function's LIVE module
+    globals: names defined later in the module (helpers below the decorated
+    function, monkeypatched globals, self-recursion) keep working. CPython
+    supports dict subclasses with __missing__ as exec globals."""
+
+    def __init__(self, live, extra):
+        super().__init__(extra)
+        self._live = live
+
+    def __missing__(self, key):
+        return self._live[key]
+
+
+def convert_function(fn):
+    """AST-convert `fn` (reference: program_translator StaticFunction).
+    Falls back to the original function (with a warning) when the source
+    is unavailable (builtins, REPL lambdas, already-compiled code) or the
+    function needs a __class__ cell (zero-arg super())."""
+    if "__class__" in fn.__code__.co_freevars:
+        warnings.warn(
+            f"dy2static: {fn.__qualname__} uses zero-arg super() — the "
+            "__class__ cell cannot be rebuilt through recompilation; "
+            "running without AST conversion")
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        filename = inspect.getsourcefile(fn) or "<dy2static>"
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError) as e:
+        warnings.warn(f"dy2static: cannot convert {fn!r} ({e}); running "
+                      "without AST conversion")
+        return fn
+    # diagnostics and tracebacks must point at the real file lines
+    ast.increment_lineno(tree, fn.__code__.co_firstlineno - 1)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        warnings.warn(f"dy2static: {fn!r} is not a plain function; running "
+                      "without AST conversion")
+        return fn
+    fdef.decorator_list = []  # don't re-apply @to_static etc.
+    _Transformer(filename).visit(fdef)
+    ast.fix_missing_locations(tree)
+    code = compile(tree, filename=filename, mode="exec")
+    import paddle_tpu.jit.dy2static as _self
+    extra = {_JST: _self}
+    if fn.__closure__:
+        # re-bind free variables by value (cells cannot be carried through
+        # recompilation; late rebinding of closed-over names is not
+        # supported — the reference has the same snapshot semantics)
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                extra[name] = cell.cell_contents
+            except ValueError:
+                pass
+    namespace = _GlobalsProxy(fn.__globals__, extra)
+    exec(code, namespace)
+    new_fn = namespace[fdef.name]
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    functools.update_wrapper(new_fn, fn, updated=[])
+    new_fn.__wrapped__ = fn
+    return new_fn

@@ -1,0 +1,354 @@
+"""dy2static tests — ported from the reference's dygraph_to_static suite
+style (fluid/tests/unittests/dygraph_to_static/: test_ifelse, test_loop,
+test_for_enumerate, test_logical, test_print, test_program_translator):
+the SAME Python function must (a) run eagerly unchanged and (b) stage under
+jax.jit via the AST pass when control flow depends on traced tensors."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit.dy2static import Dy2StaticError, convert_function
+
+
+def _staged(fn):
+    return jax.jit(convert_function(fn))
+
+
+class TestIfElse:
+    def test_tensor_if(self):  # ref: test_ifelse.py dyfunc_with_if_else
+        def f(x):
+            if jnp.sum(x) > 0:
+                y = x + 1.0
+            else:
+                y = x - 1.0
+            return y
+
+        g = _staged(f)
+        xp = jnp.ones((3,))
+        xn = -jnp.ones((3,))
+        np.testing.assert_allclose(g(xp), f(xp))
+        np.testing.assert_allclose(g(xn), f(xn))
+        # really one compiled function taking both paths
+        np.testing.assert_allclose(g(xp), xp + 1.0)
+        np.testing.assert_allclose(g(xn), xn - 1.0)
+
+    def test_nested_if(self):  # ref: dyfunc_with_if_else3 nesting
+        def f(x):
+            s = jnp.sum(x)
+            if s > 0:
+                if s > 10:
+                    y = x * 3.0
+                else:
+                    y = x * 2.0
+            else:
+                y = -x
+            return y
+
+        g = _staged(f)
+        for v in (0.1, 5.0, -1.0):
+            x = jnp.full((4,), v)
+            np.testing.assert_allclose(g(x), f(x))
+
+    def test_python_if_untouched(self):
+        def f(x, flag=True):
+            if flag:  # plain Python condition stays Python
+                y = x * 2
+            else:
+                y = x * 3
+            return y
+
+        g = _staged(f)
+        x = jnp.ones((2,))
+        np.testing.assert_allclose(g(x), 2.0)
+
+    def test_one_branch_assignment_diagnostic(self):
+        def f(x):
+            if jnp.sum(x) > 0:
+                y = x + 1  # only this branch defines y
+            return y  # noqa: F821
+
+        with pytest.raises(Dy2StaticError, match="matching variables"):
+            jax.jit(convert_function(f))(jnp.ones((2,)))
+
+    def test_early_return_diagnostic(self):
+        def f(x):
+            if jnp.sum(x) > 0:
+                return x + 1
+            return x - 1
+
+        with pytest.raises(Dy2StaticError, match="return/break/continue"):
+            jax.jit(convert_function(f))(jnp.ones((2,)))
+
+    def test_early_return_python_cond_ok(self):
+        def f(x, n):
+            if n > 0:  # Python value: early return is fine
+                return x + n
+            return x - n
+
+        g = jax.jit(convert_function(f), static_argnums=1)
+        np.testing.assert_allclose(g(jnp.ones((2,)), 3), 4.0)
+        np.testing.assert_allclose(g(jnp.ones((2,)), -3), 4.0)
+
+
+class TestLoops:
+    def test_tensor_while(self):  # ref: test_loop.py while_loop_dyfunc
+        def f(x):
+            s = jnp.zeros(())
+            while s < 10.0:
+                s = s + jnp.sum(x)
+            return s
+
+        g = _staged(f)
+        x = jnp.ones((3,))
+        np.testing.assert_allclose(g(x), f(x))
+
+    def test_while_multiple_vars(self):
+        def f(x):
+            i = jnp.zeros((), jnp.int32)
+            acc = jnp.zeros_like(x)
+            while i < 5:
+                acc = acc + x * (i + 1)
+                i = i + 1
+            return acc, i
+
+        g = _staged(f)
+        x = jnp.arange(3.0)
+        a0, i0 = f(x)
+        a1, i1 = g(x)
+        np.testing.assert_allclose(a0, a1)
+        assert int(i0) == int(i1) == 5
+
+    def test_for_range_tensor_bound(self):  # ref: for_loop_dyfunc
+        def f(x, n):
+            acc = jnp.zeros_like(x)
+            for i in range(n):
+                acc = acc + x + i
+            return acc
+
+        g = _staged(f)
+        x = jnp.ones((2,))
+        n = jnp.asarray(4)
+        np.testing.assert_allclose(g(x, n),
+                                   f(x, int(n)))
+
+    def test_for_range_start_stop_step(self):
+        def f(n):
+            s = jnp.zeros((), jnp.int32)
+            for i in range(2, n, 3):
+                s = s + i
+            return s
+
+        g = _staged(f)
+        assert int(g(jnp.asarray(11))) == 2 + 5 + 8
+        assert int(g(jnp.asarray(3))) == 2
+
+    def test_python_loop_untouched(self):
+        def f(x, n):
+            for _ in range(n):  # python int: unrolls at trace
+                x = x * 2
+            return x
+
+        g = jax.jit(convert_function(f), static_argnums=1)
+        np.testing.assert_allclose(g(jnp.ones(()), 3), 8.0)
+
+    def test_break_diagnostic(self):
+        def f(x):
+            s = jnp.zeros(())
+            while s < 10.0:
+                s = s + jnp.sum(x)
+                if s > 5.0:
+                    break
+            return s
+
+        with pytest.raises(Dy2StaticError, match="return/break/continue"):
+            jax.jit(convert_function(f))(jnp.ones((3,)))
+
+    def test_break_python_cond_ok(self):
+        def f(x, n):
+            out = x
+            i = 0
+            while i < n:  # python condition: break is fine
+                out = out + 1
+                if i == 2:
+                    break
+                i += 1
+            return out
+
+        assert float(convert_function(f)(jnp.zeros(()), 10)) == 3.0
+
+
+class TestLogicalAndPrint:
+    def test_logical_ops_tensor(self):  # ref: test_logical.py
+        def f(x):
+            a = jnp.sum(x) > 0
+            b = jnp.max(x) < 5
+            if a and b:
+                y = x + 10
+            elif a or not b:
+                y = x - 10
+            else:
+                y = x
+            return y
+
+        g = _staged(f)
+        for arr in (jnp.ones((2,)), jnp.full((2,), 9.0),
+                    -jnp.ones((2,))):
+            np.testing.assert_allclose(g(arr), f(arr))
+
+    def test_logical_short_circuit_python(self):
+        calls = []
+
+        def rhs():
+            calls.append(1)
+            return True
+
+        def f(flag):
+            return flag and rhs()
+
+        g = convert_function(f)
+        assert g(False) is False
+        assert calls == []  # short-circuit preserved for Python values
+        assert g(True) is True
+
+    def test_print_under_trace(self, capsys):  # ref: test_print.py
+        def f(x):
+            print("value:", x)
+            return x * 2
+
+        out = jax.jit(convert_function(f))(jnp.ones((2,)))
+        jax.effects_barrier()
+        np.testing.assert_allclose(out, 2.0)
+        # eager path still prints via Python
+        convert_function(f)(3.0)
+        assert "value: 3.0" in capsys.readouterr().out
+
+
+def _late_helper_caller(x):
+    return _helper_defined_later(x)
+
+
+def _helper_defined_later(x):
+    return x * 3
+
+
+class TestReviewRegressions:
+    def test_late_bound_module_global(self):
+        """Converted functions must see module globals bound AFTER
+        conversion (live-globals fallthrough, not a snapshot)."""
+        g = convert_function(_late_helper_caller)
+        assert float(g(jnp.asarray(2.0))) == 6.0
+
+    def test_import_inside_python_branch(self):
+        def f(flag, x):
+            if flag:
+                import math
+                y = x + 1
+            else:
+                import math
+                y = x - 1
+            return y, math.pi
+
+        g = convert_function(f)
+        y, pi = g(True, 1.0)
+        assert y == 2.0 and abs(pi - 3.14159) < 1e-3
+
+    def test_zero_arg_super_declines_conversion(self):
+        class Base(nn.Layer):
+            def forward(self, x):
+                return x + 1
+
+        class Child(Base):
+            def forward(self, x):
+                h = super().forward(x)
+                return h * 2
+
+        with pytest.warns(UserWarning, match="zero-arg super"):
+            g = convert_function(Child.forward)
+        net = Child()
+        assert float(g(net, jnp.asarray(1.0))) == 4.0
+
+    def test_for_target_reassigned_stays_python(self):
+        def f(x, n):
+            acc = x
+            for i in range(n):
+                acc = acc + i
+                i = 0  # reassigning the loop var: Python semantics kept
+            return acc
+
+        g = convert_function(f)
+        assert float(g(jnp.asarray(0.0), 3)) == 3.0
+        with pytest.raises(Dy2StaticError, match="reassigns its loop"):
+            jax.jit(g)(jnp.asarray(0.0), jnp.asarray(3))
+
+    def test_user_type_error_not_rebranded(self):
+        def f(x):
+            if jnp.sum(x) > 0:
+                y = x + "oops"
+            else:
+                y = x
+            return y
+
+        with pytest.raises(TypeError):
+            jax.jit(convert_function(f))(jnp.ones((2,)))
+
+    def test_diagnostic_points_at_real_line(self):
+        def f(x):
+            if jnp.sum(x) > 0:
+                return x
+            return -x
+
+        lineno = f.__code__.co_firstlineno + 1  # the `if` line
+        with pytest.raises(Dy2StaticError, match=f":{lineno}:"):
+            jax.jit(convert_function(f))(jnp.ones((2,)))
+
+
+class TestToStaticIntegration:
+    def test_to_static_function_with_control_flow(self):
+        @paddle.jit.to_static
+        def relu_or_neg(x):
+            if jnp.mean(x) > 0:
+                return_val = jnp.maximum(x, 0.0)
+            else:
+                return_val = -x
+            return return_val
+
+        x = jnp.asarray([-1.0, 2.0])        # mean > 0 -> relu path
+        np.testing.assert_allclose(relu_or_neg(x), [0.0, 2.0])
+        np.testing.assert_allclose(relu_or_neg(-x), [-1.0, 2.0])  # neg path
+
+    def test_to_static_layer_forward_control_flow(self):
+        class Gate(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if jnp.sum(h) > 0:
+                    out = h * 2.0
+                else:
+                    out = h * 0.5
+                return out
+
+        paddle.seed(0)
+        net = Gate()
+        eager = net(jnp.ones((1, 4)))
+        paddle.seed(0)
+        staged = paddle.jit.to_static(Gate())
+        np.testing.assert_allclose(np.asarray(staged(jnp.ones((1, 4)))),
+                                   np.asarray(eager), rtol=1e-6)
+
+    def test_translator_disable_passthrough(self):
+        from paddle_tpu.jit import ProgramTranslator
+        ProgramTranslator.get_instance().enable(False)
+        try:
+            def f(x):
+                return x + 1
+
+            assert paddle.jit.to_static(f) is f
+        finally:
+            ProgramTranslator.get_instance().enable(True)
