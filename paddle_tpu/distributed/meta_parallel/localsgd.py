@@ -1,0 +1,178 @@
+"""LocalSGD / AdaptiveLocalSGD (reference:
+fleet/meta_optimizers/localsgd_optimizer.py LocalSGDOptimizer +
+AdaptiveLocalSGDOptimizer): each data-parallel replica takes k local
+optimizer steps WITHOUT gradient synchronization, then parameters are
+averaged across replicas — trading gradient-allreduce bandwidth for
+periodic parameter averaging.
+
+TPU-native shape: GSPMD-replicated parameters cannot diverge per replica,
+so LocalSGD stores them REPLICA-MAJOR — every trainable param carries a
+leading replica dim sharded over the "data" mesh axis (P("data", ...)).
+The jitted step computes per-replica grads inside shard_map with NO pmean,
+updates per-replica optimizer state elementwise, and on sync steps
+averages over the leading dim (XLA lowers the mean over the sharded dim to
+the all-reduce the reference's program rewrite inserts). The sync period k
+is a runtime operand, so AdaptiveLocalSGD's k schedule (shrunk as loss
+falls — sync more often near convergence, reference
+localsgd_optimizer.py:425) never recompiles.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...framework.random import get_rng_key
+from ...jit.functionalization import functional_call, state_of
+from ..mesh import require_mesh
+
+shard_map = jax.shard_map
+
+
+class LocalSGDTrainer:
+    """Data-parallel trainer with k-step local updates + parameter
+    averaging. ``k_steps`` fixed (LocalSGD) or adapted from the loss
+    (AdaptiveLocalSGD: k ~ ceil(sqrt(lr0*loss/(lr*loss0) * init_k)),
+    clamped — replicas sync more often as loss/lr fall)."""
+
+    def __init__(self, model, optimizer, loss_fn: Callable, mesh=None,
+                 k_steps: int = 1, adaptive: bool = False,
+                 init_k_steps: int = 1, max_k_steps: int = 16):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.mesh = mesh or require_mesh()
+        self.ndata = self.mesh.shape.get("data", 1)
+        self.k_steps = init_k_steps if adaptive else k_steps
+        self.adaptive = adaptive
+        self.init_k_steps = init_k_steps
+        self.max_k_steps = max_k_steps
+        self._loss0 = None
+        self._step_no = 0
+        self._init_state()
+        self._build()
+
+    def _init_state(self):
+        params, buffers = state_of(self.model)
+        boxes = OrderedDict(self.model.named_parameters())
+        self.trainable = OrderedDict((n, boxes[n].trainable)
+                                     for n in params)
+        tparams = OrderedDict((k, v) for k, v in params.items()
+                              if self.trainable[k])
+        opt_state = self.optimizer.init_state(tparams)
+
+        def rep(v):  # replica-major: (D, *shape) sharded over "data"
+            tiled = jnp.broadcast_to(v[None], (self.ndata,) + v.shape)
+            return jax.device_put(
+                tiled, NamedSharding(self.mesh,
+                                     P("data", *([None] * v.ndim))))
+
+        # replicate the SLOTS per replica (they diverge between syncs);
+        # the step counter stays a shared scalar — replicating it breaks
+        # Adam-family bias correction broadcasting ((D,) vs (D, *shape))
+        rep_opt = dict(opt_state)
+        rep_opt["slots"] = jax.tree_util.tree_map(
+            rep, opt_state.get("slots", {}))
+        self.state = {
+            "params": OrderedDict((k, rep(v)) for k, v in tparams.items()),
+            "frozen": OrderedDict((k, v) for k, v in params.items()
+                                  if not self.trainable[k]),
+            "buffers": buffers,
+            "opt": rep_opt,
+        }
+
+    def _build(self):
+        mesh = self.mesh
+        model = self.model
+        loss_fn = self.loss_fn
+        opt = self.optimizer
+
+        def grads_fn(params, frozen, buffers, key, inputs, labels):
+            # inside shard_map: leading replica dim is LOCAL (length 1)
+            p = {k: v[0] for k, v in params.items()}
+            merged = dict(frozen)
+            merged.update(p)
+
+            def lf(tp):
+                full = dict(merged)
+                full.update(tp)
+                out, _ = functional_call(model, full, buffers, inputs,
+                                         rng=key)
+                return loss_fn(out, labels)
+
+            loss, grads = jax.value_and_grad(lf)(p)
+            # NO grad pmean — that is the whole point of LocalSGD
+            rep_loss = jax.lax.pmean(loss, "data")  # reporting only
+            return rep_loss, {k: g[None] for k, g in grads.items()}
+
+        pspec = {k: P("data", *([None] * (v.ndim - 1)))
+                 for k, v in self.state["params"].items()}
+        sharded_grads = shard_map(
+            grads_fn, mesh=mesh,
+            in_specs=(pspec, P(), P(), P(), P(("data",)), P(("data",))),
+            out_specs=(P(), pspec),
+            check_vma=False)
+
+        def train_step(params, frozen, buffers, opt_state, key, lr,
+                       step_no, k_arr, inputs, labels):
+            loss, grads = sharded_grads(dict(params), dict(frozen),
+                                        dict(buffers), key, inputs, labels)
+            new_p, new_opt = opt.apply_gradients(dict(params), grads,
+                                                 opt_state, lr=lr)
+            # sync step: average params (and moments) over replicas —
+            # XLA inserts the cross-replica all-reduce here
+            do_sync = (step_no % k_arr) == 0
+
+            def avg(v):
+                m = jnp.broadcast_to(jnp.mean(v, axis=0, keepdims=True),
+                                     v.shape)
+                return jnp.where(do_sync, m, v)
+
+            new_p = {k: avg(v) for k, v in new_p.items()}
+            new_opt = dict(new_opt)
+            new_opt["slots"] = jax.tree_util.tree_map(
+                avg, new_opt.get("slots", {}))
+            return loss, new_p, new_opt
+
+        self._step = jax.jit(train_step, donate_argnums=(0, 3))
+
+    def train_step(self, inputs, labels, lr=None):
+        lr = self.optimizer.get_lr() if lr is None else lr
+        self._step_no += 1
+        data_sh = NamedSharding(self.mesh, P(("data",)))
+        inputs = jax.device_put(jnp.asarray(inputs), data_sh)
+        labels = jax.device_put(jnp.asarray(labels), data_sh)
+        loss, new_p, new_opt = self._step(
+            self.state["params"], self.state["frozen"],
+            self.state["buffers"], self.state["opt"], get_rng_key(),
+            lr, jnp.asarray(self._step_no), jnp.asarray(self.k_steps),
+            inputs, labels)
+        self.state["params"] = new_p
+        self.state["opt"] = new_opt
+        lv = float(loss)
+        if self.adaptive:
+            # reference localsgd_optimizer.py:425 communicate_avg_loss:
+            # next_k = ceil(sqrt(lr_0 * loss / (lr * loss_0) * init_k)),
+            # clamped to [1, max] — sync MORE often as loss (or lr) drops
+            if self._loss0 is None:
+                self._loss0 = max(lv, 1e-12)
+                self._lr0 = float(lr)
+            self.k_steps = int(np.clip(
+                np.ceil(np.sqrt(self._lr0 * max(lv, 1e-12) /
+                                (max(float(lr), 1e-12) * self._loss0) *
+                                self.init_k_steps)),
+                1, self.max_k_steps))
+        return loss
+
+    def replica_params(self, k):
+        """Per-replica views of a trainable param (for tests/inspection)."""
+        return np.asarray(self.state["params"][k])
+
+    def averaged_state_dict(self):
+        return {k: jnp.mean(v, axis=0)
+                for k, v in self.state["params"].items()}

@@ -108,11 +108,54 @@ def convert_ifelse(cond, true_fn, false_fn, init, names,
     except TypeError as e:
         if not _is_structure_error(e):
             raise  # a genuine user error inside a branch, not ours
+        # fallback for branches whose outputs are not all jax-typed
+        # (python ints, loop temporaries, UNDEFINED): evaluate BOTH
+        # branches and select per variable (select computes both sides —
+        # only paid when the strict lax.cond path cannot apply)
+        return _select_branches(cond, true_fn, false_fn, init, names,
+                                filename, lineno, e)
+
+
+def _is_arrayish(v):
+    return isinstance(v, (jax.Array, jax.core.Tracer, int, float, bool,
+                          jnp.ndarray)) or (
+        hasattr(v, "dtype") and hasattr(v, "shape"))
+
+
+def _select_branches(cond, true_fn, false_fn, init, names, filename,
+                     lineno, orig_err):
+    outs_t = true_fn(*init)
+    outs_f = false_fn(*init)
+    res = []
+    for n, a, b in zip(names, outs_t, outs_f):
+        if a is b:
+            res.append(a)
+            continue
+        if a is UNDEFINED or b is UNDEFINED:
+            # assigned on one path only: reading it on the other path is
+            # undefined behavior in Python — like the reference's
+            # RETURN_NO_VALUE handling, the defined side's value is kept
+            # so the trace proceeds (the variable simply should not be
+            # consumed when the other branch was taken)
+            res.append(b if a is UNDEFINED else a)
+            continue
+        if _is_arrayish(a) and _is_arrayish(b):
+            try:
+                res.append(jnp.where(cond, a, b))
+                continue
+            except Exception:
+                raise Dy2StaticError(
+                    f"{_loc(filename, lineno)}: variable {n!r} has "
+                    f"incompatible shape/dtype across tensor-dependent "
+                    f"`if` branches") from orig_err
+        if type(a) is type(b) and a == b:
+            res.append(a)
+            continue
         raise Dy2StaticError(
-            f"{_loc(filename, lineno)}: tensor-dependent `if` branches "
-            f"must produce matching variables {list(names)} (a variable "
-            f"assigned in only one branch, or with different shape/dtype "
-            f"per branch, cannot be staged into lax.cond): {e}") from e
+            f"{_loc(filename, lineno)}: variable {n!r} takes different "
+            f"non-tensor values per branch of a tensor-dependent `if` — "
+            f"this cannot be staged") from orig_err
+    return tuple(res)
 
 
 def convert_while(cond_fn, body_fn, init, names, filename="<dy2static>",
@@ -142,6 +185,13 @@ def convert_while(cond_fn, body_fn, init, names, filename="<dy2static>",
             f"{_loc(filename, lineno)}: tensor-dependent `while` body must "
             f"keep every loop variable {list(names)} at a fixed "
             f"shape/dtype across iterations: {e}") from e
+
+
+def init_loop_var(cur, fallback):
+    """Give a converted for-loop's target a typed initial carry value
+    (the range start) while preserving a pre-existing binding (Python
+    keeps the prior value when the range is empty)."""
+    return fallback if cur is UNDEFINED else cur
 
 
 def normalize_range(*args):
@@ -242,7 +292,12 @@ def _assigned_names(stmts):
                 add(a.asname or a.name)
 
         def visit_Name(self, node):
-            if isinstance(node.ctx, ast.Store):
+            # __dy2s_* are this pass's own temporaries (inner converted
+            # loops' induction/cond/body names): capturing them as branch
+            # variables of an ENCLOSING converted statement would demand
+            # they match across branches, which they never do
+            if isinstance(node.ctx, ast.Store) and \
+                    not node.id.startswith("__dy2s_"):
                 add(node.id)
 
     v = V()
@@ -260,10 +315,11 @@ def _loaded_names(node):
 
 
 def _has_exits(stmts):
-    """return/break/continue at this statement level (not nested defs)."""
+    """Exits that would escape THIS statement: returns anywhere (except
+    nested defs), break/continue not owned by a nested loop."""
     found = []
 
-    class V(ast.NodeVisitor):
+    class Returns(ast.NodeVisitor):
         def visit_FunctionDef(self, node):
             pass
 
@@ -272,6 +328,18 @@ def _has_exits(stmts):
 
         def visit_Return(self, node):
             found.append("return")
+
+    class V(Returns):
+        def visit_While(self, node):
+            # a nested loop owns break/continue in its BODY; its else
+            # clause's break/continue (and all returns) escape to us
+            r = Returns()
+            for s in node.body:
+                r.visit(s)
+            for s in node.orelse:
+                self.visit(s)
+
+        visit_For = visit_While
 
         def visit_Break(self, node):
             found.append("break")
@@ -452,8 +520,8 @@ class _Transformer(ast.NodeTransformer):
                     for a in node.iter.args]
             return node
         t = node.target.id
-        start_n, stop_n, step_n = (self._n("start"), self._n("stop"),
-                                   self._n("step"))
+        start_n, stop_n, step_n, it_n = (self._n("start"), self._n("stop"),
+                                         self._n("step"), self._n("it"))
         setup = [
             ast.Assign(
                 targets=[ast.Tuple(elts=[_name(start_n, ast.Store()),
@@ -461,16 +529,35 @@ class _Transformer(ast.NodeTransformer):
                                          _name(step_n, ast.Store())],
                                    ctx=ast.Store())],
                 value=_call("normalize_range", list(node.iter.args))),
-            ast.Assign(targets=[_name(t, ast.Store())],
+            ast.Assign(targets=[_name(it_n, ast.Store())],
                        value=_name(start_n)),
+            # typed pre-loop init for the target (keeps a prior binding)
+            ast.Assign(
+                targets=[_name(t, ast.Store())],
+                value=_call("init_loop_var", [
+                    ast.Call(
+                        func=ast.Attribute(
+                            value=ast.Call(func=_name("locals"), args=[],
+                                           keywords=[]),
+                            attr="get", ctx=ast.Load()),
+                        args=[_const(t), _jst_attr("UNDEFINED")],
+                        keywords=[]),
+                    _name(it_n)])),
         ]
         setup = [ast.copy_location(ast.fix_missing_locations(s), node)
                  for s in setup]
-        test = _call("range_cond", [_name(t), _name(stop_n), _name(step_n)])
-        inc = ast.AugAssign(target=_name(t, ast.Store()), op=ast.Add(),
+        # hidden induction variable: the USER-visible target is assigned at
+        # body start and keeps its last-iteration value after the loop
+        # (Python range semantics), instead of leaking the post-increment
+        test = _call("range_cond", [_name(it_n), _name(stop_n),
+                                    _name(step_n)])
+        set_t = ast.Assign(targets=[_name(t, ast.Store())],
+                           value=_name(it_n))
+        inc = ast.AugAssign(target=_name(it_n, ast.Store()), op=ast.Add(),
                             value=_name(step_n))
         return setup + self._while_form(
-            node, test, list(node.body) + [inc], extra_loop_names=(t,))
+            node, test, [set_t] + list(node.body) + [inc],
+            extra_loop_names=(it_n, t))
 
 
 class _GlobalsProxy(dict):
@@ -513,10 +600,25 @@ def convert_function(fn):
         warnings.warn(f"dy2static: {fn!r} is not a plain function; running "
                       "without AST conversion")
         return fn
+    if any(isinstance(n, (ast.Global, ast.Nonlocal))
+           for n in ast.walk(fdef)):
+        # global/nonlocal stores would land in the exec proxy (or a
+        # generated branch fn's locals), silently diverging from the
+        # original's side effects — decline rather than corrupt
+        warnings.warn(
+            f"dy2static: {fn.__qualname__} uses global/nonlocal "
+            "declarations; running without AST conversion")
+        return fn
     fdef.decorator_list = []  # don't re-apply @to_static etc.
-    _Transformer(filename).visit(fdef)
-    ast.fix_missing_locations(tree)
-    code = compile(tree, filename=filename, mode="exec")
+    try:
+        _Transformer(filename).visit(fdef)
+        ast.fix_missing_locations(tree)
+        code = compile(tree, filename=filename, mode="exec")
+    except Exception as e:  # a transformer defect must degrade, not crash
+        warnings.warn(f"dy2static: AST conversion of {fn.__qualname__} "
+                      f"failed ({type(e).__name__}: {e}); running without "
+                      "conversion")
+        return fn
     import paddle_tpu.jit.dy2static as _self
     extra = {_JST: _self}
     if fn.__closure__:
@@ -528,6 +630,12 @@ def convert_function(fn):
                 extra[name] = cell.cell_contents
             except ValueError:
                 pass
+    # the import machinery reads module-context dunders with dict.get
+    # (which bypasses __missing__): seed them into the proxy's own storage
+    for dunder in ("__name__", "__package__", "__loader__", "__spec__",
+                   "__builtins__"):
+        if dunder in fn.__globals__:
+            extra.setdefault(dunder, fn.__globals__[dunder])
     namespace = _GlobalsProxy(fn.__globals__, extra)
     exec(code, namespace)
     new_fn = namespace[fdef.name]

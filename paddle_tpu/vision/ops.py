@@ -372,3 +372,644 @@ def decode_jpeg(x, mode="unchanged", name=None):
     else:
         arr = arr.transpose(2, 0, 1)
     return jnp.asarray(arr)
+
+
+# ---------------------------------------------------------------------------
+# Detection op suite (reference: paddle/fluid/operators/detection/*) —
+# priors/anchors, box transforms, IoU/matching, NMS family, RoI pooling.
+# Dense/grid ops are pure jax (jit-compatible, differentiable where the
+# reference op is); data-dependent-output ops (NMS selection, bipartite
+# match) run on host like the reference's CPU-only kernels and are
+# eager-only.
+# ---------------------------------------------------------------------------
+def _expand_aspect_ratios(aspect_ratios, flip):
+    out = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(float(ar))
+        if flip:
+            out.append(1.0 / float(ar))
+    return out
+
+
+def prior_box(input, image, min_sizes, aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              max_sizes=None, flip=False, clip=False, steps=(0.0, 0.0),
+              offset=0.5, min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes per feature-map cell (reference
+    detection/prior_box_op.h:53 kernel). Returns (boxes, variances), each
+    (feat_h, feat_w, num_priors, 4), boxes normalized to [0,1] image
+    coords."""
+    feat_h, feat_w = input.shape[2], input.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    step_w = steps[0] or img_w / feat_w
+    step_h = steps[1] or img_h / feat_h
+    ars = _expand_aspect_ratios(aspect_ratios, flip)
+    max_sizes = list(max_sizes or [])
+
+    cx = (np.arange(feat_w) + offset) * step_w
+    cy = (np.arange(feat_h) + offset) * step_h
+    cx, cy = np.meshgrid(cx, cy)              # (H, W)
+
+    halves = []  # (half_w, half_h) per prior, reference emission order
+    for s, mn in enumerate(np.asarray(min_sizes, dtype="f8")):
+        if min_max_aspect_ratios_order:
+            halves.append((mn / 2.0, mn / 2.0))
+            if max_sizes:
+                m = np.sqrt(mn * max_sizes[s]) / 2.0
+                halves.append((m, m))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                halves.append((mn * np.sqrt(ar) / 2.0,
+                               mn / np.sqrt(ar) / 2.0))
+        else:
+            for ar in ars:
+                halves.append((mn * np.sqrt(ar) / 2.0,
+                               mn / np.sqrt(ar) / 2.0))
+            if max_sizes:
+                m = np.sqrt(mn * max_sizes[s]) / 2.0
+                halves.append((m, m))
+    hw = np.asarray([h[0] for h in halves])   # (P,)
+    hh = np.asarray([h[1] for h in halves])
+    boxes = np.stack([
+        (cx[..., None] - hw) / img_w, (cy[..., None] - hh) / img_h,
+        (cx[..., None] + hw) / img_w, (cy[..., None] + hh) / img_h,
+    ], axis=-1)                               # (H, W, P, 4)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, dtype="f4"), boxes.shape)
+    return jnp.asarray(boxes, jnp.float32), jnp.asarray(var)
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, flatten_to_2d=False,
+                      name=None):
+    """Density prior boxes (reference detection/density_prior_box_op.h):
+    each (density, fixed_size) pair tiles density^2 shifted centers."""
+    feat_h, feat_w = input.shape[2], input.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    step_w = steps[0] or img_w / feat_w
+    step_h = steps[1] or img_h / feat_h
+    cx = (np.arange(feat_w) + offset) * step_w
+    cy = (np.arange(feat_h) + offset) * step_h
+    cx, cy = np.meshgrid(cx, cy)
+
+    all_boxes = []
+    for density, fs in zip(densities, fixed_sizes):
+        for ratio in fixed_ratios:
+            bw = fs * np.sqrt(ratio)
+            bh = fs / np.sqrt(ratio)
+            shift_w = step_w / density
+            shift_h = step_h / density
+            for di in range(density):
+                for dj in range(density):
+                    c_x = cx - step_w / 2.0 + shift_w / 2.0 + dj * shift_w
+                    c_y = cy - step_h / 2.0 + shift_h / 2.0 + di * shift_h
+                    all_boxes.append(np.stack([
+                        (c_x - bw / 2.0) / img_w, (c_y - bh / 2.0) / img_h,
+                        (c_x + bw / 2.0) / img_w, (c_y + bh / 2.0) / img_h,
+                    ], axis=-1))
+    boxes = np.stack(all_boxes, axis=2)       # (H, W, P, 4)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, dtype="f4"), boxes.shape)
+    if flatten_to_2d:
+        boxes = boxes.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return jnp.asarray(boxes, jnp.float32), jnp.asarray(var)
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios,
+                     variances=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5, name=None):
+    """RPN anchors in absolute pixel coords (reference
+    detection/anchor_generator_op.h)."""
+    feat_h, feat_w = input.shape[2], input.shape[3]
+    sw, sh = stride
+    cx = (np.arange(feat_w) * sw) + offset * sw
+    cy = (np.arange(feat_h) * sh) + offset * sh
+    cx, cy = np.meshgrid(cx, cy)
+    hws, hhs = [], []
+    for ar in aspect_ratios:
+        for size in anchor_sizes:
+            area = sw * sh
+            area_ratios = area / ar
+            base_w = np.round(np.sqrt(area_ratios))
+            base_h = np.round(base_w * ar)
+            scale_w = size / sw
+            scale_h = size / sh
+            hws.append(0.5 * (scale_w * base_w - 1))
+            hhs.append(0.5 * (scale_h * base_h - 1))
+    hw = np.asarray(hws)
+    hh = np.asarray(hhs)
+    anchors = np.stack([cx[..., None] - hw, cy[..., None] - hh,
+                        cx[..., None] + hw, cy[..., None] + hh], axis=-1)
+    var = np.broadcast_to(np.asarray(variances, dtype="f4"), anchors.shape)
+    return jnp.asarray(anchors, jnp.float32), jnp.asarray(var)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """Encode/decode boxes against priors (reference
+    detection/box_coder_op.h:41 EncodeCenterSize, :118 DecodeCenterSize).
+    prior_box (M, 4); prior_box_var: None | (M, 4) array | list of 4.
+    encode: target (N, 4) -> (N, M, 4); decode: target (N, M, 4) -> same.
+    Pure jax, differentiable."""
+    pb = jnp.asarray(prior_box, jnp.float32)
+    norm = 1.0 if box_normalized else 0.0
+    pw = pb[:, 2] - pb[:, 0] + (1.0 - norm)
+    ph = pb[:, 3] - pb[:, 1] + (1.0 - norm)
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+    var = None
+    if prior_box_var is not None:
+        var = jnp.asarray(prior_box_var, jnp.float32)
+
+    t = jnp.asarray(target_box, jnp.float32)
+    if code_type == "encode_center_size":
+        tw = t[:, 2] - t[:, 0] + (1.0 - norm)
+        th = t[:, 3] - t[:, 1] + (1.0 - norm)
+        tcx = (t[:, 0] + t[:, 2]) / 2
+        tcy = (t[:, 1] + t[:, 3]) / 2
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)      # (N, M, 4)
+        if var is not None:
+            out = out / (var[None, :, :] if var.ndim == 2
+                         else var.reshape(1, 1, 4))
+        return out
+    if code_type != "decode_center_size":
+        raise ValueError(f"unknown code_type {code_type!r}")
+    # decode: t is (N, M, 4); priors broadcast along `axis`
+    if var is not None and var.ndim == 2:
+        var = var[None, :, :] if axis == 0 else var[:, None, :]
+    elif var is not None:
+        var = var.reshape(1, 1, 4)
+    if axis == 0:
+        bpw, bph, bpcx, bpcy = (pw[None, :], ph[None, :],
+                                pcx[None, :], pcy[None, :])
+    else:
+        bpw, bph, bpcx, bpcy = (pw[:, None], ph[:, None],
+                                pcx[:, None], pcy[:, None])
+    tv = t * var if var is not None else t
+    w = jnp.exp(tv[..., 2]) * bpw
+    h = jnp.exp(tv[..., 3]) * bph
+    cx = tv[..., 0] * bpw + bpcx
+    cy = tv[..., 1] * bph + bpcy
+    return jnp.stack([cx - w / 2, cy - h / 2,
+                      cx + w / 2 - (1.0 - norm),
+                      cy + h / 2 - (1.0 - norm)], axis=-1)
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image bounds (reference detection/box_clip_op.h;
+    im_info = [h, w, scale]). Batched: im_info (N, 3) clips
+    input (N, ..., 4) per image; a single [h, w, scale] clips all."""
+    b = jnp.asarray(input, jnp.float32)
+    info = jnp.asarray(im_info, jnp.float32)
+    if info.ndim > 1:  # per-image bounds broadcast over the box dims
+        extra = b.ndim - 2
+        info = info.reshape((info.shape[0],) + (1,) * extra + (3,))
+    h = info[..., 0] / info[..., 2] - 1.0
+    w = info[..., 1] / info[..., 2] - 1.0
+    return jnp.stack([
+        jnp.clip(b[..., 0], 0.0, w), jnp.clip(b[..., 1], 0.0, h),
+        jnp.clip(b[..., 2], 0.0, w), jnp.clip(b[..., 3], 0.0, h)],
+        axis=-1)
+
+
+def _pairwise_iou(x, y, normalized=True):
+    eps = 0.0 if normalized else 1.0
+    area_x = (x[:, 2] - x[:, 0] + eps) * (x[:, 3] - x[:, 1] + eps)
+    area_y = (y[:, 2] - y[:, 0] + eps) * (y[:, 3] - y[:, 1] + eps)
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt + eps, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_x[:, None] + area_y[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _pairwise_iou_np(x, normalized=True):
+    """Self-IoU on host (the NMS loops are host-side: no device bounce)."""
+    eps = 0.0 if normalized else 1.0
+    area = (x[:, 2] - x[:, 0] + eps) * (x[:, 3] - x[:, 1] + eps)
+    lt = np.maximum(x[:, None, :2], x[None, :, :2])
+    rb = np.minimum(x[:, None, 2:], x[None, :, 2:])
+    wh = np.maximum(rb - lt + eps, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area[:, None] + area[None, :] - inter
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return np.where(union > 0, inter / union, 0.0)
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """Pairwise IoU (N,4) x (M,4) -> (N,M) (reference
+    detection/iou_similarity_op.h). Pure jax."""
+    return _pairwise_iou(jnp.asarray(x, jnp.float32),
+                         jnp.asarray(y, jnp.float32), box_normalized)
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=None,
+                    name=None):
+    """Greedy bipartite matching (reference detection/bipartite_match_op.cc):
+    repeatedly take the globally largest entry, retiring its row and
+    column. Returns (match_indices (M,), match_dist (M,)) over columns.
+    Host-side (data-dependent control flow), eager-only like the
+    reference's CPU kernel."""
+    d = np.array(dist_matrix, dtype=np.float64, copy=True)
+    n, m = d.shape
+    match_idx = np.full((m,), -1, dtype=np.int64)
+    match_dist = np.zeros((m,), dtype=np.float32)
+    live = d.copy()
+    for _ in range(min(n, m)):
+        i, j = np.unravel_index(np.argmax(live), live.shape)
+        if live[i, j] <= 0:
+            break
+        match_idx[j] = i
+        match_dist[j] = d[i, j]
+        live[i, :] = -1.0
+        live[:, j] = -1.0
+    if match_type == "per_prediction":
+        thr = dist_threshold if dist_threshold is not None else 0.5
+        for j in range(m):
+            if match_idx[j] == -1:
+                i = int(np.argmax(d[:, j]))
+                if d[i, j] >= thr:
+                    match_idx[j] = i
+                    match_dist[j] = d[i, j]
+    return jnp.asarray(match_idx), jnp.asarray(match_dist)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, normalized=True, nms_eta=1.0,
+        name=None):
+    """Hard NMS returning kept indices sorted by score (reference
+    paddle.vision.ops.nms / detection NMS kernels). Host-side
+    (data-dependent output length), eager-only. ``normalized=False``
+    uses pixel-coordinate IoU (+1 extents); ``nms_eta < 1`` shrinks the
+    threshold adaptively after each kept box (reference NMSFast)."""
+    b = np.asarray(boxes, dtype=np.float64)
+    n = b.shape[0]
+    s = (np.asarray(scores, dtype=np.float64) if scores is not None
+         else np.arange(n, 0, -1, dtype=np.float64))
+    if category_idxs is not None:
+        # per-category NMS: offset boxes so categories never overlap
+        cat = np.asarray(category_idxs)
+        off = (b.max() + 1.0) * cat.astype(np.float64)
+        b = b + off[:, None]
+    order = np.argsort(-s)
+    keep = []
+    iou = _pairwise_iou_np(b, normalized=normalized)
+    suppressed = np.zeros(n, dtype=bool)
+    thr = float(iou_threshold)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        suppressed |= iou[i] > thr
+        suppressed[i] = True
+        if nms_eta < 1.0 and thr > 0.5:
+            thr *= nms_eta
+    keep = np.asarray(keep, dtype=np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return jnp.asarray(keep)
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, name=None):
+    """Per-class NMS + cross-class top-k (reference
+    detection/multiclass_nms_op.cc). bboxes (N, M, 4), scores (N, C, M).
+    Returns list per image of (label, score, x1, y1, x2, y2) arrays —
+    host-side, eager-only (LoD output in the reference)."""
+    bboxes = np.asarray(bboxes)
+    scores = np.asarray(scores)
+    outs = []
+    for n in range(bboxes.shape[0]):
+        dets = []
+        for c in range(scores.shape[1]):
+            if c == background_label:
+                continue
+            s = scores[n, c]
+            mask = s > score_threshold
+            if not mask.any():
+                continue
+            idx = np.nonzero(mask)[0]
+            idx = idx[np.argsort(-s[idx])][:nms_top_k]
+            keep = np.asarray(nms(bboxes[n, idx], nms_threshold,
+                                  scores=s[idx], normalized=normalized,
+                                  nms_eta=nms_eta))
+            for i in np.asarray(idx)[keep]:
+                dets.append([c, s[i], *bboxes[n, i]])
+        if dets:
+            dets = np.asarray(dets, dtype=np.float32)
+            dets = dets[np.argsort(-dets[:, 1])][:keep_top_k]
+        else:
+            dets = np.zeros((0, 6), dtype=np.float32)
+        outs.append(jnp.asarray(dets))
+    return outs
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               name=None):
+    """Matrix NMS (reference detection/matrix_nms_op.cc): parallel soft
+    suppression by decayed scores — no sequential suppression loop. Pure
+    numpy per image (selection still data-dependent), decay math matches
+    the reference kernel."""
+    bboxes = np.asarray(bboxes)
+    scores = np.asarray(scores)
+    outs = []
+    for n in range(bboxes.shape[0]):
+        dets = []
+        for c in range(scores.shape[1]):
+            if c == background_label:
+                continue
+            s = scores[n, c]
+            mask = s > score_threshold
+            if not mask.any():
+                continue
+            idx = np.nonzero(mask)[0]
+            idx = idx[np.argsort(-s[idx])][:nms_top_k]
+            sel = bboxes[n, idx]
+            ss = s[idx]
+            iou = _pairwise_iou_np(sel.astype(np.float64), normalized)
+            iou = np.triu(iou, k=1)             # iou[i, j] for i < j only
+            # comp[i] = max IoU of box i with any higher-scored box —
+            # the reference's compensation term (matrix_nms_op.cc): decay
+            # for box j = min over i<j of f(iou_ij) / f(comp_i)
+            comp = iou.max(axis=0)
+            k = iou.shape[0]
+            excl = np.tril(np.ones((k, k), dtype=bool))  # i >= j: no-op
+            if use_gaussian:
+                # reference matrix_nms_op.cc:87 decay_score<T, true>:
+                # exp((max_iou^2 - iou^2) * sigma)
+                ratio = np.exp((comp[:, None] ** 2 - iou ** 2)
+                               * gaussian_sigma)
+            else:
+                ratio = (1.0 - iou) / np.maximum(1.0 - comp[:, None],
+                                                 1e-10)
+            ratio = np.where(excl, 1.0, ratio)
+            decay = ratio.min(axis=0)
+            decayed = ss * decay
+            keep = decayed > post_threshold
+            for i, sc in zip(np.asarray(idx)[keep], decayed[keep]):
+                dets.append([c, sc, *bboxes[n, i]])
+        if dets:
+            dets = np.asarray(dets, dtype=np.float32)
+            dets = dets[np.argsort(-dets[:, 1])][:keep_top_k]
+        else:
+            dets = np.zeros((0, 6), dtype=np.float32)
+        outs.append(jnp.asarray(dets))
+    return outs
+
+
+def _bilinear_gather(feat, y, x):
+    """feat (C, H, W); y/x arbitrary same-shaped sample coords."""
+    H, W = feat.shape[1], feat.shape[2]
+    y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    ly = jnp.clip(y - y0, 0.0, 1.0)
+    lx = jnp.clip(x - x0, 0.0, 1.0)
+    y0i, y1i = y0.astype(jnp.int32), y1.astype(jnp.int32)
+    x0i, x1i = x0.astype(jnp.int32), x1.astype(jnp.int32)
+    v00 = feat[:, y0i, x0i]
+    v01 = feat[:, y0i, x1i]
+    v10 = feat[:, y1i, x0i]
+    v11 = feat[:, y1i, x1i]
+    return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+            v10 * ly * (1 - lx) + v11 * ly * lx)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoI Align (reference operators/roi_align_op.*): bilinear sampling on
+    a regular in-bin grid, averaged. Pure jax, differentiable, static
+    shapes (sampling_ratio <= 0 uses 2 samples/bin — a static stand-in for
+    the reference's per-roi adaptive count)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    x = jnp.asarray(x)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    boxes_num = np.asarray(boxes_num)
+    img_idx = jnp.asarray(np.repeat(np.arange(len(boxes_num)), boxes_num),
+                          jnp.int32)
+    off = 0.5 if aligned else 0.0
+    x1 = boxes[:, 0] * spatial_scale - off
+    y1 = boxes[:, 1] * spatial_scale - off
+    x2 = boxes[:, 2] * spatial_scale - off
+    y2 = boxes[:, 3] * spatial_scale - off
+    rw = x2 - x1
+    rh = y2 - y1
+    if not aligned:
+        rw = jnp.maximum(rw, 1.0)
+        rh = jnp.maximum(rh, 1.0)
+    bin_h = rh / ph
+    bin_w = rw / pw
+    # sample grid: (R, ph, sr) y-coords and (R, pw, sr) x-coords
+    iy = (jnp.arange(sr) + 0.5) / sr
+    ys = (y1[:, None, None] + (jnp.arange(ph)[None, :, None] +
+                               iy[None, None, :]) * bin_h[:, None, None])
+    xs = (x1[:, None, None] + (jnp.arange(pw)[None, :, None] +
+                               iy[None, None, :]) * bin_w[:, None, None])
+
+    def one_roi(feat, ys_r, xs_r):
+        yy = jnp.broadcast_to(ys_r[:, None, :, None], (ph, pw, sr, sr))
+        xx = jnp.broadcast_to(xs_r[None, :, None, :], (ph, pw, sr, sr))
+        vals = _bilinear_gather(feat, yy, xx)       # (C, ph, pw, sr, sr)
+        return vals.mean(axis=(-1, -2))             # (C, ph, pw)
+
+    feats = x[img_idx]                              # (R, C, H, W)
+    return jax.vmap(one_roi)(feats, ys, xs)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoI max pooling (reference operators/roi_pool_op.*): exact integer
+    bins via separable masked max (max over w then h). Pure jax,
+    differentiable through the max."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x = jnp.asarray(x)
+    H, W = x.shape[2], x.shape[3]
+    boxes = jnp.asarray(boxes, jnp.float32)
+    boxes_num = np.asarray(boxes_num)
+    img_idx = jnp.asarray(np.repeat(np.arange(len(boxes_num)), boxes_num),
+                          jnp.int32)
+    x1 = jnp.round(boxes[:, 0] * spatial_scale)
+    y1 = jnp.round(boxes[:, 1] * spatial_scale)
+    x2 = jnp.round(boxes[:, 2] * spatial_scale)
+    y2 = jnp.round(boxes[:, 3] * spatial_scale)
+    rh = jnp.maximum(y2 - y1 + 1, 1.0)
+    rw = jnp.maximum(x2 - x1 + 1, 1.0)
+    bin_h = rh / ph
+    bin_w = rw / pw
+
+    hs = jnp.arange(H, dtype=jnp.float32)
+    ws = jnp.arange(W, dtype=jnp.float32)
+    # bin [i] covers [floor(y1 + i*bin_h), ceil(y1 + (i+1)*bin_h))
+    i = jnp.arange(ph, dtype=jnp.float32)
+    j = jnp.arange(pw, dtype=jnp.float32)
+    hstart = jnp.clip(jnp.floor(y1[:, None] + i[None, :] * bin_h[:, None]),
+                      0, H)
+    hend = jnp.clip(jnp.ceil(y1[:, None] + (i[None, :] + 1) *
+                             bin_h[:, None]), 0, H)
+    wstart = jnp.clip(jnp.floor(x1[:, None] + j[None, :] * bin_w[:, None]),
+                      0, W)
+    wend = jnp.clip(jnp.ceil(x1[:, None] + (j[None, :] + 1) *
+                             bin_w[:, None]), 0, W)
+    rowm = ((hs[None, None, :] >= hstart[..., None]) &
+            (hs[None, None, :] < hend[..., None]))    # (R, ph, H)
+    colm = ((ws[None, None, :] >= wstart[..., None]) &
+            (ws[None, None, :] < wend[..., None]))    # (R, pw, W)
+    feats = x[img_idx]                                # (R, C, H, W)
+    neg = jnp.finfo(x.dtype).min
+
+    # max over w (masked by colm), then over h (masked by rowm) — max is
+    # separable, so no (R, ph, pw, H, W) tensor is ever materialized
+    def one_roi(feat, rm, cm):
+        t = jnp.where(cm[None, None, :, :], feat[:, :, None, :], neg)
+        t = t.max(axis=-1)                            # (C, H, pw)
+        t2 = jnp.where(rm[None, :, :, None], t[:, None, :, :], neg)
+        out = t2.max(axis=2)                          # (C, ph, pw)
+        empty = (~rm.any(-1))[None, :, None] | (~cm.any(-1))[None, None, :]
+        return jnp.where(empty, 0.0, out)
+
+    return jax.vmap(one_roi)(feats, rowm, colm)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI average pooling (reference
+    operators/psroi_pool_op.*): input channels C = out_c * ph * pw; bin
+    (i, j) averages channel group (i*pw + j). Pure jax."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x = jnp.asarray(x)
+    C, H, W = x.shape[1], x.shape[2], x.shape[3]
+    assert C % (ph * pw) == 0, "channels must be out_c * ph * pw"
+    out_c = C // (ph * pw)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    boxes_num = np.asarray(boxes_num)
+    img_idx = jnp.asarray(np.repeat(np.arange(len(boxes_num)), boxes_num),
+                          jnp.int32)
+    x1 = jnp.round(boxes[:, 0]) * spatial_scale
+    y1 = jnp.round(boxes[:, 1]) * spatial_scale
+    x2 = jnp.round(boxes[:, 2] + 1.0) * spatial_scale
+    y2 = jnp.round(boxes[:, 3] + 1.0) * spatial_scale
+    rh = jnp.maximum(y2 - y1, 0.1)
+    rw = jnp.maximum(x2 - x1, 0.1)
+    bin_h = rh / ph
+    bin_w = rw / pw
+    hs = jnp.arange(H, dtype=jnp.float32)
+    ws = jnp.arange(W, dtype=jnp.float32)
+    i = jnp.arange(ph, dtype=jnp.float32)
+    j = jnp.arange(pw, dtype=jnp.float32)
+    hstart = jnp.clip(jnp.floor(y1[:, None] + i[None, :] * bin_h[:, None]),
+                      0, H)
+    hend = jnp.clip(jnp.ceil(y1[:, None] + (i[None, :] + 1) *
+                             bin_h[:, None]), 0, H)
+    wstart = jnp.clip(jnp.floor(x1[:, None] + j[None, :] * bin_w[:, None]),
+                      0, W)
+    wend = jnp.clip(jnp.ceil(x1[:, None] + (j[None, :] + 1) *
+                             bin_w[:, None]), 0, W)
+    rowm = ((hs[None, None, :] >= hstart[..., None]) &
+            (hs[None, None, :] < hend[..., None])).astype(x.dtype)
+    colm = ((ws[None, None, :] >= wstart[..., None]) &
+            (ws[None, None, :] < wend[..., None])).astype(x.dtype)
+    feats = x[img_idx].reshape(-1, out_c, ph, pw, H, W)  # (R, oc, ph, pw, H, W)
+
+    def one_roi(feat, rm, cm):
+        # feat (oc, ph, pw, H, W); average over each bin's h/w window
+        t = jnp.einsum("opqhw,qw->opqh", feat, cm)     # sum over w per bin-col
+        t = jnp.einsum("opqh,ph->opq", t, rm)          # sum over h per bin-row
+        cnt = jnp.einsum("ph,qw->pq", rm, cm)
+        return jnp.where(cnt[None] > 0, t / jnp.maximum(cnt[None], 1.0), 0.0)
+
+    return jax.vmap(one_roi)(feats, rowm, colm)
+
+
+def polygon_box_transform(input, name=None):
+    """EAST geometry-map offsets -> absolute quad coords (reference
+    detection/polygon_box_transform_op.cc: even channels use 4*w - v, odd
+    use 4*h - v)."""
+    x = jnp.asarray(input)
+    n, c, h, w = x.shape
+    jj = jnp.arange(w, dtype=x.dtype)[None, None, None, :] * 4.0
+    ii = jnp.arange(h, dtype=x.dtype)[None, None, :, None] * 4.0
+    even = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+    return jnp.where(even, jj - x, ii - x)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=False, name=None):
+    """RPN proposal generation (reference
+    detection/generate_proposals_v2_op.cc): decode anchors with deltas,
+    clip, filter small, NMS. Host-side selection, eager-only."""
+    scores = np.asarray(scores)        # (N, A, H, W)
+    deltas = np.asarray(bbox_deltas)   # (N, A*4, H, W)
+    img_size = np.asarray(img_size)    # (N, 2) [h, w]
+    anc = np.asarray(anchors).reshape(-1, 4)
+    var = np.asarray(variances).reshape(-1, 4)
+    # reference bbox_util.h:197 FilterBoxes clamps the size floor to 1px
+    min_size = max(min_size, 1.0)
+    N = scores.shape[0]
+    rois, roi_scores, rois_num = [], [], []
+    for n in range(N):
+        s = scores[n].transpose(1, 2, 0).reshape(-1)          # (H*W*A,)
+        d = deltas[n].reshape(scores.shape[1], 4,
+                              scores.shape[2], scores.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s = s[order]
+        d = d[order]
+        a = anc[order]
+        v = var[order]
+        # decode (variance-scaled center-size)
+        aw = a[:, 2] - a[:, 0] + 1.0
+        ah = a[:, 3] - a[:, 1] + 1.0
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        wd = np.exp(np.minimum(v[:, 2] * d[:, 2], 10.0)) * aw
+        hd = np.exp(np.minimum(v[:, 3] * d[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - wd / 2, cy - hd / 2,
+                          cx + wd / 2 - 1, cy + hd / 2 - 1], axis=1)
+        ih, iw = img_size[n, 0], img_size[n, 1]
+        boxes = np.stack([
+            np.clip(boxes[:, 0], 0, iw - 1), np.clip(boxes[:, 1], 0, ih - 1),
+            np.clip(boxes[:, 2], 0, iw - 1), np.clip(boxes[:, 3], 0, ih - 1),
+        ], axis=1)
+        keep_sz = ((boxes[:, 2] - boxes[:, 0] + 1 >= min_size) &
+                   (boxes[:, 3] - boxes[:, 1] + 1 >= min_size))
+        boxes, s = boxes[keep_sz], s[keep_sz]
+        if boxes.shape[0]:
+            keep = np.asarray(nms(boxes, nms_thresh,
+                                  scores=s))[:post_nms_top_n]
+            boxes, s = boxes[keep], s[keep]
+        rois.append(jnp.asarray(boxes, jnp.float32))
+        roi_scores.append(jnp.asarray(s, jnp.float32))
+        rois_num.append(boxes.shape[0])
+    out_rois = (jnp.concatenate(rois, 0) if rois else
+                jnp.zeros((0, 4), jnp.float32))
+    scores_out = (jnp.concatenate(roi_scores, 0) if roi_scores else
+                  jnp.zeros((0,), jnp.float32))
+    if return_rois_num:
+        return out_rois, scores_out, jnp.asarray(rois_num, jnp.int32)
+    return out_rois, scores_out

@@ -1,0 +1,253 @@
+"""Detection op suite (reference paddle/fluid/operators/detection/*):
+priors/anchors, box transforms, IoU/matching, NMS family, RoI pooling."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.vision import ops as V
+
+
+class TestPriorsAndAnchors:
+    def test_prior_box_shapes_and_centers(self):
+        feat = jnp.zeros((1, 8, 4, 4))
+        img = jnp.zeros((1, 3, 32, 32))
+        boxes, var = V.prior_box(feat, img, min_sizes=[8.0],
+                                 aspect_ratios=[1.0, 2.0], flip=True,
+                                 max_sizes=[16.0])
+        # priors: ar {1, 2, 0.5} * 1 min + 1 max-sq = 4
+        assert boxes.shape == (4, 4, 4, 4) and var.shape == boxes.shape
+        # cell (0,0): center (0.5*8, 0.5*8) = (4, 4); min box half=4
+        np.testing.assert_allclose(np.asarray(boxes[0, 0, 0]),
+                                   [0.0, 0.0, 0.25, 0.25], atol=1e-6)
+        # max-size square prior: sqrt(8*16)/2
+        m = np.sqrt(8 * 16) / 2
+        got = np.asarray(boxes[0, 0])
+        expect = [(4 - m) / 32, (4 - m) / 32, (4 + m) / 32, (4 + m) / 32]
+        assert any(np.allclose(got[p], expect, atol=1e-6) for p in range(4))
+
+    def test_prior_box_clip(self):
+        feat = jnp.zeros((1, 8, 2, 2))
+        img = jnp.zeros((1, 3, 8, 8))
+        boxes, _ = V.prior_box(feat, img, min_sizes=[16.0], clip=True)
+        b = np.asarray(boxes)
+        assert b.min() >= 0.0 and b.max() <= 1.0
+
+    def test_density_prior_box_counts(self):
+        feat = jnp.zeros((1, 8, 2, 2))
+        img = jnp.zeros((1, 3, 16, 16))
+        boxes, var = V.density_prior_box(
+            feat, img, densities=[2], fixed_sizes=[4.0],
+            fixed_ratios=[1.0])
+        assert boxes.shape == (2, 2, 4, 4)  # density^2 = 4 priors
+        flat, _ = V.density_prior_box(
+            feat, img, densities=[2], fixed_sizes=[4.0],
+            fixed_ratios=[1.0], flatten_to_2d=True)
+        assert flat.shape == (16, 4)
+
+    def test_anchor_generator(self):
+        feat = jnp.zeros((1, 8, 3, 3))
+        anchors, var = V.anchor_generator(
+            feat, anchor_sizes=[32.0, 64.0], aspect_ratios=[0.5, 1.0],
+            stride=[16.0, 16.0])
+        assert anchors.shape == (3, 3, 4, 4)
+        a = np.asarray(anchors)
+        assert (a[..., 2] > a[..., 0]).all() and (a[..., 3] > a[..., 1]).all()
+
+
+class TestBoxTransforms:
+    def test_box_coder_roundtrip(self):
+        rs = np.random.RandomState(0)
+        priors = np.abs(rs.rand(5, 4)).astype("f4")
+        priors[:, 2:] = priors[:, :2] + 0.2 + priors[:, 2:]
+        targets = priors + 0.05 * rs.randn(5, 4).astype("f4")
+        var = np.full((5, 4), 0.1, dtype="f4")
+        enc = V.box_coder(priors, var, targets,
+                          code_type="encode_center_size")
+        assert enc.shape == (5, 5, 4)
+        # decode the diagonal (each target against its own prior)
+        dec = V.box_coder(priors, var, enc, code_type="decode_center_size")
+        diag = np.asarray(dec)[np.arange(5), np.arange(5)]
+        np.testing.assert_allclose(diag, targets, rtol=1e-4, atol=1e-5)
+
+    def test_box_coder_differentiable(self):
+        priors = jnp.asarray([[0.0, 0.0, 1.0, 1.0]], jnp.float32)
+
+        def f(t):
+            return jnp.sum(V.box_coder(priors, None, t) ** 2)
+
+        g = jax.grad(f)(jnp.asarray([[0.1, 0.1, 0.8, 0.9]], jnp.float32))
+        assert np.isfinite(np.asarray(g)).all() and np.any(g != 0)
+
+    def test_box_clip(self):
+        boxes = jnp.asarray([[-5.0, -5.0, 30.0, 40.0]])
+        out = V.box_clip(boxes, [20.0, 25.0, 1.0])
+        np.testing.assert_allclose(np.asarray(out[0]),
+                                   [0.0, 0.0, 24.0, 19.0])
+
+    def test_polygon_box_transform(self):
+        x = jnp.zeros((1, 2, 2, 3))
+        out = np.asarray(V.polygon_box_transform(x))
+        # even channel: 4*j; odd channel: 4*i
+        np.testing.assert_allclose(out[0, 0, 0], [0.0, 4.0, 8.0])
+        np.testing.assert_allclose(out[0, 1, :, 0], [0.0, 4.0])
+
+
+class TestIoUAndMatching:
+    def test_iou_similarity_values(self):
+        a = jnp.asarray([[0.0, 0.0, 1.0, 1.0]])
+        b = jnp.asarray([[0.0, 0.0, 1.0, 1.0],
+                         [2.0, 2.0, 3.0, 3.0],
+                         [0.5, 0.0, 1.5, 1.0]])
+        iou = np.asarray(V.iou_similarity(a, b))
+        np.testing.assert_allclose(iou[0], [1.0, 0.0, 1.0 / 3.0],
+                                   rtol=1e-6)
+
+    def test_bipartite_match_greedy(self):
+        d = np.asarray([[0.9, 0.1, 0.3],
+                        [0.8, 0.7, 0.2]])
+        idx, dist = V.bipartite_match(d)
+        # global max 0.9 -> row0/col0; next best row1 -> col1 (0.7)
+        np.testing.assert_array_equal(np.asarray(idx), [0, 1, -1])
+        np.testing.assert_allclose(np.asarray(dist), [0.9, 0.7, 0.0])
+
+    def test_bipartite_match_per_prediction(self):
+        d = np.asarray([[0.9, 0.6], [0.1, 0.2]])
+        idx, dist = V.bipartite_match(d, match_type="per_prediction",
+                                      dist_threshold=0.5)
+        # col1's bipartite match would be row1 (0.2) — but greedy takes
+        # (0,0) first, then (1,1)=0.2; per_prediction does not rematch
+        # matched cols; unmatched cols above threshold get argmax row
+        assert int(idx[0]) == 0
+
+
+class TestNmsFamily:
+    def test_nms_suppresses_overlaps(self):
+        boxes = np.asarray([[0, 0, 10, 10], [1, 1, 10, 10],
+                            [20, 20, 30, 30]], dtype="f4")
+        scores = np.asarray([0.9, 0.8, 0.7], dtype="f4")
+        keep = np.asarray(V.nms(boxes, 0.5, scores=scores))
+        np.testing.assert_array_equal(keep, [0, 2])
+
+    def test_nms_categories_do_not_suppress(self):
+        boxes = np.asarray([[0, 0, 10, 10], [1, 1, 10, 10]], dtype="f4")
+        scores = np.asarray([0.9, 0.8], dtype="f4")
+        keep = np.asarray(V.nms(boxes, 0.5, scores=scores,
+                                category_idxs=np.asarray([0, 1]),
+                                categories=[0, 1]))
+        assert set(keep.tolist()) == {0, 1}
+
+    def test_multiclass_nms(self):
+        bboxes = np.zeros((1, 3, 4), dtype="f4")
+        bboxes[0] = [[0, 0, 10, 10], [1, 1, 10, 10], [20, 20, 30, 30]]
+        scores = np.zeros((1, 2, 3), dtype="f4")
+        scores[0, 1] = [0.9, 0.85, 0.6]
+        outs = V.multiclass_nms(bboxes, scores, score_threshold=0.1,
+                                nms_threshold=0.5, background_label=0)
+        dets = np.asarray(outs[0])
+        assert dets.shape[1] == 6
+        assert dets.shape[0] == 2            # one suppressed
+        assert (dets[:, 0] == 1).all()       # class label
+        assert dets[0, 1] >= dets[1, 1]      # sorted by score
+
+    def test_matrix_nms_decays_overlaps(self):
+        bboxes = np.zeros((1, 2, 4), dtype="f4")
+        bboxes[0] = [[0, 0, 10, 10], [0.5, 0.5, 10, 10]]  # IoU ~0.9
+        scores = np.zeros((1, 2, 2), dtype="f4")
+        scores[0, 1] = [0.9, 0.8]
+        outs = V.matrix_nms(bboxes, scores, score_threshold=0.1,
+                            post_threshold=0.0)
+        dets = np.asarray(outs[0])
+        assert dets.shape[0] == 2
+        np.testing.assert_allclose(dets[0, 1], 0.9, rtol=1e-5)  # top kept
+        assert dets[1, 1] < 0.2              # near-duplicate decayed hard
+
+
+class TestRoiOps:
+    def test_roi_align_constant_map(self):
+        x = jnp.full((1, 2, 8, 8), 3.0)
+        boxes = jnp.asarray([[0.0, 0.0, 8.0, 8.0]])
+        out = V.roi_align(x, boxes, [1], output_size=4)
+        assert out.shape == (1, 2, 4, 4)
+        np.testing.assert_allclose(np.asarray(out), 3.0, rtol=1e-6)
+
+    def test_roi_align_gradient_flows(self):
+        x = jnp.asarray(np.random.RandomState(0).rand(1, 1, 8, 8), jnp.float32)
+        boxes = jnp.asarray([[1.0, 1.0, 6.0, 6.0]])
+
+        def f(xx):
+            return jnp.sum(V.roi_align(xx, boxes, [1], 2))
+
+        g = np.asarray(jax.grad(f)(x))
+        assert np.isfinite(g).all() and (g != 0).any()
+        # gradient localized inside the box region
+        assert g[0, 0, 0, 7] == 0.0
+
+    def test_roi_pool_exact_max(self):
+        x = jnp.asarray(np.arange(16, dtype="f4").reshape(1, 1, 4, 4))
+        boxes = jnp.asarray([[0.0, 0.0, 3.0, 3.0]])
+        out = V.roi_pool(x, boxes, [1], output_size=2)
+        np.testing.assert_allclose(np.asarray(out[0, 0]),
+                                   [[5.0, 7.0], [13.0, 15.0]])
+
+    def test_psroi_pool_uniform(self):
+        x = jnp.full((1, 8, 6, 6), 2.0)   # out_c=2, ph=pw=2
+        boxes = jnp.asarray([[0.0, 0.0, 5.0, 5.0]])
+        out = V.psroi_pool(x, boxes, [1], output_size=2)
+        assert out.shape == (1, 2, 2, 2)
+        np.testing.assert_allclose(np.asarray(out), 2.0, rtol=1e-6)
+
+
+class TestReviewRegressions:
+    def test_box_clip_batched_im_info(self):
+        boxes = jnp.asarray([[[0.0, 0.0, 400.0, 400.0]],
+                             [[0.0, 0.0, 400.0, 400.0]]])
+        info = np.asarray([[100.0, 100.0, 1.0], [500.0, 500.0, 1.0]])
+        out = np.asarray(V.box_clip(boxes, info))
+        np.testing.assert_allclose(out[0, 0], [0, 0, 99, 99])
+        np.testing.assert_allclose(out[1, 0], [0, 0, 400, 400])
+
+    def test_nms_unnormalized_pixel_iou(self):
+        # two identical 1x1 pixel boxes: normalized IoU degenerate (0),
+        # pixel IoU 1.0 -> second must be suppressed
+        boxes = np.asarray([[5, 5, 5, 5], [5, 5, 5, 5]], dtype="f4")
+        keep = np.asarray(V.nms(boxes, 0.5, scores=np.asarray([0.9, 0.8]),
+                                normalized=False))
+        np.testing.assert_array_equal(keep, [0])
+
+    def test_generate_proposals_returns_scores(self):
+        rs2 = np.random.RandomState(1)
+        feat = jnp.zeros((1, 8, 3, 3))
+        anchors, var = V.anchor_generator(
+            feat, anchor_sizes=[16.0], aspect_ratios=[1.0],
+            stride=[8.0, 8.0])
+        scores = rs2.rand(1, 1, 3, 3).astype("f4")
+        deltas = (0.05 * rs2.randn(1, 4, 3, 3)).astype("f4")
+        rois, probs, num = V.generate_proposals(
+            scores, deltas, np.asarray([[24.0, 24.0]]), anchors, var,
+            post_nms_top_n=5, return_rois_num=True)
+        assert probs is not None and probs.shape[0] == rois.shape[0]
+        p = np.asarray(probs)
+        assert (np.diff(p) <= 1e-6).all()  # sorted by score
+
+
+class TestGenerateProposals:
+    def test_shapes_and_clipping(self):
+        rs = np.random.RandomState(0)
+        H = W = 4
+        A = 3
+        scores = rs.rand(1, A, H, W).astype("f4")
+        deltas = (0.1 * rs.randn(1, A * 4, H, W)).astype("f4")
+        feat = jnp.zeros((1, 8, H, W))
+        anchors, var = V.anchor_generator(
+            feat, anchor_sizes=[16.0, 32.0, 64.0][:A] if A <= 3 else None,
+            aspect_ratios=[1.0], stride=[8.0, 8.0])
+        rois, _, num = V.generate_proposals(
+            scores, deltas, np.asarray([[32.0, 32.0]]), anchors, var,
+            pre_nms_top_n=20, post_nms_top_n=8, nms_thresh=0.7,
+            return_rois_num=True)
+        r = np.asarray(rois)
+        assert r.shape[1] == 4 and r.shape[0] == int(num[0]) <= 8
+        assert (r[:, 0] >= 0).all() and (r[:, 2] <= 31).all()
+        assert (r[:, 1] >= 0).all() and (r[:, 3] <= 31).all()

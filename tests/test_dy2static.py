@@ -65,14 +65,17 @@ class TestIfElse:
         x = jnp.ones((2,))
         np.testing.assert_allclose(g(x), 2.0)
 
-    def test_one_branch_assignment_diagnostic(self):
+    def test_one_branch_assignment_keeps_defined_value(self):
+        """A variable assigned in only one branch: like the reference's
+        RETURN_NO_VALUE handling, the defined side's value is used (reading
+        it when the other branch was taken is Python-level UB anyway)."""
         def f(x):
             if jnp.sum(x) > 0:
                 y = x + 1  # only this branch defines y
             return y  # noqa: F821
 
-        with pytest.raises(Dy2StaticError, match="matching variables"):
-            jax.jit(convert_function(f))(jnp.ones((2,)))
+        g = jax.jit(convert_function(f))
+        np.testing.assert_allclose(g(jnp.ones((2,))), 2.0)
 
     def test_early_return_diagnostic(self):
         def f(x):
@@ -283,6 +286,82 @@ class TestReviewRegressions:
         assert float(g(jnp.asarray(0.0), 3)) == 3.0
         with pytest.raises(Dy2StaticError, match="reassigns its loop"):
             jax.jit(g)(jnp.asarray(0.0), jnp.asarray(3))
+
+    def test_inner_python_loop_break_allowed(self):
+        """break belonging to a nested Python loop must not poison the
+        enclosing tensor-dependent if (it stages fine under lax.cond)."""
+        def f(x):
+            if jnp.sum(x) > 0:
+                y = x
+                for k in [1, 2, 3]:
+                    if k == 2:
+                        break
+                    y = y + k
+            else:
+                y = -x
+            return y
+
+        g = jax.jit(convert_function(f))
+        np.testing.assert_allclose(g(jnp.ones((2,))), 2.0)
+        np.testing.assert_allclose(g(-jnp.ones((2,))), 1.0)
+
+    def test_for_loop_var_final_value(self):
+        """After `for i in range(n)`, i must hold the LAST iterated value
+        (Python semantics), not the post-increment."""
+        def f(x, n):
+            s = x
+            i = -1
+            for i in range(n):
+                s = s + i
+            return s, i
+
+        g = convert_function(f)
+        s, i = g(jnp.zeros(()), 3)
+        assert float(s) == 3.0 and int(i) == 2
+        sj, ij = jax.jit(g)(jnp.zeros(()), jnp.asarray(3))
+        assert float(sj) == 3.0 and int(ij) == 2
+
+    def test_global_declaration_declines_conversion(self):
+        def f(x):
+            global _SOME_GLOBAL
+            _SOME_GLOBAL = 1
+            return x
+
+        with pytest.warns(UserWarning, match="global/nonlocal"):
+            g = convert_function(f)
+        assert g is f
+
+    def test_converted_loop_inside_tensor_if(self):
+        """A converted range-loop inside a tensor-dependent if: the pass's
+        own __dy2s_* temporaries must not become branch variables."""
+        def f(x):
+            if jnp.sum(x) > 0:
+                s = x
+                for i in range(3):
+                    s = s + i
+            else:
+                s = -x
+            return s
+
+        g = jax.jit(convert_function(f))
+        np.testing.assert_allclose(g(jnp.ones((2,))), 4.0)
+        np.testing.assert_allclose(g(-jnp.ones((2,))), 1.0)
+
+    def test_break_in_nested_loop_else_clause(self):
+        """break in a nested for's ELSE clause belongs to the OUTER while
+        — conversion must not emit 'break' outside a loop."""
+        def f(x, n):
+            out = x
+            i = 0
+            while i < n:
+                for k in [1, 2]:
+                    out = out + k
+                else:
+                    break
+            return out
+
+        g = convert_function(f)  # must not raise SyntaxError
+        assert float(g(jnp.zeros(()), 5)) == 3.0
 
     def test_user_type_error_not_rebranded(self):
         def f(x):
