@@ -1,0 +1,186 @@
+// Graph table for graph-learning workloads — the capability of the
+// reference's distributed/table/common_graph_table.cc (sharded adjacency
+// store + uniform neighbor sampling + node feature rows; NOT a port: fresh
+// unordered_map adjacency with per-shard locks, xorshift sampling, C ABI
+// for ctypes). Multi-host sharding happens above by node-key hash routing,
+// exactly like the sparse table (distributed/ps/service.py).
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kShards = 64;
+
+struct Node {
+  std::vector<int64_t> neighbors;
+  std::vector<float> weights;   // empty = unweighted
+  std::vector<float> feature;   // empty = no feature
+};
+
+struct GShard {
+  std::unordered_map<int64_t, Node> nodes;
+  std::mutex mu;
+};
+
+class GraphTable {
+ public:
+  explicit GraphTable(int feat_dim, uint64_t seed)
+      : feat_dim_(feat_dim), seed_(seed) {}
+
+  static int ShardOf(int64_t key) {
+    uint64_t h = static_cast<uint64_t>(key);
+    h ^= h >> 33;
+    h *= 0xff51afd7ed558ccdULL;
+    h ^= h >> 33;
+    return static_cast<int>(h % kShards);
+  }
+
+  void AddEdges(const int64_t* src, const int64_t* dst, const float* w,
+                int64_t n) {
+    for (int64_t i = 0; i < n; ++i) {
+      GShard& s = shards_[ShardOf(src[i])];
+      std::lock_guard<std::mutex> lk(s.mu);
+      Node& node = s.nodes[src[i]];
+      node.neighbors.push_back(dst[i]);
+      if (w) node.weights.push_back(w[i]);
+    }
+  }
+
+  void SetFeature(const int64_t* keys, const float* feats, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) {
+      GShard& s = shards_[ShardOf(keys[i])];
+      std::lock_guard<std::mutex> lk(s.mu);
+      Node& node = s.nodes[keys[i]];
+      node.feature.assign(feats + i * feat_dim_,
+                          feats + (i + 1) * feat_dim_);
+    }
+  }
+
+  void GetFeature(const int64_t* keys, float* out, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) {
+      GShard& s = shards_[ShardOf(keys[i])];
+      std::lock_guard<std::mutex> lk(s.mu);
+      auto it = s.nodes.find(keys[i]);
+      if (it != s.nodes.end() &&
+          static_cast<int>(it->second.feature.size()) == feat_dim_) {
+        std::memcpy(out + i * feat_dim_, it->second.feature.data(),
+                    sizeof(float) * feat_dim_);
+      } else {
+        std::memset(out + i * feat_dim_, 0, sizeof(float) * feat_dim_);
+      }
+    }
+  }
+
+  int64_t Degree(int64_t key) {
+    GShard& s = shards_[ShardOf(key)];
+    std::lock_guard<std::mutex> lk(s.mu);
+    auto it = s.nodes.find(key);
+    return it == s.nodes.end()
+               ? 0
+               : static_cast<int64_t>(it->second.neighbors.size());
+  }
+
+  // Uniform sample (with replacement if degree < k, reference
+  // random_sample_neighboors semantics return actual count): out gets k
+  // slots per key, missing filled with -1; counts[i] = actual neighbors
+  // written.
+  void SampleNeighbors(const int64_t* keys, int64_t n, int k, uint64_t seed,
+                       int64_t* out, int64_t* counts) {
+    for (int64_t i = 0; i < n; ++i) {
+      GShard& s = shards_[ShardOf(keys[i])];
+      std::lock_guard<std::mutex> lk(s.mu);
+      auto it = s.nodes.find(keys[i]);
+      int64_t* dst = out + i * k;
+      if (it == s.nodes.end() || it->second.neighbors.empty()) {
+        for (int j = 0; j < k; ++j) dst[j] = -1;
+        counts[i] = 0;
+        continue;
+      }
+      const auto& nb = it->second.neighbors;
+      int64_t deg = static_cast<int64_t>(nb.size());
+      std::mt19937_64 rng(seed_ ^ seed ^
+                          (static_cast<uint64_t>(keys[i]) * 0x9e3779b9ULL));
+      if (deg <= k) {
+        // all neighbors (shuffled), pad with -1
+        std::vector<int64_t> perm(nb);
+        for (int64_t j = deg - 1; j > 0; --j) {
+          std::swap(perm[j], perm[rng() % (j + 1)]);
+        }
+        for (int64_t j = 0; j < k; ++j) dst[j] = j < deg ? perm[j] : -1;
+        counts[i] = deg;
+      } else {
+        // Floyd's sampling without replacement
+        std::unordered_map<int64_t, int64_t> repl;
+        for (int64_t j = 0; j < k; ++j) {
+          int64_t r = static_cast<int64_t>(rng() % (deg - j)) + j;
+          int64_t vj = repl.count(j) ? repl[j] : j;
+          int64_t vr = repl.count(r) ? repl[r] : r;
+          dst[j] = nb[vr];
+          repl[r] = vj;
+        }
+        counts[i] = k;
+      }
+    }
+  }
+
+  int64_t NumNodes() {
+    int64_t n = 0;
+    for (auto& s : shards_) {
+      std::lock_guard<std::mutex> lk(s.mu);
+      n += static_cast<int64_t>(s.nodes.size());
+    }
+    return n;
+  }
+
+ private:
+  int feat_dim_;
+  uint64_t seed_;
+  GShard shards_[kShards];
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ps_graph_create(int feat_dim, uint64_t seed) {
+  return new GraphTable(feat_dim, seed);
+}
+
+void ps_graph_destroy(void* g) { delete static_cast<GraphTable*>(g); }
+
+void ps_graph_add_edges(void* g, const int64_t* src, const int64_t* dst,
+                        const float* w, int64_t n) {
+  static_cast<GraphTable*>(g)->AddEdges(src, dst, w, n);
+}
+
+void ps_graph_set_feature(void* g, const int64_t* keys, const float* feats,
+                          int64_t n) {
+  static_cast<GraphTable*>(g)->SetFeature(keys, feats, n);
+}
+
+void ps_graph_get_feature(void* g, const int64_t* keys, float* out,
+                          int64_t n) {
+  static_cast<GraphTable*>(g)->GetFeature(keys, out, n);
+}
+
+int64_t ps_graph_degree(void* g, int64_t key) {
+  return static_cast<GraphTable*>(g)->Degree(key);
+}
+
+void ps_graph_sample_neighbors(void* g, const int64_t* keys, int64_t n,
+                               int k, uint64_t seed, int64_t* out,
+                               int64_t* counts) {
+  static_cast<GraphTable*>(g)->SampleNeighbors(keys, n, k, seed, out,
+                                               counts);
+}
+
+int64_t ps_graph_num_nodes(void* g) {
+  return static_cast<GraphTable*>(g)->NumNodes();
+}
+
+}  // extern "C"

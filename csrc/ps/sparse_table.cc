@@ -21,6 +21,7 @@
 // Optimizers run on the host, one row at a time, matching the PS model
 // where the server applies updates (SGD / Adagrad / Adam).
 
+#include <algorithm>
 #include <atomic>
 #include <cmath>
 #include <cstdint>
@@ -41,6 +42,8 @@ enum Optimizer : int { kSGD = 0, kAdagrad = 1, kAdam = 2 };
 
 struct Shard {
   std::unordered_map<int64_t, uint64_t> index;  // key -> row offset
+  std::unordered_map<int64_t, uint64_t> touch;  // key -> last access tick
+  std::unordered_map<int64_t, uint64_t> cold;   // key -> spill-file offset
   std::vector<float> pool;                      // rows, stride = row_width
   std::mutex mu;
 };
@@ -62,13 +65,116 @@ class SparseTable {
 
   int dim() const { return dim_; }
 
+  ~SparseTable() {
+    if (spill_f_) std::fclose(spill_f_);
+  }
+
   int64_t size() {
+    int64_t n = 0;
+    for (auto& s : shards_) {
+      std::lock_guard<std::mutex> lk(s.mu);
+      n += static_cast<int64_t>(s.index.size() + s.cold.size());
+    }
+    return n;
+  }
+
+  int64_t hot_rows() {
     int64_t n = 0;
     for (auto& s : shards_) {
       std::lock_guard<std::mutex> lk(s.mu);
       n += static_cast<int64_t>(s.index.size());
     }
     return n;
+  }
+
+  // SSD tier (reference table/ssd_sparse_table.cc, RocksDB-backed cold
+  // store): evict least-recently-touched rows beyond `max_hot` to a spill
+  // file; promoted back transparently by FindOrCreate. Each call rewrites
+  // the spill file (compaction of promoted-away rows).
+  bool Spill(const char* path, int64_t max_hot) {
+    std::vector<std::unique_lock<std::mutex>> locks;
+    locks.reserve(kShards);
+    for (auto& s : shards_) locks.emplace_back(s.mu);
+    std::lock_guard<std::mutex> flk(spill_mu_);
+
+    // rank hot rows by recency; keys beyond max_hot get evicted
+    std::vector<std::pair<uint64_t, int64_t>> hot;  // (touch, key)
+    for (auto& s : shards_) {
+      for (const auto& kv : s.index) {
+        auto t = s.touch.find(kv.first);
+        hot.emplace_back(t == s.touch.end() ? 0 : t->second, kv.first);
+      }
+    }
+    int64_t n_evict =
+        std::max<int64_t>(0, static_cast<int64_t>(hot.size()) - max_hot);
+    std::unordered_map<int64_t, bool> evict;
+    if (n_evict > 0) {
+      std::nth_element(hot.begin(), hot.begin() + n_evict, hot.end());
+      for (int64_t i = 0; i < n_evict; ++i) evict[hot[i].second] = true;
+    }
+
+    // two-phase: build the whole new file AND the new shard states first;
+    // only COMMIT (swap shard state + file handle) after rename succeeds,
+    // so any mid-write failure leaves the table untouched on the old file
+    std::string tmp = std::string(path) + ".tmp";
+    FILE* nf = std::fopen(tmp.c_str(), "wb+");
+    if (!nf) return false;
+    struct NewShard {
+      std::unordered_map<int64_t, uint64_t> index;
+      std::unordered_map<int64_t, uint64_t> cold;
+      std::vector<float> pool;
+    };
+    std::vector<NewShard> staged(kShards);
+    bool ok = true;
+    std::vector<float> row(row_width_);
+    for (int si = 0; si < kShards && ok; ++si) {
+      Shard& s = shards_[si];
+      NewShard& ns = staged[si];
+      // surviving cold rows: copy from the old file (compaction)
+      for (const auto& kv : s.cold) {
+        if (!spill_f_) { ok = false; break; }
+        std::fseek(spill_f_, static_cast<long>(kv.second), SEEK_SET);
+        if (std::fread(row.data(), sizeof(float), row_width_, spill_f_) !=
+            static_cast<size_t>(row_width_)) { ok = false; break; }
+        ns.cold[kv.first] = static_cast<uint64_t>(std::ftell(nf));
+        if (std::fwrite(row.data(), sizeof(float), row_width_, nf) !=
+            static_cast<size_t>(row_width_)) { ok = false; break; }
+      }
+      if (!ok) break;
+      for (const auto& kv : s.index) {
+        const float* src = s.pool.data() + kv.second;
+        if (evict.count(kv.first)) {
+          ns.cold[kv.first] = static_cast<uint64_t>(std::ftell(nf));
+          if (std::fwrite(src, sizeof(float), row_width_, nf) !=
+              static_cast<size_t>(row_width_)) { ok = false; break; }
+        } else {
+          uint64_t off = ns.pool.size();
+          ns.pool.resize(off + row_width_);
+          std::memcpy(ns.pool.data() + off, src,
+                      sizeof(float) * row_width_);
+          ns.index[kv.first] = off;
+        }
+      }
+    }
+    if (!ok || std::fflush(nf) != 0 ||
+        std::rename(tmp.c_str(), path) != 0) {
+      std::fclose(nf);
+      std::remove(tmp.c_str());
+      return false;  // table state untouched, old spill file still valid
+    }
+    // commit
+    for (int si = 0; si < kShards; ++si) {
+      Shard& s = shards_[si];
+      for (const auto& kv : staged[si].cold)
+        if (s.index.count(kv.first)) s.touch.erase(kv.first);
+      s.index = std::move(staged[si].index);
+      s.pool = std::move(staged[si].pool);
+      s.cold = std::move(staged[si].cold);
+    }
+    if (spill_f_) std::fclose(spill_f_);
+    spill_f_ = nf;  // nf's descriptor follows the renamed file
+    spill_path_ = path;
+    return true;
   }
 
   // Lookup rows for keys[0..n); missing keys are initialized (uniform in
@@ -144,13 +250,16 @@ class SparseTable {
     std::vector<std::unique_lock<std::mutex>> locks;
     locks.reserve(kShards);
     for (auto& s : shards_) locks.emplace_back(s.mu);
+    std::lock_guard<std::mutex> flk(spill_mu_);
     std::string tmp = std::string(path) + ".tmp";
     FILE* f = std::fopen(tmp.c_str(), "wb");
     if (!f) return false;
     int64_t nrows = 0;
-    for (auto& s : shards_) nrows += static_cast<int64_t>(s.index.size());
+    for (auto& s : shards_)
+      nrows += static_cast<int64_t>(s.index.size() + s.cold.size());
     int64_t header[5] = {dim_, opt_, slots_, step_.load(), nrows};
     bool ok = std::fwrite(header, sizeof(int64_t), 5, f) == 5;
+    std::vector<float> crow(row_width_);
     for (auto& s : shards_) {
       if (!ok) break;
       for (const auto& kv : s.index) {
@@ -159,6 +268,19 @@ class SparseTable {
                         f) != static_cast<size_t>(row_width_)) {
           ok = false;
           break;
+        }
+      }
+      // spilled (cold) rows are part of the checkpoint too
+      for (const auto& kv : s.cold) {
+        if (!ok) break;
+        if (!spill_f_) { ok = false; break; }
+        std::fseek(spill_f_, static_cast<long>(kv.second), SEEK_SET);
+        if (std::fread(crow.data(), sizeof(float), row_width_, spill_f_) !=
+                static_cast<size_t>(row_width_) ||
+            std::fwrite(&kv.first, sizeof(int64_t), 1, f) != 1 ||
+            std::fwrite(crow.data(), sizeof(float), row_width_, f) !=
+                static_cast<size_t>(row_width_)) {
+          ok = false;
         }
       }
     }
@@ -205,11 +327,14 @@ class SparseTable {
     }
     std::fclose(f);
     // a checkpoint fully replaces table contents (rows auto-created by a
-    // warm-up pull before load must not survive and merge with it)
+    // warm-up pull before load must not survive and merge with it);
+    // everything loads hot — the cold tier restarts empty
     for (int s = 0; s < kShards; ++s) {
       std::lock_guard<std::mutex> lk(shards_[s].mu);
       shards_[s].index = std::move(staged[s].index);
       shards_[s].pool = std::move(staged[s].pool);
+      shards_[s].cold.clear();
+      shards_[s].touch.clear();
     }
     step_ = header[3];
     return true;
@@ -234,8 +359,30 @@ class SparseTable {
   const float* FindOrCreate(int64_t key, bool create) {
     Shard& s = shards_[ShardOf(key)];
     auto it = s.index.find(key);
-    if (it != s.index.end()) return s.pool.data() + it->second;
+    if (it != s.index.end()) {
+      s.touch[key] = ++tick_;  // only EXISTING/created rows get a touch
+      return s.pool.data() + it->second;
+    }
+    // SSD tier (reference table/ssd_sparse_table.cc): cold rows live in
+    // the spill file and are transparently promoted back on access
+    auto cit = s.cold.find(key);
+    if (cit != s.cold.end()) {
+      s.touch[key] = ++tick_;
+      uint64_t off = AllocRow(s);
+      {
+        std::lock_guard<std::mutex> flk(spill_mu_);
+        std::fseek(spill_f_, static_cast<long>(cit->second), SEEK_SET);
+        if (std::fread(s.pool.data() + off, sizeof(float), row_width_,
+                       spill_f_) != static_cast<size_t>(row_width_)) {
+          std::memset(s.pool.data() + off, 0, sizeof(float) * row_width_);
+        }
+      }
+      s.index[key] = off;
+      s.cold.erase(cit);
+      return s.pool.data() + off;
+    }
     if (!create) return nullptr;
+    s.touch[key] = ++tick_;
     uint64_t off = AllocRow(s);
     s.index[key] = off;
     float* row = s.pool.data() + off;
@@ -267,6 +414,10 @@ class SparseTable {
   uint64_t seed_;
   float init_range_, beta1_, beta2_, eps_;
   std::atomic<int64_t> step_;
+  std::atomic<uint64_t> tick_{0};
+  FILE* spill_f_ = nullptr;
+  std::string spill_path_;
+  std::mutex spill_mu_;
   Shard shards_[kShards];
 };
 
@@ -355,6 +506,14 @@ void ps_sparse_push(void* t, const int64_t* keys, int64_t n,
 
 int ps_sparse_save(void* t, const char* path) {
   return static_cast<SparseTable*>(t)->Save(path) ? 1 : 0;
+}
+
+int ps_sparse_spill(void* t, const char* path, int64_t max_hot) {
+  return static_cast<SparseTable*>(t)->Spill(path, max_hot) ? 1 : 0;
+}
+
+int64_t ps_sparse_hot_rows(void* t) {
+  return static_cast<SparseTable*>(t)->hot_rows();
 }
 
 int ps_sparse_load(void* t, const char* path) {

@@ -93,11 +93,91 @@ class InMemoryDataset:
                         f"{int(widths[0])} — check the input files")
         self._order = np.arange(self._n)
 
-    def global_shuffle(self, seed: int = 0):
-        """reference: data_set.h global shuffle (single-host form: permute
-        the example order; multi-host exchange is the caller's alltoall)."""
-        rng = np.random.RandomState(seed)
-        self._order = rng.permutation(self._n)
+    def global_shuffle(self, seed: int = 0, rank: Optional[int] = None,
+                       nprocs: Optional[int] = None,
+                       exchange_dir: Optional[str] = None,
+                       timeout: float = 120.0):
+        """reference: data_set.h:157 global shuffle. Single-host form
+        (rank None): permute the example order. Multi-PROCESS form: every
+        example is routed to a uniformly random destination trainer and
+        physically EXCHANGED (the reference ships examples through the PS;
+        here through a shared filesystem ``exchange_dir`` — each rank
+        writes per-destination shards, barriers on done-markers, then
+        ingests the shards addressed to it), followed by a local
+        permutation."""
+        if rank is None or not nprocs or nprocs == 1:
+            rng = np.random.RandomState(seed)
+            self._order = rng.permutation(self._n)
+            return
+        assert exchange_dir, "multi-process global_shuffle needs a shared " \
+                             "exchange_dir"
+        import os
+        import pickle
+        import time
+        os.makedirs(exchange_dir, exist_ok=True)
+        # shards/markers are keyed by seed so each shuffle ROUND is its own
+        # namespace — reusing (exchange_dir, seed) would let a rank sail
+        # through the barrier on the previous round's stale markers and
+        # read old shards (duplicating/losing examples). Fail loudly.
+        done_mine = os.path.join(exchange_dir, f"done.{seed}.{rank}")
+        if os.path.exists(done_mine):
+            raise ValueError(
+                f"global_shuffle(seed={seed}) was already run in "
+                f"{exchange_dir!r}; use a fresh seed (e.g. the epoch "
+                "number) or a fresh exchange_dir per shuffle round")
+        rng = np.random.RandomState(seed * 100003 + rank)
+        dest = rng.randint(0, nprocs, size=self._n)
+        for d in range(nprocs):
+            idxs = np.nonzero(dest == d)[0]
+            payload = {"n": int(idxs.size),
+                       "slots": [(s.name, s.dense) + self._gather(s, idxs)
+                                 for s in self._slots]}
+            tmp = os.path.join(exchange_dir, f".ex.{seed}.{rank}.{d}.tmp")
+            with open(tmp, "wb") as f:
+                pickle.dump(payload, f)
+            os.replace(tmp, os.path.join(exchange_dir,
+                                         f"ex.{seed}.{rank}.{d}.pkl"))
+        open(done_mine, "w").close()
+        deadline = time.time() + timeout
+        while not all(os.path.exists(os.path.join(exchange_dir,
+                                                  f"done.{seed}.{r}"))
+                      for r in range(nprocs)):
+            if time.time() > deadline:
+                raise TimeoutError("global_shuffle barrier timed out")
+            time.sleep(0.05)
+        parts = []
+        for src in range(nprocs):
+            with open(os.path.join(
+                    exchange_dir, f"ex.{seed}.{src}.{rank}.pkl"),
+                    "rb") as f:
+                parts.append(pickle.load(f))
+        # rebuild slots from the shards addressed to this rank
+        self._n = sum(p["n"] for p in parts)
+        for si, s in enumerate(self._slots):
+            offs = [np.zeros((1,), np.int64)]
+            vals = []
+            base = 0
+            for p in parts:
+                _, _, po, pv = p["slots"][si]
+                offs.append(po[1:] + base)
+                vals.append(pv)
+                base += pv.size
+            s.offsets = np.concatenate(offs)
+            s.values = (np.concatenate(vals) if vals else
+                        np.zeros((0,), s.values.dtype))
+        self._order = np.random.RandomState(
+            seed * 7919 + rank).permutation(self._n)
+
+    @staticmethod
+    def _gather(s: "_Slot", idxs: np.ndarray):
+        """(offsets, values) of the examples `idxs` as a packed pair."""
+        lens = s.offsets[idxs + 1] - s.offsets[idxs]
+        vals = (np.concatenate([s.values[s.offsets[i]:s.offsets[i + 1]]
+                                for i in idxs])
+                if idxs.size else np.zeros((0,), s.values.dtype))
+        offsets = np.concatenate([np.zeros((1,), np.int64),
+                                  np.cumsum(lens)])
+        return offsets, vals
 
     def _example_slice(self, s: _Slot, idx: int):
         a, b = s.offsets[idx], s.offsets[idx + 1]

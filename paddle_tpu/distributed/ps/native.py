@@ -15,7 +15,8 @@ import threading
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(_HERE)))
 _SRC_DIR = os.path.join(_REPO, "csrc", "ps")
-_SOURCES = ["sparse_table.cc", "datafeed.cc", "ps_service.cc"]
+_SOURCES = ["sparse_table.cc", "datafeed.cc", "ps_service.cc",
+            "graph_table.cc"]
 _LIB_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "lib")
 _LIB = os.path.join(_LIB_DIR, "libpaddle_ps.so")
 
@@ -72,6 +73,10 @@ def lib() -> ctypes.CDLL:
         dll.ps_sparse_push.argtypes = [c.c_void_p, p_i64, i64, p_f32, f32]
         dll.ps_sparse_save.restype = c.c_int
         dll.ps_sparse_save.argtypes = [c.c_void_p, c.c_char_p]
+        dll.ps_sparse_spill.restype = c.c_int
+        dll.ps_sparse_spill.argtypes = [c.c_void_p, c.c_char_p, i64]
+        dll.ps_sparse_hot_rows.restype = i64
+        dll.ps_sparse_hot_rows.argtypes = [c.c_void_p]
         dll.ps_sparse_load.restype = c.c_int
         dll.ps_sparse_load.argtypes = [c.c_void_p, c.c_char_p]
 
@@ -101,6 +106,21 @@ def lib() -> ctypes.CDLL:
         dll.ps_client_size.restype = i64
         dll.ps_client_size.argtypes = [c.c_void_p]
         dll.ps_client_close.argtypes = [c.c_void_p]
+
+        dll.ps_graph_create.restype = c.c_void_p
+        dll.ps_graph_create.argtypes = [c.c_int, c.c_uint64]
+        dll.ps_graph_destroy.argtypes = [c.c_void_p]
+        dll.ps_graph_add_edges.argtypes = [c.c_void_p, p_i64, p_i64, p_f32,
+                                           i64]
+        dll.ps_graph_set_feature.argtypes = [c.c_void_p, p_i64, p_f32, i64]
+        dll.ps_graph_get_feature.argtypes = [c.c_void_p, p_i64, p_f32, i64]
+        dll.ps_graph_degree.restype = i64
+        dll.ps_graph_degree.argtypes = [c.c_void_p, i64]
+        dll.ps_graph_sample_neighbors.argtypes = [c.c_void_p, p_i64, i64,
+                                                  c.c_int, c.c_uint64,
+                                                  p_i64, p_i64]
+        dll.ps_graph_num_nodes.restype = i64
+        dll.ps_graph_num_nodes.argtypes = [c.c_void_p]
 
         dll.ps_datafeed_parse.restype = c.c_void_p
         dll.ps_datafeed_parse.argtypes = [c.c_char_p, c.c_int, p_int, c.c_int]

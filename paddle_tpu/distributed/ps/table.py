@@ -76,6 +76,19 @@ class SparseTable:
         self._lib.ps_sparse_push(self._h, _ip(keys), keys.size, _fp(grads),
                                  lr)
 
+    def spill(self, path: str, max_hot_rows: int):
+        """Evict least-recently-touched rows beyond ``max_hot_rows`` to a
+        disk file (reference table/ssd_sparse_table.cc cold tier); spilled
+        rows are promoted back transparently on the next pull/push."""
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        if not self._lib.ps_sparse_spill(self._h, path.encode(),
+                                         int(max_hot_rows)):
+            raise IOError(f"failed to spill sparse table to {path}")
+
+    @property
+    def hot_rows(self) -> int:
+        return int(self._lib.ps_sparse_hot_rows(self._h))
+
     def save(self, path: str):
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         if not self._lib.ps_sparse_save(self._h, path.encode()):
@@ -120,6 +133,62 @@ class DenseTable:
         g = _as_f32(grad).reshape(-1)
         assert g.size == self.size
         self._lib.ps_dense_push(self._h, _fp(g), lr)
+
+
+class GraphTable:
+    """Graph store + neighbor sampling for graph-learning PS workloads
+    (reference: distributed/table/common_graph_table.cc — adjacency store,
+    random_sample_neighboors, node features). Multi-host sharding by node
+    key hash happens above (``shard_keys``), like the sparse table."""
+
+    def __init__(self, feat_dim: int = 0, seed: int = 0):
+        self.feat_dim = int(feat_dim)
+        self._lib = lib()
+        self._h = self._lib.ps_graph_create(self.feat_dim, seed)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.ps_graph_destroy(self._h)
+            self._h = None
+
+    def add_edges(self, src, dst, weights=None):
+        src = _as_i64(src).reshape(-1)
+        dst = _as_i64(dst).reshape(-1)
+        assert src.size == dst.size
+        wp = _fp(_as_f32(weights).reshape(-1)) if weights is not None \
+            else None
+        self._lib.ps_graph_add_edges(self._h, _ip(src), _ip(dst), wp,
+                                     src.size)
+
+    def set_node_feature(self, keys, feats):
+        keys = _as_i64(keys).reshape(-1)
+        feats = _as_f32(feats).reshape(keys.size, self.feat_dim)
+        self._lib.ps_graph_set_feature(self._h, _ip(keys), _fp(feats),
+                                       keys.size)
+
+    def node_feature(self, keys) -> np.ndarray:
+        keys = _as_i64(keys).reshape(-1)
+        out = np.empty((keys.size, self.feat_dim), dtype=np.float32)
+        self._lib.ps_graph_get_feature(self._h, _ip(keys), _fp(out),
+                                       keys.size)
+        return out
+
+    def degree(self, key: int) -> int:
+        return int(self._lib.ps_graph_degree(self._h, int(key)))
+
+    def sample_neighbors(self, keys, k: int, seed: int = 0):
+        """Uniform sample without replacement: returns (neighbors
+        (N, k) with -1 padding, counts (N,))."""
+        keys = _as_i64(keys).reshape(-1)
+        out = np.empty((keys.size, k), dtype=np.int64)
+        counts = np.empty((keys.size,), dtype=np.int64)
+        self._lib.ps_graph_sample_neighbors(self._h, _ip(keys), keys.size,
+                                            int(k), int(seed), _ip(out),
+                                            _ip(counts))
+        return out, counts
+
+    def __len__(self):
+        return int(self._lib.ps_graph_num_nodes(self._h))
 
 
 def shard_keys(keys: np.ndarray, num_shards: int) -> np.ndarray:

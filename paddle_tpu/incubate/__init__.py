@@ -1,4 +1,5 @@
 """paddle_tpu.incubate — experimental features (reference:
 python/paddle/incubate/)."""
 from .moe import ExpertFFN, MoELayer, top2_gating  # noqa: F401
+from . import asp  # noqa: F401,E402
 from . import checkpoint  # noqa: F401,E402

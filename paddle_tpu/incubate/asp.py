@@ -1,0 +1,86 @@
+"""ASP — automatic structured (n:m) sparsity (reference:
+python/paddle/fluid/contrib/sparsity/asp.py prune_model/decorate +
+utils.py mask algorithms; the reference targets Ampere 2:4 sparse tensor
+cores). TPU note: the MXU has no sparse mode, so ASP here preserves the
+SEMANTICS — n:m-sparse weights maintained through training (masks
+re-applied after every optimizer step) for model-compression /
+sparse-deployment parity — without a kernel speedup claim.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+import jax.numpy as jnp
+
+_MASKS: Dict[int, Dict[str, jnp.ndarray]] = {}  # id(model) -> name -> mask
+
+
+def compute_nm_mask(w, n: int = 2, m: int = 4):
+    """Per group of ``m`` consecutive elements along the LAST dim, keep the
+    ``n`` largest magnitudes (reference sparsity/utils.py get_mask_1d)."""
+    w = jnp.asarray(w)
+    last = w.shape[-1]
+    if last % m != 0:
+        return jnp.ones_like(w, dtype=jnp.float32)
+    groups = w.reshape(-1, m)
+    order = jnp.argsort(-jnp.abs(groups), axis=-1)
+    ranks = jnp.argsort(order, axis=-1)     # rank of each element
+    mask = (ranks < n).astype(jnp.float32)
+    return mask.reshape(w.shape)
+
+
+def check_sparsity(w, n: int = 2, m: int = 4) -> bool:
+    """True iff every m-group along the last dim has <= n nonzeros
+    (reference sparsity/utils.py check_mask_1d)."""
+    w = np.asarray(w)
+    if w.shape[-1] % m != 0:
+        return False
+    groups = np.abs(w.reshape(-1, m)) > 0
+    return bool((groups.sum(axis=-1) <= n).all())
+
+
+def _prunable(name: str, p, m: int = 4) -> bool:
+    v = getattr(p, "value", p)
+    return (getattr(p, "trainable", True) and v.ndim == 2
+            and v.shape[-1] % m == 0 and name.endswith("weight"))
+
+
+def prune_model(model, n: int = 2, m: int = 4):
+    """Apply n:m masks to every prunable weight (2-D, last dim % m == 0)
+    and remember them (reference asp.py prune_model). Returns the masks."""
+    masks: Dict[str, jnp.ndarray] = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p, m):
+            continue
+        mask = compute_nm_mask(p.value, n, m)
+        p.value = p.value * mask
+        masks[name] = mask
+    _MASKS[id(model)] = masks
+    return masks
+
+
+def decorate(optimizer, model):
+    """Wrap the optimizer so every step re-applies the pruning masks
+    (reference asp.py decorate: masked params stay masked through
+    training — gradients may be dense, the update is re-projected).
+    Masks are looked up at step time, so the reference's documented call
+    order (decorate before prune_model) works too."""
+    orig = optimizer.apply_gradients
+    model_id = id(model)
+
+    def apply_gradients(params, grads, state, lr=None, lr_scales=None):
+        new_p, new_s = orig(params, grads, state, lr=lr,
+                            lr_scales=lr_scales)
+        for k, mask in _MASKS.get(model_id, {}).items():
+            if k in new_p:
+                new_p[k] = new_p[k] * mask
+        return new_p, new_s
+
+    optimizer.apply_gradients = apply_gradients
+    return optimizer
+
+
+def reset(model):
+    _MASKS.pop(id(model), None)

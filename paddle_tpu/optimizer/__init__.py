@@ -2,8 +2,8 @@
 from . import lr  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .optimizer import (  # noqa: F401
-    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Lars, LarsMomentum, Momentum,
-    Optimizer, RMSProp)
+    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, DGCMomentum, Lamb, Lars,
+    LarsMomentum, Momentum, Optimizer, RMSProp)
 
 
 class L2Decay:

@@ -1,0 +1,139 @@
+"""DGC momentum + ASP n:m sparsity (reference:
+fluid DGCMomentumOptimizer / dgc_momentum_op.cc and
+fluid/contrib/sparsity/asp.py)."""
+import numpy as np
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.engine import ParallelTrainer
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.incubate import asp
+
+
+def _data(rows=16):
+    rng = np.random.RandomState(0)
+    return (rng.randn(rows, 8).astype("f4"),
+            rng.randn(rows, 4).astype("f4"))
+
+
+def _mse(o, y):
+    return jnp.mean((o - y) ** 2)
+
+
+class TestDGCMomentum:
+    def test_local_accumulation_conserves_gradient_mass(self):
+        """Unsent coordinates stay in the residual: across enough steps of
+        a CONSTANT gradient, total applied update approaches what dense
+        momentum would apply (nothing is lost, only delayed)."""
+        opt = paddle.optimizer.DGCMomentum(
+            1.0, momentum=0.0, rampup_begin_step=0, sparsity=(0.75,))
+        p = {"w": jnp.zeros((16,))}
+        g = {"w": jnp.asarray(np.linspace(0.1, 1.6, 16), jnp.float32)}
+        state = opt.init_state(p)
+        steps = 40
+        for _ in range(steps):
+            p, state = opt.apply_gradients(p, dict(g), state, lr=1.0)
+        # dense momentum(0) with lr 1 for 40 steps would apply -40*g per
+        # coordinate; with local accumulation every coordinate (even the
+        # smallest, which must accumulate ~13 steps to cross the top-k
+        # threshold) receives most of its mass — delayed, never lost
+        applied = -np.asarray(p["w"]) / np.asarray(g["w"])
+        assert applied.min() > steps * 0.5, applied
+        # residual bounded by ~the selection threshold (max |g| scale)
+        resid = np.abs(np.asarray(state["slots"]["w"]["v"]))
+        assert resid.max() <= 2.0 * float(np.max(np.asarray(g["w"])))
+
+    def test_sparsification_sends_topk_only(self):
+        opt = paddle.optimizer.DGCMomentum(
+            1.0, momentum=0.0, rampup_begin_step=0, sparsity=(0.75,))
+        p = {"w": jnp.zeros((16,))}
+        g = {"w": jnp.asarray(np.linspace(0.1, 1.6, 16), jnp.float32)}
+        state = opt.init_state(p)
+        p, state = opt.apply_gradients(p, g, state, lr=1.0)
+        moved = np.asarray(p["w"]) != 0.0
+        assert moved.sum() <= 5            # ~25% of 16 coordinates
+        assert moved[-1] and not moved[0]  # largest sent, smallest held
+
+    def test_rampup_dense_before_begin_step(self):
+        opt = paddle.optimizer.DGCMomentum(
+            1.0, momentum=0.0, rampup_begin_step=3, sparsity=(0.9,))
+        p = {"w": jnp.zeros((8,))}
+        g = {"w": jnp.ones((8,), jnp.float32)}
+        state = opt.init_state(p)
+        p, state = opt.apply_gradients(p, g, state, lr=1.0)
+        assert (np.asarray(p["w"]) != 0).all()  # step 1 <= begin: dense
+
+    def test_trains_a_model(self):
+        build_mesh({"data": 1})
+        paddle.seed(0)
+        net = nn.Linear(8, 4)
+        opt = paddle.optimizer.DGCMomentum(
+            0.05, momentum=0.9, rampup_begin_step=2, sparsity=(0.5,),
+            parameters=net.parameters())
+        tr = ParallelTrainer(net, opt, _mse)
+        x, y = _data()
+        losses = [float(tr.train_step(x, y)) for _ in range(15)]
+        assert losses[-1] < losses[0]
+
+
+class TestASP:
+    def test_mask_is_2_of_4(self):
+        w = np.random.RandomState(0).randn(8, 16).astype("f4")
+        mask = np.asarray(asp.compute_nm_mask(w))
+        groups = mask.reshape(-1, 4)
+        assert (groups.sum(axis=-1) == 2).all()
+        # kept entries are the two largest magnitudes per group
+        wg = np.abs(w.reshape(-1, 4))
+        for row_m, row_w in zip(groups, wg):
+            kept = row_w[row_m == 1]
+            dropped = row_w[row_m == 0]
+            assert kept.min() >= dropped.max() - 1e-7
+
+    def test_prune_and_check(self):
+        paddle.seed(0)
+        net = nn.Linear(8, 16)
+        asp.prune_model(net)
+        assert asp.check_sparsity(net.weight.value)
+
+    def test_sparsity_maintained_through_training(self):
+        build_mesh({"data": 1})
+        paddle.seed(1)
+        net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+        asp.prune_model(net)
+        opt = asp.decorate(
+            paddle.optimizer.Momentum(0.05, parameters=net.parameters()),
+            net)
+        tr = ParallelTrainer(net, opt, _mse)
+        x, y = _data()
+        losses = [float(tr.train_step(x, y)) for _ in range(10)]
+        assert losses[-1] < losses[0]
+        w = np.asarray(tr.state["params"]["0.weight"])
+        assert asp.check_sparsity(w)       # still 2:4 after real training
+        w2 = np.asarray(tr.state["params"]["2.weight"])
+        assert asp.check_sparsity(w2)
+
+    def test_decorate_before_prune_order(self):
+        """Reference call order (decorate THEN prune_model) must also
+        keep masks applied (masks are looked up at step time)."""
+        build_mesh({"data": 1})
+        paddle.seed(2)
+        net = nn.Linear(8, 16)
+        opt = asp.decorate(
+            paddle.optimizer.SGD(0.1, parameters=net.parameters()), net)
+        asp.prune_model(net)
+        tr = ParallelTrainer(net, opt, _mse)
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 8).astype("f4")
+        y = rng.randn(8, 16).astype("f4")
+        for _ in range(3):
+            tr.train_step(x, y)
+        assert asp.check_sparsity(np.asarray(tr.state["params"]["weight"]))
+
+    def test_custom_group_size(self):
+        paddle.seed(3)
+        net = nn.Linear(8, 6)      # last dim 6: prunable only for m=2
+        masks = asp.prune_model(net, n=1, m=2)
+        assert "weight" in masks
+        assert asp.check_sparsity(net.weight.value, n=1, m=2)
