@@ -11,3 +11,6 @@ from .embedding import DistributedEmbedding, make_lookup  # noqa: F401
 from .service import DistributedSparseTable, PsServer  # noqa: F401
 from .table import (DenseTable, GraphTable, SparseTable,  # noqa: F401
                     shard_keys)
+from .trainer import (Communicator, DownpourWorker,  # noqa: F401
+                      HogwildWorker, MultiTrainer, TrainerDesc,
+                      TrainerFactory)

@@ -221,6 +221,40 @@ class Executor:
             outs = [np.asarray(o) for o in outs]
         return list(outs)
 
+    def train_from_dataset(self, program, dataset, sparse_table,
+                           dense_table=None, thread: int = 2,
+                           batch_size: int = 128, lr: float = 0.05,
+                           worker: str = "hogwild", key_slot: str = "ids",
+                           extract=None, _eval_only: bool = False,
+                           **desc_kwargs):
+        """reference: fluid/executor.py train_from_dataset — dispatch the
+        Trainer/DeviceWorker runtime (trainer.h:57) over a Dataset. Here
+        ``program`` is the jitted step callable
+        ``(emb, dense, batch) -> (loss, emb_grad, dense_grad)`` — the dense
+        compute the reference expressed as a ProgramDesc — and the sparse
+        side is a native/RPC table (distributed/ps). ``key_slot``/``extract``
+        select which slot feeds the embedding pull. Returns the trainer's
+        stats dict (loss_mean/losses/batches/threads)."""
+        from ..distributed.ps.trainer import TrainerDesc, TrainerFactory
+        desc = TrainerDesc(worker=worker, thread_num=thread,
+                           batch_size=batch_size, lr=lr, **desc_kwargs)
+        return TrainerFactory().create(desc).train(
+            dataset, program, sparse_table, dense_table=dense_table,
+            key_slot=key_slot, extract=extract, eval_only=_eval_only)
+
+    def infer_from_dataset(self, program, dataset, sparse_table,
+                           dense_table=None, thread: int = 2,
+                           batch_size: int = 128, key_slot: str = "ids",
+                           extract=None):
+        """reference: executor.py infer_from_dataset — same worker fan-out,
+        read-only: no pushes reach the tables (even zero grads would advance
+        Adam step/moment decay) and unseen ids are not materialized."""
+        return self.train_from_dataset(program, dataset, sparse_table,
+                                       dense_table=dense_table,
+                                       thread=thread, batch_size=batch_size,
+                                       lr=0.0, key_slot=key_slot,
+                                       extract=extract, _eval_only=True)
+
     def close(self):
         pass
 

@@ -1,0 +1,205 @@
+"""C17 trainer/device-worker runtime tests: Hogwild lock-free threads,
+Downpour async communicator, trainer factory, dataset wiring.
+(reference analogues: test_trainer_desc.py, test_communicator_async.py,
+test_downpoursgd.py, dist_fleet_ctr.py.)"""
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.distributed.ps import (Communicator, DenseTable,
+                                       InMemoryDataset, MultiTrainer,
+                                       SparseTable, TrainerDesc,
+                                       TrainerFactory)
+
+DIM = 8
+VOCAB = 200
+RS = np.random.RandomState(0)
+# ground truth: each id has a latent score; label = 1 if mean score > 0
+_TRUE = RS.randn(VOCAB).astype(np.float32)
+
+
+def _make_batches(n_batches, bsz, seq=6, pad_frac=0.0, seed=1):
+    rs = np.random.RandomState(seed)
+    out = []
+    for _ in range(n_batches):
+        ids = rs.randint(0, VOCAB, (bsz, seq)).astype(np.int64)
+        labels = (_TRUE[ids].mean(axis=1) > 0).astype(np.float32)
+        if pad_frac:
+            mask = rs.rand(bsz, seq) < pad_frac
+            mask[:, 0] = False          # keep >=1 valid id per example
+            ids = np.where(mask, -1, ids)
+        out.append({"ids": ids, "label": labels})
+    return out
+
+
+@jax.jit
+def _logreg_step(emb, w, labels):
+    """loss, d/demb, d/dw of a logistic regression over mean-pooled
+    embeddings (the Wide&Deep 'deep' tower in miniature)."""
+    def f(emb, w):
+        feat = emb.mean(axis=1)
+        logit = feat @ w
+        return jnp.mean(
+            jnp.maximum(logit, 0) - logit * labels
+            + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+    loss, (ge, gw) = jax.value_and_grad(f, argnums=(0, 1))(emb, w)
+    return loss, ge, gw
+
+
+def _step_fn(emb, dense, batch):
+    loss, ge, gw = _logreg_step(jnp.asarray(emb), jnp.asarray(dense),
+                                jnp.asarray(batch["label"]))
+    return float(loss), np.asarray(ge), np.asarray(gw)
+
+
+def _train(worker, epochs=3, thread_num=3, pad_frac=0.0, lr=0.5):
+    table = SparseTable(DIM, "adagrad", seed=3, init_range=0.01)
+    dense = DenseTable(DIM, "sgd")
+    dense.set(np.zeros(DIM, np.float32))
+    desc = TrainerDesc(worker=worker, thread_num=thread_num,
+                       batch_size=32, lr=lr)
+    trainer = TrainerFactory().create(desc)
+    batches = _make_batches(12, 32, pad_frac=pad_frac)
+    stats = []
+    for _ in range(epochs):
+        stats.append(trainer.train(batches, _step_fn, table,
+                                   dense_table=dense))
+    return table, dense, trainer, stats
+
+
+class TestHogwild:
+    def test_loss_decreases_across_epochs(self):
+        _, _, _, stats = _train("hogwild")
+        assert stats[-1]["loss_mean"] < stats[0]["loss_mean"] - 0.05, \
+            [s["loss_mean"] for s in stats]
+
+    def test_all_batches_processed_across_threads(self):
+        _, _, trainer, stats = _train("hogwild", epochs=1, thread_num=4)
+        assert stats[0]["batches"] == 12
+        assert stats[0]["threads"] == 4
+        # round-robin partition: every worker got some batches
+        assert all(w.batches_done > 0 for w in trainer.workers)
+
+    def test_padding_ids_never_enter_table(self):
+        table, _, _, _ = _train("hogwild", epochs=1, pad_frac=0.3)
+        # table only materializes touched (non-negative) ids
+        assert 0 < len(table) <= VOCAB
+
+
+class TestDownpour:
+    def test_loss_decreases_and_communicator_applies_all(self):
+        _, _, trainer, stats = _train("downpour")
+        assert stats[-1]["loss_mean"] < stats[0]["loss_mean"] - 0.05
+        # one communicator per train() call; last one saw all 12 batches
+        assert trainer.communicator.pushes_applied == 12
+
+    def test_grad_merge_dedups_keys(self):
+        # adagrad distinguishes merged from sequential pushes: one g=2
+        # step gives -lr*2/sqrt(4) = -1.0; two g=1 steps give ~-1.707
+        table = SparseTable(4, "adagrad", init_range=0.0)
+        comm = Communicator(table, lr=1.0, merge_grads=True)
+        keys = np.array([7, 7, 9], np.int64)
+        grads = np.ones((3, 4), np.float32)
+        comm.send(keys, grads)
+        comm.stop()
+        out = table.pull(np.array([7, 9]))
+        np.testing.assert_allclose(out[0], -1.0 * np.ones(4), rtol=1e-4)
+        np.testing.assert_allclose(out[1], -1.0 * np.ones(4), rtol=1e-4)
+
+    def test_flush_barrier_applies_queue(self):
+        table = SparseTable(4, "sgd", init_range=0.0)
+        comm = Communicator(table, lr=1.0, send_queue_size=64)
+        for _ in range(20):
+            comm.send(np.array([1], np.int64), np.ones((1, 4), np.float32))
+        comm.flush()
+        np.testing.assert_allclose(table.pull(np.array([1]))[0],
+                                   -20.0 * np.ones(4))
+        comm.stop()
+
+
+class TestFactoryAndDataset:
+    def test_unknown_worker_raises(self):
+        with pytest.raises(ValueError, match="hogwild"):
+            TrainerFactory().create(TrainerDesc(worker="nope"))
+
+    def test_worker_error_propagates(self):
+        def bad_step(emb, dense, batch):
+            raise RuntimeError("boom")
+        t = MultiTrainer(TrainerDesc(worker="hogwild", thread_num=2))
+        with pytest.raises(RuntimeError, match="boom"):
+            t.train(_make_batches(2, 8), bad_step,
+                    SparseTable(DIM, "sgd"))
+
+    def test_train_from_inmemory_dataset(self, tmp_path):
+        # MultiSlot file -> InMemoryDataset -> trainer (the reference
+        # exe.train_from_dataset path)
+        lines = []
+        rs = np.random.RandomState(2)
+        for _ in range(64):
+            ids = rs.randint(0, VOCAB, 4)
+            label = float(_TRUE[ids].mean() > 0)
+            lines.append(
+                f"{len(ids)} " + " ".join(str(i) for i in ids)
+                + f" 1 {label}")
+        p = tmp_path / "part-0"
+        p.write_text("\n".join(lines) + "\n")
+        ds = InMemoryDataset(["ids", "label"], dense_slots=["label"])
+        ds.load_into_memory([str(p)])
+        ds.global_shuffle(seed=0)
+
+        table = SparseTable(DIM, "adagrad", seed=3, init_range=0.01)
+        dense = DenseTable(DIM, "sgd")
+        dense.set(np.zeros(DIM, np.float32))
+
+        def step(emb, dw, batch):
+            loss, ge, gw = _logreg_step(
+                jnp.asarray(emb), jnp.asarray(dw),
+                jnp.asarray(batch["label"][:, 0]))
+            return float(loss), np.asarray(ge), np.asarray(gw)
+
+        trainer = TrainerFactory().create(
+            TrainerDesc(worker="downpour", thread_num=2, batch_size=16,
+                        lr=0.5))
+        first = trainer.train(ds, step, table, dense_table=dense)
+        last = None
+        for _ in range(4):
+            last = trainer.train(ds, step, table, dense_table=dense)
+        assert last["loss_mean"] < first["loss_mean"]
+        assert len(table) > 0
+
+    def test_executor_train_and_infer_from_dataset(self):
+        from paddle_tpu import static
+        exe = static.Executor()
+        table = SparseTable(DIM, "adagrad", seed=5, init_range=0.01)
+        dense = DenseTable(DIM, "sgd")
+        dense.set(np.zeros(DIM, np.float32))
+        batches = _make_batches(8, 16, seed=4)
+        first = exe.train_from_dataset(_step_fn, batches, table,
+                                       dense_table=dense, thread=2,
+                                       lr=0.5, worker="downpour")
+        for _ in range(3):
+            stats = exe.train_from_dataset(_step_fn, batches, table,
+                                           dense_table=dense, thread=2,
+                                           lr=0.5, worker="downpour")
+        assert stats["loss_mean"] < first["loss_mean"]
+        # infer: loss reported, tables untouched — even on UNSEEN ids
+        # (eval must not materialize rows or advance optimizer state)
+        eval_batches = batches + [{
+            "ids": np.full((4, 6), VOCAB + 999, np.int64),
+            "label": np.zeros(4, np.float32)}]
+        w_before = dense.pull().copy()
+        n_before = len(table)
+        seen = np.unique(np.concatenate(
+            [b["ids"].ravel() for b in batches]))
+        rows_before = table.pull(seen, create_missing=False).copy()
+        ev = exe.infer_from_dataset(_step_fn, eval_batches, table,
+                                    dense_table=dense, thread=2)
+        assert np.isfinite(ev["loss_mean"])
+        np.testing.assert_array_equal(dense.pull(), w_before)
+        assert len(table) == n_before
+        np.testing.assert_array_equal(
+            table.pull(seen, create_missing=False), rows_before)
