@@ -5,11 +5,15 @@
 // for ctypes). Multi-host sharding happens above by node-key hash routing,
 // exactly like the sparse table (distributed/ps/service.py).
 
+#include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <limits>
 #include <mutex>
 #include <random>
 #include <unordered_map>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -47,7 +51,9 @@ class GraphTable {
       std::lock_guard<std::mutex> lk(s.mu);
       Node& node = s.nodes[src[i]];
       node.neighbors.push_back(dst[i]);
-      if (w) node.weights.push_back(w[i]);
+      // weights stay index-aligned with neighbors across mixed
+      // weighted/unweighted AddEdges calls: unweighted inserts get 1.0
+      node.weights.push_back(w ? w[i] : 1.0f);
     }
   }
 
@@ -90,7 +96,7 @@ class GraphTable {
   // slots per key, missing filled with -1; counts[i] = actual neighbors
   // written.
   void SampleNeighbors(const int64_t* keys, int64_t n, int k, uint64_t seed,
-                       int64_t* out, int64_t* counts) {
+                       int64_t* out, int64_t* counts, int weighted) {
     for (int64_t i = 0; i < n; ++i) {
       GShard& s = shards_[ShardOf(keys[i])];
       std::lock_guard<std::mutex> lk(s.mu);
@@ -105,6 +111,27 @@ class GraphTable {
       int64_t deg = static_cast<int64_t>(nb.size());
       std::mt19937_64 rng(seed_ ^ seed ^
                           (static_cast<uint64_t>(keys[i]) * 0x9e3779b9ULL));
+      if (weighted && deg > k) {
+        // Efraimidis-Spirakis weighted sampling without replacement:
+        // key_j = u_j^(1/w_j); take the k largest keys.
+        const auto& wt = it->second.weights;
+        std::uniform_real_distribution<double> uni(
+            std::numeric_limits<double>::min(), 1.0);
+        std::vector<std::pair<double, int64_t>> es(deg);
+        for (int64_t j = 0; j < deg; ++j) {
+          double w = (j < static_cast<int64_t>(wt.size()) && wt[j] > 0.0f)
+                         ? static_cast<double>(wt[j])
+                         : 1e-12;
+          es[j] = {std::pow(uni(rng), 1.0 / w), nb[j]};
+        }
+        std::nth_element(es.begin(), es.begin() + k, es.end(),
+                         [](const auto& a, const auto& b) {
+                           return a.first > b.first;
+                         });
+        for (int j = 0; j < k; ++j) dst[j] = es[j].second;
+        counts[i] = k;
+        continue;
+      }
       if (deg <= k) {
         // all neighbors (shuffled), pad with -1
         std::vector<int64_t> perm(nb);
@@ -174,9 +201,9 @@ int64_t ps_graph_degree(void* g, int64_t key) {
 
 void ps_graph_sample_neighbors(void* g, const int64_t* keys, int64_t n,
                                int k, uint64_t seed, int64_t* out,
-                               int64_t* counts) {
+                               int64_t* counts, int weighted) {
   static_cast<GraphTable*>(g)->SampleNeighbors(keys, n, k, seed, out,
-                                               counts);
+                                               counts, weighted);
 }
 
 int64_t ps_graph_num_nodes(void* g) {

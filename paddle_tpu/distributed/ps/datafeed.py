@@ -170,14 +170,20 @@ class InMemoryDataset:
 
     @staticmethod
     def _gather(s: "_Slot", idxs: np.ndarray):
-        """(offsets, values) of the examples `idxs` as a packed pair."""
-        lens = s.offsets[idxs + 1] - s.offsets[idxs]
-        vals = (np.concatenate([s.values[s.offsets[i]:s.offsets[i + 1]]
-                                for i in idxs])
-                if idxs.size else np.zeros((0,), s.values.dtype))
+        """(offsets, values) of the examples `idxs` as a packed pair.
+        Vectorized: one fancy-index instead of a per-example slice loop
+        (this runs once per destination rank per slot in global_shuffle)."""
+        starts = s.offsets[idxs]
+        lens = s.offsets[idxs + 1] - starts
         offsets = np.concatenate([np.zeros((1,), np.int64),
                                   np.cumsum(lens)])
-        return offsets, vals
+        if not idxs.size or offsets[-1] == 0:
+            return offsets, np.zeros((0,), s.values.dtype)
+        # flat source index for every value: repeat each start, then add
+        # the within-example ramp (global ramp minus repeated segment base)
+        seg_base = np.repeat(offsets[:-1], lens)
+        flat = np.repeat(starts, lens) + (np.arange(offsets[-1]) - seg_base)
+        return offsets, s.values[flat]
 
     def _example_slice(self, s: _Slot, idx: int):
         a, b = s.offsets[idx], s.offsets[idx + 1]

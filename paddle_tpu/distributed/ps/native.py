@@ -118,7 +118,7 @@ def lib() -> ctypes.CDLL:
         dll.ps_graph_degree.argtypes = [c.c_void_p, i64]
         dll.ps_graph_sample_neighbors.argtypes = [c.c_void_p, p_i64, i64,
                                                   c.c_int, c.c_uint64,
-                                                  p_i64, p_i64]
+                                                  p_i64, p_i64, c.c_int]
         dll.ps_graph_num_nodes.restype = i64
         dll.ps_graph_num_nodes.argtypes = [c.c_void_p]
 

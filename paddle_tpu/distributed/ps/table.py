@@ -176,15 +176,18 @@ class GraphTable:
     def degree(self, key: int) -> int:
         return int(self._lib.ps_graph_degree(self._h, int(key)))
 
-    def sample_neighbors(self, keys, k: int, seed: int = 0):
-        """Uniform sample without replacement: returns (neighbors
-        (N, k) with -1 padding, counts (N,))."""
+    def sample_neighbors(self, keys, k: int, seed: int = 0,
+                         weighted: bool = False):
+        """Sample without replacement: returns (neighbors (N, k) with -1
+        padding, counts (N,)). ``weighted=True`` draws edge-weight-
+        proportional (Efraimidis-Spirakis); unweighted edges count 1.0."""
         keys = _as_i64(keys).reshape(-1)
         out = np.empty((keys.size, k), dtype=np.int64)
         counts = np.empty((keys.size,), dtype=np.int64)
         self._lib.ps_graph_sample_neighbors(self._h, _ip(keys), keys.size,
                                             int(k), int(seed), _ip(out),
-                                            _ip(counts))
+                                            _ip(counts),
+                                            1 if weighted else 0)
         return out, counts
 
     def __len__(self):

@@ -61,21 +61,55 @@ def prune_model(model, n: int = 2, m: int = 4):
     return masks
 
 
-def decorate(optimizer, model):
+def prune_params(params: Dict[str, jnp.ndarray], n: int = 2, m: int = 4):
+    """Prune a name->array params mapping (e.g. ParallelTrainer.state
+    ["params"]) mid-training. Returns (new_params, masks). Combined with
+    the value-derived masking in decorate(), the new zeros stay frozen
+    from the next step on even inside an already-compiled train step."""
+    masks: Dict[str, jnp.ndarray] = {}
+    out = dict(params)
+    for name, v in params.items():
+        v = jnp.asarray(v)
+        if not (v.ndim == 2 and v.shape[-1] % m == 0
+                and name.endswith("weight")):
+            continue
+        mask = compute_nm_mask(v, n, m)
+        out[name] = v * mask
+        masks[name] = mask
+    return out, masks
+
+
+def decorate(optimizer, model, n: int = 2, m: int = 4):
     """Wrap the optimizer so every step re-applies the pruning masks
     (reference asp.py decorate: masked params stay masked through
     training — gradients may be dense, the update is re-projected).
-    Masks are looked up at step time, so the reference's documented call
-    order (decorate before prune_model) works too."""
+
+    jit-safe by construction: the mask is DERIVED from the incoming
+    parameter values inside the step (zeros of an already-n:m-sparse
+    weight stay zero), never read from Python state at trace time — so
+    the wrapper keeps working inside an already-compiled train step no
+    matter whether prune_model ran before or after the first trace.
+    A weight that is not yet n:m sparse (dense, not pruned) passes
+    through untouched. Caveat: an exactly-zero element of a weight whose
+    every m-group happens to satisfy the n:m pattern is treated as
+    pruned; float inits/updates land on 0.0 with probability ~0."""
     orig = optimizer.apply_gradients
-    model_id = id(model)
+    # which params are structurally prunable is static (names/shapes fixed
+    # at decorate time); only their VALUES are inspected per step.
+    prunable = {name for name, p in model.named_parameters()
+                if _prunable(name, p, m)}
 
     def apply_gradients(params, grads, state, lr=None, lr_scales=None):
         new_p, new_s = orig(params, grads, state, lr=lr,
                             lr_scales=lr_scales)
-        for k, mask in _MASKS.get(model_id, {}).items():
-            if k in new_p:
-                new_p[k] = new_p[k] * mask
+        for k in prunable:
+            if k not in new_p or k not in params:
+                continue
+            w = jnp.asarray(params[k])
+            groups = (w.reshape(-1, m) != 0).sum(axis=-1)
+            is_pruned = (groups <= n).all()
+            mask = (w != 0).astype(new_p[k].dtype)
+            new_p[k] = jnp.where(is_pruned, new_p[k] * mask, new_p[k])
         return new_p, new_s
 
     optimizer.apply_gradients = apply_gradients

@@ -131,6 +131,32 @@ class TestASP:
             tr.train_step(x, y)
         assert asp.check_sparsity(np.asarray(tr.state["params"]["weight"]))
 
+    def test_prune_mid_training_inside_compiled_step(self):
+        """Pruning AFTER the first (already traced+compiled) step must
+        still take effect: decorate derives masks from runtime weight
+        values, not from Python state baked in at trace time."""
+        build_mesh({"data": 1})
+        paddle.seed(4)
+        net = nn.Linear(8, 16)
+        opt = asp.decorate(
+            paddle.optimizer.SGD(0.1, parameters=net.parameters()), net)
+        tr = ParallelTrainer(net, opt, _mse)
+        rng = np.random.RandomState(1)
+        x = rng.randn(8, 8).astype("f4")
+        y = rng.randn(8, 16).astype("f4")
+        for _ in range(2):
+            tr.train_step(x, y)        # traces with DENSE weights
+        assert not asp.check_sparsity(
+            np.asarray(tr.state["params"]["weight"]))
+        tr.state["params"], masks = asp.prune_params(tr.state["params"])
+        assert "weight" in masks
+        for _ in range(3):
+            tr.train_step(x, y)        # same compiled fn, masks now bind
+        w = np.asarray(tr.state["params"]["weight"])
+        assert asp.check_sparsity(w)
+        # and it actually trained (non-masked entries moved)
+        assert np.abs(w).sum() > 0
+
     def test_custom_group_size(self):
         paddle.seed(3)
         net = nn.Linear(8, 6)      # last dim 6: prunable only for m=2

@@ -391,6 +391,34 @@ class TestGraphTable:
         np.testing.assert_array_equal(a, b)
         assert not np.array_equal(a, c)
 
+    def test_weighted_sampling_follows_edge_weights(self):
+        from paddle_tpu.distributed.ps import GraphTable
+        g = GraphTable()
+        # node 1: one heavy edge (w=50) among 19 light ones (w=1)
+        dsts = np.arange(100, 119, dtype=np.int64)
+        g.add_edges(np.full(19, 1, dtype=np.int64), dsts,
+                    np.ones(19, "f4"))
+        g.add_edges([1], [500], np.asarray([50.0], "f4"))
+        hits = 0
+        for seed in range(200):
+            nbr, cnt = g.sample_neighbors([1], k=3, seed=seed,
+                                          weighted=True)
+            assert cnt[0] == 3
+            assert len(set(nbr[0].tolist())) == 3   # without replacement
+            hits += int(500 in nbr[0])
+        # P(heavy in top-3) ~ 1 under 50:1 weights; uniform would be ~0.15
+        assert hits > 150, hits
+
+    def test_mixed_weighted_unweighted_edges_stay_aligned(self):
+        from paddle_tpu.distributed.ps import GraphTable
+        g = GraphTable()
+        g.add_edges([4, 4], [40, 41])                   # unweighted -> 1.0
+        g.add_edges([4], [42], np.asarray([100.0], "f4"))
+        hits = sum(42 in g.sample_neighbors([4], k=1, seed=s,
+                                            weighted=True)[0]
+                   for s in range(100))
+        assert hits > 80, hits                          # ~100/102 odds
+
 
 class TestGlobalShuffleCrossProcess:
     def test_examples_exchange_across_processes(self, tmp_path):
