@@ -56,7 +56,8 @@ class ParallelTrainer:
 
     def __init__(self, model, optimizer, loss_fn: Callable, mesh=None,
                  micro_batches: int = 1, remat: bool = False,
-                 zero_stage: int = 0, accumulate_steps: int = 1):
+                 zero_stage: int = 0, accumulate_steps: int = 1,
+                 fp16_allreduce: bool = False):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -64,6 +65,10 @@ class ParallelTrainer:
         self.micro_batches = micro_batches
         self.remat = remat
         self.zero_stage = zero_stage
+        # reference fleet/meta_optimizers/fp16_allreduce_optimizer.py:
+        # compress the DP grad allreduce. Here: fp32 grads cross the ICI
+        # as bf16 (half the bytes), restored to fp32 for the update.
+        self.fp16_allreduce = fp16_allreduce
         # GradientMerge (reference: fleet/meta_optimizers
         # gradient_merge_optimizer + DistributedStrategy.gradient_merge):
         # split each batch into k chunks, accumulate grads, one optimizer step
@@ -264,6 +269,13 @@ class ParallelTrainer:
             # ZeRO-3 leaves already carry the SUM over the sharding axis
             # (all_gather transpose = reduce-scatter): divide for the mean
             # and only pmean over the remaining data axes.
+            def _pmean(g, ax):
+                # fp16_allreduce: fp32 grads cross the wire as bf16
+                if self.fp16_allreduce and g.dtype == jnp.float32:
+                    return lax.pmean(g.astype(jnp.bfloat16),
+                                     ax).astype(jnp.float32)
+                return lax.pmean(g, ax)
+
             for k in grads:
                 if k in zero3_dims:
                     if pp_grads is not None:
@@ -277,7 +289,7 @@ class ParallelTrainer:
                         grads[k] = grads[k] / n_shard
                     for ax in ("data", "sep"):
                         if ax in reduce_axes and mesh.shape.get(ax, 1) > 1:
-                            grads[k] = lax.pmean(grads[k], ax)
+                            grads[k] = _pmean(grads[k], ax)
                 elif k in zero2_dims:
                     # reduce-scatter (mean) over sharding; pmean over data
                     grads[k] = lax.psum_scatter(
@@ -286,11 +298,11 @@ class ParallelTrainer:
                         tiled=True) / n_shard
                     for ax in ("data", "sep"):
                         if ax in reduce_axes and mesh.shape.get(ax, 1) > 1:
-                            grads[k] = lax.pmean(grads[k], ax)
+                            grads[k] = _pmean(grads[k], ax)
                 else:
                     for ax in reduce_axes:
                         if mesh.shape.get(ax, 1) > 1:
-                            grads[k] = lax.pmean(grads[k], ax)
+                            grads[k] = _pmean(grads[k], ax)
                 if k in pipe_psum_keys:
                     grads[k] = lax.psum(grads[k], "pipe")
             return loss, grads

@@ -54,6 +54,10 @@ class DistributedStrategy:
         self.dgc = False
         self.localsgd = False
         self.find_unused_parameters = False
+        # reference meta_optimizers/fp16_allreduce_optimizer.py: compress
+        # grads for the allreduce. TPU form: cast fp32 grads to bf16 for
+        # the pmean collectives (halves ICI bytes), accumulate back in fp32.
+        self.fp16_allreduce = False
         self.fuse_all_reduce_ops = True
         self.fuse_grad_size_in_MB = 32
         self.nccl_comm_num = 1

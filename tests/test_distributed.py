@@ -199,6 +199,34 @@ class TestEngine:
             l8 = float(tr8.train_step(x, y))
         np.testing.assert_allclose(l1, l8, rtol=1e-4)
 
+    def test_fp16_allreduce_tracks_fp32(self):
+        """fp16_allreduce (reference fp16_allreduce_optimizer.py): grads
+        cross the DP pmean as bf16. Trajectory must track the fp32
+        allreduce closely — same data on every replica makes the pmean a
+        near-identity, so divergence can only come from the bf16
+        round-trip (~1e-2)."""
+        from paddle_tpu.distributed.engine import ParallelTrainer
+        x, y = self._data()
+        loss_fn = lambda o, l: nn.functional.cross_entropy(o, l)  # noqa: E731
+        make_mesh(data=8)
+        net_a = self._net()
+        tr_a = ParallelTrainer(net_a, paddle.optimizer.SGD(
+            0.1, parameters=net_a.parameters()), loss_fn)
+        paddle.seed(0)
+        net_b = self._net()
+        net_b.set_state_dict(net_a.state_dict())
+        tr_b = ParallelTrainer(net_b, paddle.optimizer.SGD(
+            0.1, parameters=net_b.parameters()), loss_fn,
+            fp16_allreduce=True)
+        la = lb = first_b = None
+        for i in range(8):
+            la = float(tr_a.train_step(x, y))
+            lb = float(tr_b.train_step(x, y))
+            if i == 0:
+                first_b = lb
+        assert abs(la - lb) < 2e-2, (la, lb)
+        assert lb < first_b  # it actually trained
+
     def test_zero_sharding_specs(self):
         from paddle_tpu.distributed.meta_parallel.sharding_parallel import (
             shard_spec_for)
