@@ -164,13 +164,40 @@ class Model:
         self._eval_step_fn = jax.jit(eval_step)
         self._pred_step_fn = jax.jit(pred_step)
 
+    # -- data parallelism over the active mesh ------------------------------
+    # reference hapi runs DataParallel when launched under
+    # distributed.launch (hapi/model.py _parallel context). TPU idiom:
+    # if a mesh with a data axis > 1 is active, batches are sharded over
+    # "data" and params replicated; GSPMD inserts the grad allreduce
+    # (the global-batch mean-loss makes jit's grads the DP average).
+    def _dp_mesh(self):
+        from ..distributed.mesh import get_mesh
+        mesh = get_mesh()
+        if mesh is not None and mesh.shape.get("data", 1) > 1:
+            return mesh
+        return None
+
+    def _shard_batch(self, data):
+        mesh = self._dp_mesh()
+        if mesh is None:
+            return data
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        out = []
+        n = mesh.shape["data"]
+        for d in data:
+            if getattr(d, "ndim", 0) >= 1 and d.shape[0] % n == 0:
+                out.append(jax.device_put(d, NamedSharding(mesh, P("data"))))
+            else:   # indivisible or scalar: replicate
+                out.append(jax.device_put(d, NamedSharding(mesh, P())))
+        return tuple(out)
+
     # -- batch-level API ---------------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
         if self._train_step_fn is None:
             self._build_steps()
         st = self._device_state()
-        data = self._pack(inputs, labels)
+        data = self._shard_batch(self._pack(inputs, labels))
         key = get_rng_key()
         trainable = tuple(sorted((k, v) for k, v in st["trainable"].items()))
         lr = self._optimizer.get_lr()
@@ -191,7 +218,7 @@ class Model:
         if self._eval_step_fn is None:
             self._build_steps()
         st = self._device_state()
-        data = self._pack(inputs, labels)
+        data = self._shard_batch(self._pack(inputs, labels))
         loss, metric_outs = self._eval_step_fn(st["params"], st["buffers"], *data)
         metrics = []
         for m, mo in zip(self._metrics, metric_outs):
@@ -204,7 +231,7 @@ class Model:
             self._build_steps()
         st = self._device_state()
         inputs = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
-        inputs = tuple(jnp.asarray(i) for i in inputs)
+        inputs = self._shard_batch(tuple(jnp.asarray(i) for i in inputs))
         out = self._pred_step_fn(st["params"], st["buffers"], *inputs)
         return out
 

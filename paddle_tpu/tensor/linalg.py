@@ -47,6 +47,10 @@ def inverse(x, name=None):
     return jnp.linalg.inv(x)
 
 
+# paddle.linalg.inv spelling (reference python/paddle/linalg.py)
+inv = inverse
+
+
 def pinv(x, rcond=1e-15, hermitian=False, name=None):
     return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
 

@@ -34,6 +34,33 @@ def test_lenet_fit_learns():
     assert res["acc"] > 0.8, res
 
 
+def test_fit_data_parallel_matches_single_device():
+    """Model.fit under an active data>1 mesh shards batches over "data"
+    (the hapi DataParallel analogue); trajectory must match single-device
+    (same global batch, GSPMD averages the grads)."""
+    from paddle_tpu.distributed.mesh import build_mesh
+
+    def run(data_degree):
+        build_mesh({"data": data_degree})
+        paddle.seed(7)
+        net = nn.Sequential(nn.Flatten(), nn.Linear(16, 32), nn.ReLU(),
+                            nn.Linear(32, 4))
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+            nn.CrossEntropyLoss())
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 16).astype("float32")
+        y = (x.sum(1) > 0).astype("int64") * 3
+        losses = [model.train_batch([x], [y])[0] for _ in range(5)]
+        return losses
+
+    single = run(1)
+    dp8 = run(8)
+    np.testing.assert_allclose(single, dp8, rtol=2e-4)
+    assert dp8[-1] < dp8[0]
+
+
 def test_model_save_load(tmp_path):
     net = LeNet()
     model = paddle.Model(net)
