@@ -122,14 +122,76 @@ class Fleet:
         return ",".join(eps) if to_string else eps
 
     def server_num(self):
-        return 0
+        import os
+        eps = os.environ.get("PADDLE_PSERVER_ENDPOINTS", "")
+        return len([e for e in eps.split(",") if e.strip()])
 
     def is_server(self):
-        return False
+        import os
+        return os.environ.get("TRAINING_ROLE", "").upper() == "PSERVER"
 
     def barrier_worker(self):
         from ..collective import barrier
         barrier()
+
+    # -- parameter-server lifecycle (reference fleet_base.py:533-632) ------
+    # The PS tier this drives is distributed/ps: native sparse tables
+    # behind the csrc/ps TCP RPC service, key-hash-routed clients, and
+    # the Hogwild/Downpour trainer runtime.
+    def init_server(self, dim: int = None, optimizer: str = "adagrad",
+                    port: int = None, **table_kwargs):
+        """Start this rank's PS shard (reference init_server + the brpc
+        server setup). The listening port comes from this rank's entry in
+        PADDLE_PSERVER_ENDPOINTS unless given. Returns the PsServer (its
+        ``.table`` is checkpointable)."""
+        import os
+        import threading
+        from ..ps.service import PsServer
+        if dim is None:
+            raise ValueError("init_server needs the embedding dim "
+                             "(the PS table schema)")
+        if port is None:
+            eps = [e for e in os.environ.get(
+                "PADDLE_PSERVER_ENDPOINTS", "").split(",") if e.strip()]
+            idx = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+            port = int(eps[idx].rsplit(":", 1)[1]) if idx < len(eps) else 0
+        self._ps_server = PsServer(dim, optimizer, port=port, **table_kwargs)
+        self._ps_stop = threading.Event()
+        return self._ps_server
+
+    def run_server(self):
+        """Serve until stop_server() (reference run_server blocks in brpc).
+        The RPC threads run in the background; this parks the main
+        thread."""
+        if getattr(self, "_ps_server", None) is None:
+            raise RuntimeError("call fleet.init_server(...) first")
+        self._ps_stop.wait()
+        self._ps_server.stop()
+
+    def stop_server(self):
+        if getattr(self, "_ps_stop", None) is not None:
+            self._ps_stop.set()
+
+    def init_worker(self, async_mode: bool = False):
+        """Connect this trainer to all PS shards (reference init_worker:
+        brpc client + communicator). Returns the key-hash-routed
+        DistributedSparseTable; async_mode enables geo-style buffered
+        pushes."""
+        import os
+        from ..ps.service import DistributedSparseTable
+        eps = [e for e in os.environ.get(
+            "PADDLE_PSERVER_ENDPOINTS", "").split(",") if e.strip()]
+        if not eps:
+            raise RuntimeError("PADDLE_PSERVER_ENDPOINTS is empty — "
+                               "no parameter servers to connect to")
+        self._ps_client = DistributedSparseTable(eps, async_mode=async_mode)
+        return self._ps_client
+
+    def stop_worker(self):
+        if getattr(self, "_ps_client", None) is not None:
+            self._ps_client.flush()
+            self._ps_client.close()
+            self._ps_client = None
 
     # -- model/optimizer wrapping -----------------------------------------
     def distributed_model(self, model):
@@ -175,6 +237,11 @@ worker_endpoints = fleet.worker_endpoints
 server_num = fleet.server_num
 is_server = fleet.is_server
 barrier_worker = fleet.barrier_worker
+init_server = fleet.init_server
+run_server = fleet.run_server
+stop_server = fleet.stop_server
+init_worker = fleet.init_worker
+stop_worker = fleet.stop_worker
 distributed_model = fleet.distributed_model
 distributed_optimizer = fleet.distributed_optimizer
 get_hybrid_communicate_group = fleet.get_hybrid_communicate_group

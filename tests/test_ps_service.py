@@ -228,3 +228,47 @@ def test_cross_process_wide_deep_sharded_ps(tmp_path):
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
         assert "wide&deep ok" in out
+
+
+class TestFleetPsLifecycle:
+    """fleet.init_server/run_server/init_worker/stop_worker (reference
+    fleet_base.py:533-632) over the native RPC PS tier."""
+
+    def test_server_worker_roundtrip(self, monkeypatch):
+        import threading
+        import numpy as np
+        from paddle_tpu.distributed import fleet as fleet_mod
+        f = fleet_mod.Fleet()
+
+        srv = f.init_server(dim=8, optimizer="sgd", port=0, init_range=0.0)
+        monkeypatch.setenv("PADDLE_PSERVER_ENDPOINTS", srv.endpoint)
+        monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+        assert f.server_num() == 1
+        assert not f.is_server()
+        monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+        assert f.is_server()
+
+        # run_server parks until stop_server
+        t = threading.Thread(target=f.run_server, daemon=True)
+        t.start()
+
+        client = f.init_worker()
+        keys = np.asarray([3, 9], np.int64)
+        emb = client.pull(keys)
+        assert emb.shape == (2, 8)
+        client.push(keys, np.ones((2, 8), np.float32), lr=1.0)
+        np.testing.assert_allclose(client.pull(keys),
+                                   -1.0 * np.ones((2, 8)), rtol=1e-6)
+        f.stop_worker()
+        assert f._ps_client is None
+
+        f.stop_server()
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+    def test_init_worker_without_endpoints_raises(self, monkeypatch):
+        import pytest as _pytest
+        from paddle_tpu.distributed import fleet as fleet_mod
+        monkeypatch.delenv("PADDLE_PSERVER_ENDPOINTS", raising=False)
+        with _pytest.raises(RuntimeError, match="ENDPOINTS"):
+            fleet_mod.Fleet().init_worker()
