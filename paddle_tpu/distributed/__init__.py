@@ -6,8 +6,11 @@ see collective.py; topology/fleet in fleet/; parallel layers in meta_parallel/.
 from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env  # noqa: F401
 from .collective import (  # noqa: F401
     all_gather, all_reduce, alltoall, barrier, broadcast, get_group,
-    new_group, recv, reduce, reduce_scatter, scatter, send, split_group,
-    ReduceOp)
+    new_group, recv, reduce, reduce_scatter, scatter, send, split,
+    split_group, wait, ReduceOp)
+from .entry import (CountFilterEntry, EntryAttr,  # noqa: F401
+                    ProbabilityEntry)
+from .ps.datafeed import InMemoryDataset, QueueDataset  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
 from .spawn import spawn  # noqa: F401

@@ -224,14 +224,48 @@ def barrier(group=None):
         pass
 
 
-def split(x, num_partitions, axis=0, group=None):
-    """Shard-and-keep-local split (reference: collective.py:1283 split)."""
-    ax = _axis(group)
-    if not in_traced_axis(ax):
-        return x
-    idx = lax.axis_index(ax)
-    size = x.shape[axis] // num_partitions
-    return lax.dynamic_slice_in_dim(x, idx * size, size, axis=axis)
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel op builder (reference: collective.py:1283 split) —
+    build the weight-sharded layer for ``operation`` and apply it to x:
+    embedding (vocab split), linear axis=0 (row parallel, in_features
+    split), linear axis=1 (column parallel, out_features split).
+
+    TPU note: sharding comes from the layer's pspec over the "model" mesh
+    axis, not from num_partitions (which must match the mesh degree when
+    given). Call once at graph-build time (e.g. under static.Program.trace
+    or a Layer __init__), like the reference's static-graph usage — the
+    parallel layer's parameters are created here."""
+    from .mesh import get_mesh
+    from .meta_parallel.parallel_layers import mp_layers
+    mesh = get_mesh()
+    model_deg = mesh.shape.get("model", 1) if mesh is not None else 1
+    if num_partitions not in (1, model_deg):
+        raise ValueError(
+            f"num_partitions={num_partitions} does not match the mesh "
+            f"'model' degree {model_deg}")
+    if operation == "embedding":
+        if axis != 0:
+            raise ValueError("parallel embedding only splits axis 0 (vocab)")
+        layer = mp_layers.VocabParallelEmbedding(
+            size[0], size[1], weight_attr=weight_attr, name=name)
+        return layer(x)
+    if operation == "linear":
+        if axis == 0:
+            layer = mp_layers.RowParallelLinear(
+                size[0], size[1], weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                input_is_parallel=False, name=name)
+            return layer(x)
+        if axis == 1:
+            layer = mp_layers.ColumnParallelLinear(
+                size[0], size[1], weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                gather_output=gather_out, name=name)
+            return layer(x)
+        raise ValueError("linear split axis must be 0 (row) or 1 (column)")
+    raise ValueError(f"unsupported operation {operation!r} "
+                     "(expected 'linear' or 'embedding')")
 
 
 def wait(tensor, group=None, use_calc_stream=True):

@@ -83,7 +83,7 @@ class DistributedEmbedding(Layer):
 
     def __init__(self, dim: int, optimizer: str = "adagrad", lr: float = 0.05,
                  seed: int = 0, init_range: float = 0.01, pooling=None,
-                 table=None):
+                 table=None, entry=None):
         super().__init__()
         from ...nn.initializer import Constant
         self.dim = dim
@@ -95,6 +95,11 @@ class DistributedEmbedding(Layer):
         self.table = table if table is not None else SparseTable(
             dim, optimizer=optimizer, seed=seed, init_range=init_range)
         assert self.table.dim == dim
+        if entry is not None:
+            # feature-admission gate (reference static.nn.sparse_embedding
+            # entry=ProbabilityEntry/CountFilterEntry)
+            from ..entry import _AdmissionTable
+            self.table = _AdmissionTable(self.table, entry)
         self.grad_hook = self.create_parameter((), initializer=Constant(0.0))
         self._lookup = make_lookup(self.table)
 

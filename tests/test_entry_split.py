@@ -1,0 +1,106 @@
+"""paddle.distributed API tail: entry admission policies
+(ProbabilityEntry/CountFilterEntry, reference entry_attr.py), the
+model-parallel split builder (reference collective.py:1283), and wait.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.entry import _AdmissionTable
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.distributed.ps import DistributedEmbedding, SparseTable
+
+
+class TestEntryAttr:
+    def test_probability_validation(self):
+        with pytest.raises(ValueError):
+            dist.ProbabilityEntry(1)          # int, not float
+        with pytest.raises(ValueError):
+            dist.ProbabilityEntry(0.0)
+        with pytest.raises(ValueError):
+            dist.ProbabilityEntry(1.5)
+        assert dist.ProbabilityEntry(0.25)._to_attr() == \
+            "probability_entry:0.25"
+
+    def test_count_filter_validation(self):
+        with pytest.raises(ValueError):
+            dist.CountFilterEntry(-1)
+        with pytest.raises(ValueError):
+            dist.CountFilterEntry(0.5)
+        assert dist.CountFilterEntry(3)._to_attr() == "count_filter_entry:3"
+
+    def test_probability_deterministic_and_rate(self):
+        e = dist.ProbabilityEntry(0.3)
+        keys = np.arange(10000, dtype=np.int64)
+        a = e.accumulate_and_admit(keys)
+        b = e.accumulate_and_admit(keys)
+        np.testing.assert_array_equal(a, b)          # stable per key
+        assert 0.25 < a.mean() < 0.35                # ~p admission rate
+
+    def test_count_filter_admits_after_n(self):
+        e = dist.CountFilterEntry(3)
+        k = np.asarray([7], np.int64)
+        assert not e.accumulate_and_admit(k)[0]      # seen 1x
+        assert not e.accumulate_and_admit(k)[0]      # seen 2x
+        assert e.accumulate_and_admit(k)[0]          # seen 3x -> in
+        # duplicates within one batch count individually
+        e2 = dist.CountFilterEntry(3)
+        assert e2.accumulate_and_admit(
+            np.asarray([9, 9, 9], np.int64)).all()
+
+    def test_admission_table_gates_create_and_push(self):
+        t = SparseTable(4, "sgd", init_range=0.0)
+        at = _AdmissionTable(t, dist.CountFilterEntry(2))
+        k = np.asarray([5], np.int64)
+        out = at.pull(k)                 # 1st sight: zeros, no row
+        np.testing.assert_array_equal(out, np.zeros((1, 4)))
+        assert len(t) == 0
+        at.push(k, np.ones((1, 4), np.float32), lr=1.0)   # dropped
+        assert len(t) == 0
+        at.pull(k)                       # 2nd sight: admitted, row created
+        assert len(t) == 1
+        at.push(k, np.ones((1, 4), np.float32), lr=1.0)   # applied
+        np.testing.assert_allclose(t.pull(k)[0], -1.0 * np.ones(4))
+
+    def test_distributed_embedding_with_entry_trains_admitted_only(self):
+        build_mesh({"data": 1})
+        paddle.seed(0)
+        emb = DistributedEmbedding(4, "sgd", lr=1.0, init_range=0.0,
+                                   entry=dist.CountFilterEntry(2))
+        ids = np.asarray([[11, 12]], np.int64)
+        out1 = np.asarray(emb(ids))
+        np.testing.assert_array_equal(out1, np.zeros((1, 2, 4)))
+        assert len(emb.table) == 0       # nothing admitted yet
+        np.asarray(emb(ids))             # 2nd occurrence -> admitted
+        assert len(emb.table) == 2
+
+
+class TestSplitAndWait:
+    def test_split_linear_shapes_and_errors(self):
+        build_mesh({"data": 1})
+        paddle.seed(1)
+        x = np.random.RandomState(0).randn(4, 8).astype("float32")
+        col = dist.split(x, (8, 12), operation="linear", axis=1,
+                         gather_out=True)
+        assert col.shape == (4, 12)
+        row = dist.split(x, (8, 12), operation="linear", axis=0)
+        assert row.shape == (4, 12)
+        ids = np.asarray([[1, 2, 3]], np.int64)
+        e = dist.split(ids, (16, 6), operation="embedding")
+        assert e.shape == (1, 3, 6)
+        with pytest.raises(ValueError, match="axis"):
+            dist.split(x, (8, 12), operation="linear", axis=2)
+        with pytest.raises(ValueError, match="operation"):
+            dist.split(x, (8, 12), operation="conv")
+        with pytest.raises(ValueError, match="num_partitions"):
+            dist.split(x, (8, 12), operation="linear", axis=1,
+                       num_partitions=4)
+
+    def test_wait_passthrough(self):
+        x = np.ones((3,))
+        assert dist.wait(x) is x or np.array_equal(dist.wait(x), x)
+
+    def test_datasets_reexported(self):
+        assert dist.InMemoryDataset is not None
+        assert dist.QueueDataset is not None
