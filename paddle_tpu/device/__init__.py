@@ -13,7 +13,8 @@ import jax
 
 from ..framework import (  # noqa: F401
     get_device, is_compiled_with_cuda, is_compiled_with_npu,
-    is_compiled_with_tpu, is_compiled_with_xpu, set_device)
+    is_compiled_with_rocm, is_compiled_with_tpu, is_compiled_with_xpu,
+    set_device)
 
 
 class Place:

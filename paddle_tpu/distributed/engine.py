@@ -171,7 +171,11 @@ class ParallelTrainer:
                     lambda v: pspec if jnp.shape(v) == pshape else P(), st)
         specs = {kk: jax.tree_util.tree_map(lambda v: P(), vv)
                  for kk, vv in opt_state.items() if kk != "slots"}
-        specs["slots"] = slot_specs
+        if "slots" in opt_state:
+            # wrapper optimizers (LookAhead/ModelAverage) nest the real
+            # slots deeper; their whole state replicates (no ZeRO slot
+            # sharding through wrappers)
+            specs["slots"] = slot_specs
         return specs
 
     # -- step construction ---------------------------------------------------

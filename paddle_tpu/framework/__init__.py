@@ -41,6 +41,10 @@ def is_compiled_with_xpu() -> bool:
     return False
 
 
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
 def is_compiled_with_npu() -> bool:
     return False
 

@@ -3,3 +3,5 @@ python/paddle/incubate/)."""
 from .moe import ExpertFFN, MoELayer, top2_gating  # noqa: F401
 from . import asp  # noqa: F401,E402
 from . import checkpoint  # noqa: F401,E402
+from .operators import softmax_mask_fuse_upper_triangle  # noqa: F401,E402
+from .optimizer import LookAhead, ModelAverage  # noqa: F401,E402

@@ -1,0 +1,30 @@
+"""Incubate fused operators (reference: python/paddle/incubate/operators/
+softmax_mask_fuse_upper_triangle.py — CUDA-fused causal-masked softmax
+for transformer attention scores).
+
+TPU translation: expressed as mask+softmax in one jit scope — XLA fuses
+the mask into the softmax's elementwise pipeline, and the flash-attention
+path (ops/pallas) covers the memory-bound fused case end-to-end.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["softmax_mask_fuse_upper_triangle"]
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Softmax over the last axis with the strict upper triangle masked
+    out (causal attention scores). x: (batch, heads, S_q, S_k)."""
+    x = jnp.asarray(x)
+    s_q, s_k = x.shape[-2], x.shape[-1]
+    causal = jnp.tril(jnp.ones((s_q, s_k), bool))
+    neg = jnp.asarray(jnp.finfo(
+        x.dtype if jnp.issubdtype(x.dtype, jnp.floating)
+        else jnp.float32).min, x.dtype)
+    masked = jnp.where(causal, x, neg)
+    out = jnp.exp(masked - jnp.max(masked, axis=-1, keepdims=True))
+    out = out / jnp.sum(out, axis=-1, keepdims=True)
+    # exact zeros on masked positions (softmax of -inf-like values can
+    # leave denormals in low precision)
+    return jnp.where(causal, out, jnp.zeros((), out.dtype))

@@ -1,0 +1,155 @@
+"""Incubate optimizers (reference: python/paddle/incubate/optimizer/
+lookahead.py LookAhead, modelaverage.py ModelAverage).
+
+Both are pure functional wrappers here: state lives in the optimizer
+state pytree so they compose with jit / ParallelTrainer like every other
+optimizer (no Python-side step counters inside traced code).
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """k-step lookahead (reference lookahead.py:28): the inner optimizer
+    advances "fast" weights every step; every ``k`` steps the "slow"
+    weights move ``alpha`` of the way toward the fast ones and the fast
+    weights are reset onto them."""
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5,
+                 name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if not (isinstance(k, int) and k >= 1):
+            raise ValueError(f"k must be a positive integer, got {k}")
+        super().__init__(learning_rate=inner_optimizer._lr,
+                         parameters=inner_optimizer._parameter_list)
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def set_lr(self, value):
+        self.inner_optimizer.set_lr(value)
+
+    def init_state(self, params: Dict[str, jax.Array]):
+        return {"inner": self.inner_optimizer.init_state(params),
+                "slow": {n: v.astype(jnp.float32)
+                         for n, v in params.items()},
+                "la_step": jnp.zeros((), jnp.int32)}
+
+    def apply_gradients(self, params, grads, state, lr=None,
+                        lr_scales: Optional[Dict[str, float]] = None):
+        fast, inner_state = self.inner_optimizer.apply_gradients(
+            params, grads, state["inner"], lr=lr, lr_scales=lr_scales)
+        step = state["la_step"] + 1
+        sync = (step % self.k) == 0
+        out, slow = {}, {}
+        for n in fast:
+            f32 = fast[n].astype(jnp.float32)
+            s = state["slow"][n]
+            merged = s + self.alpha * (f32 - s)
+            slow[n] = jnp.where(sync, merged, s)
+            out[n] = jnp.where(sync, merged, f32).astype(fast[n].dtype)
+        return out, {"inner": inner_state, "slow": slow, "la_step": step}
+
+
+class ModelAverage(Optimizer):
+    """Sliding-window parameter averaging (reference modelaverage.py:30):
+    each step accumulates the post-update parameters; ``apply()`` swaps
+    the window average into the model for evaluation, ``restore()`` swaps
+    the live weights back.
+
+    The reference's 3-bucket scheme (sum_1/sum_2/sum_3 with
+    shift-on-window-full) is kept so old parameters age out once the
+    window (clip(num_updates*rate, min, max)) fills.
+    """
+
+    def __init__(self, average_window_rate: float, parameters=None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000000, name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters)
+        self.rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self._backup = None
+
+    def init_state(self, params: Dict[str, jax.Array]):
+        z = {n: jnp.zeros(v.shape, jnp.float32) for n, v in params.items()}
+        return {"sum_1": z,
+                "sum_2": {n: jnp.zeros_like(v) for n, v in z.items()},
+                "sum_3": {n: jnp.zeros_like(v) for n, v in z.items()},
+                "num_1": jnp.zeros((), jnp.int32),
+                "num_2": jnp.zeros((), jnp.int32),
+                "num_3": jnp.zeros((), jnp.int32),
+                "num_updates": jnp.zeros((), jnp.int32)}
+
+    def apply_gradients(self, params, grads, state, lr=None, lr_scales=None):
+        """Accumulate ``params`` (gradients are ignored — run this AFTER
+        the main optimizer's step, like the reference's separate
+        ModelAverage.step())."""
+        num_updates = state["num_updates"] + 1
+        window = jnp.clip((num_updates * self.rate).astype(jnp.int32),
+                          self.min_window, self.max_window)
+        num_1 = state["num_1"] + 1
+        full = num_1 >= window
+        new = {
+            "num_updates": num_updates,
+            "num_3": jnp.where(full, state["num_2"], state["num_3"]),
+            "num_2": jnp.where(full, num_1, state["num_2"]),
+            "num_1": jnp.where(full, 0, num_1),
+        }
+        s1, s2, s3 = {}, {}, {}
+        for n, p in params.items():
+            acc = state["sum_1"][n] + p.astype(jnp.float32)
+            s3[n] = jnp.where(full, state["sum_2"][n], state["sum_3"][n])
+            s2[n] = jnp.where(full, acc, state["sum_2"][n])
+            s1[n] = jnp.where(full, jnp.zeros_like(acc), acc)
+        new.update(sum_1=s1, sum_2=s2, sum_3=s3)
+        return dict(params), new
+
+    def _average(self, state):
+        total = (state["num_1"] + state["num_2"] + state["num_3"]) \
+            .astype(jnp.float32)
+        total = jnp.maximum(total, 1.0)
+        return {n: (state["sum_1"][n] + state["sum_2"][n]
+                    + state["sum_3"][n]) / total
+                for n in state["sum_1"]}
+
+    # -- eager apply/restore (reference modelaverage.py apply:222) --------
+    def step(self):
+        """Accumulate the CURRENT parameter values into the window."""
+        self._ensure_eager_state()
+        params = {p.name: p.value for p in self._parameter_list}
+        zero = {k: None for k in params}
+        _, self._eager_state = self.apply_gradients(
+            params, zero, self._eager_state)
+
+    @contextmanager
+    def apply(self, executor=None, need_restore: bool = True):
+        self._ensure_eager_state()
+        avg = self._average(self._eager_state)
+        self._backup = [p.value for p in self._parameter_list]
+        for p in self._parameter_list:
+            p.value = avg[p.name].astype(p.value.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, v in zip(self._parameter_list, self._backup):
+                p.value = v
+            self._backup = None

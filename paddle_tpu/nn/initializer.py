@@ -130,6 +130,50 @@ class Assign(Initializer):
         return v
 
 
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel init for transposed-conv upsampling
+    weights (reference: fluid/initializer.py BilinearInitializer — used so
+    conv2d_transpose starts as exact bilinear upsampling). Expects a 4-D
+    (C_out, C_in, H, W) weight; each spatial kernel gets the separable
+    triangle filter centered per the upsampling factor."""
+
+    def __call__(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError(
+                f"Bilinear initializer needs a 4-D conv weight, got {shape}")
+        h, w = shape[2], shape[3]
+        f_h, f_w = (h + 1) // 2, (w + 1) // 2
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        ys = (1 - abs(np.arange(h) / f_h - c_h))[:, None]
+        xs = (1 - abs(np.arange(w) / f_w - c_w))[None, :]
+        kern = (ys * xs).astype(np.float32)
+        out = np.zeros(shape, np.float32)
+        out[:, :] = kern
+        return jnp.asarray(out, dtype=dtype)
+
+
+# global default initializers (reference: nn/initializer/__init__.py
+# set_global_initializer) — consulted by Layer.create_parameter when
+# neither attr nor initializer specifies one
+_global_init = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Set (or clear, with None) the process-wide default weight/bias
+    initializers (reference initializer.py:1000 set_global_initializer)."""
+    if weight_init is not None and not isinstance(weight_init, Initializer):
+        raise TypeError("weight_init must be an Initializer or None")
+    if bias_init is not None and not isinstance(bias_init, Initializer):
+        raise TypeError("bias_init must be an Initializer or None")
+    _global_init["weight"] = weight_init
+    _global_init["bias"] = bias_init
+
+
+def _global_default(is_bias: bool):
+    return _global_init["bias" if is_bias else "weight"]
+
+
 class ParamAttr:
     """Parameter attribute bundle (reference: python/paddle/fluid/param_attr.py)."""
 

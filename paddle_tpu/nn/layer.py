@@ -113,9 +113,17 @@ class Layer:
     def create_parameter(self, shape, dtype=None, initializer=None,
                          is_bias: bool = False, attr=None, trainable: bool = True,
                          name: Optional[str] = None) -> Parameter:
-        from .initializer import Constant, XavierUniform, _to_initializer
+        from .initializer import (Constant, XavierUniform, _global_default,
+                                  _to_initializer)
         dt = dtype_mod.convert_dtype_to_jax(dtype) or self._dtype
-        init = _to_initializer(attr, initializer)
+        # precedence (reference set_global_initializer semantics): an
+        # attr-specified initializer wins; otherwise the global default
+        # overrides the layer's own default passed via `initializer`.
+        init = _to_initializer(attr, None)
+        if init is None:
+            init = _global_default(is_bias)
+        if init is None:
+            init = initializer
         if init is None:
             init = Constant(0.0) if is_bias else XavierUniform()
         value = init(shape, dt)

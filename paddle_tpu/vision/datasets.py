@@ -150,3 +150,172 @@ class Cifar100(Cifar10):
         data_file = data_file or os.path.join(DATA_HOME, "cifar",
                                               "cifar-100-python.tar.gz")
         super().__init__(data_file, mode, transform, download, backend)
+
+
+_IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                   ".tiff", ".webp")
+
+
+def _pil_loader(path):
+    from PIL import Image
+    with open(path, "rb") as f:
+        return Image.open(f).convert("RGB")
+
+
+class DatasetFolder(Dataset):
+    """Class-per-subdirectory image dataset (reference:
+    python/paddle/vision/datasets/folder.py DatasetFolder): root/cls_x/a.jpg
+    -> (sample, class_index). Samples sorted for determinism."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _pil_loader
+        if extensions is None and is_valid_file is None:
+            extensions = _IMG_EXTENSIONS
+        if extensions is not None and is_valid_file is not None:
+            raise ValueError(
+                "pass either extensions or is_valid_file, not both")
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise FileNotFoundError(f"no class folders under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        valid = (is_valid_file if is_valid_file is not None
+                 else (lambda p: p.lower().endswith(tuple(extensions))))
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fn in sorted(files):
+                    p = os.path.join(dirpath, fn)
+                    if valid(p):
+                        self.samples.append((p, self.class_to_idx[c]))
+        if not self.samples:
+            raise FileNotFoundError(f"no valid files under {root}")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat (label-free) image dataset (reference folder.py ImageFolder):
+    every valid file under root, recursively, sorted."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _pil_loader
+        if extensions is None and is_valid_file is None:
+            extensions = _IMG_EXTENSIONS
+        valid = (is_valid_file if is_valid_file is not None
+                 else (lambda p: p.lower().endswith(tuple(extensions))))
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                p = os.path.join(dirpath, fn)
+                if valid(p):
+                    self.samples.append(p)
+        if not self.samples:
+            raise FileNotFoundError(f"no valid files under {root}")
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference vision/datasets/flowers.py). Reads the
+    local 102flowers.tgz + imagelabels.mat + setid.mat (no egress)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        import scipy.io as sio
+        base = os.path.join(DATA_HOME, "flowers")
+        data_file = data_file or os.path.join(base, "102flowers.tgz")
+        label_file = label_file or os.path.join(base, "imagelabels.mat")
+        setid_file = setid_file or os.path.join(base, "setid.mat")
+        for f in (data_file, label_file, setid_file):
+            if not os.path.exists(f):
+                raise FileNotFoundError(
+                    f"{f} not found; no network egress — place the Flowers "
+                    f"archive/mat files under {base}")
+        self.transform = transform
+        labels = sio.loadmat(label_file)["labels"].ravel()
+        setid = sio.loadmat(setid_file)
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[
+            mode.lower()]
+        self.indexes = setid[key].ravel()
+        self.labels = labels
+        self._tar = tarfile.open(data_file, "r:*")
+        self._names = {os.path.basename(m.name): m
+                       for m in self._tar.getmembers() if m.isfile()}
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        flower_id = int(self.indexes[idx])
+        member = self._names[f"image_{flower_id:05d}.jpg"]
+        img = Image.open(self._tar.extractfile(member)).convert("RGB")
+        label = np.asarray(self.labels[flower_id - 1] - 1, dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (reference vision/datasets/voc2012.py).
+    Reads the local VOCtrainval tar (no egress): returns (image, label
+    mask) pairs from ImageSets/Segmentation/{train,val,trainval}.txt."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        data_file = data_file or os.path.join(DATA_HOME, "voc2012",
+                                              "VOCtrainval_11-May-2012.tar")
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"{data_file} not found; no network egress — place the "
+                f"VOC2012 tar locally")
+        self.transform = transform
+        self._tar = tarfile.open(data_file, "r:*")
+        members = {m.name: m for m in self._tar.getmembers()}
+        mode = {"train": "train", "valid": "val", "test": "val",
+                "trainval": "trainval"}[mode.lower()]
+        listname = next(n for n in members
+                        if n.endswith(f"ImageSets/Segmentation/{mode}.txt"))
+        ids = self._tar.extractfile(members[listname]).read() \
+            .decode().split()
+        prefix = listname.split("ImageSets")[0]
+        self._pairs = [(members[f"{prefix}JPEGImages/{i}.jpg"],
+                        members[f"{prefix}SegmentationClass/{i}.png"])
+                       for i in ids]
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        im_m, lb_m = self._pairs[idx]
+        img = np.asarray(Image.open(self._tar.extractfile(im_m))
+                         .convert("RGB"))
+        label = np.asarray(Image.open(self._tar.extractfile(lb_m)))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self._pairs)

@@ -1,0 +1,181 @@
+"""API-tail parity batch: Bilinear/set_global_initializer, incubate
+LookAhead/ModelAverage/softmax_mask_fuse_upper_triangle, folder datasets,
+device queries. (reference analogues: test_initializer.py,
+test_lookahead.py, test_modelaverage.py, test_softmax_mask_fuse_op.py,
+test_datasets.py.)"""
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestInitializers:
+    def test_bilinear_kernel_values(self):
+        init = nn.initializer.Bilinear()
+        w = np.asarray(init((1, 1, 4, 4), jnp.float32))[0, 0]
+        # separable triangle filter for factor-2 upsampling
+        expect_1d = np.asarray([0.25, 0.75, 0.75, 0.25])
+        np.testing.assert_allclose(w, np.outer(expect_1d, expect_1d),
+                                   rtol=1e-6)
+        with pytest.raises(ValueError):
+            init((4, 4), jnp.float32)
+
+    def test_set_global_initializer(self):
+        nn.initializer.set_global_initializer(
+            nn.initializer.Constant(3.0), nn.initializer.Constant(-1.0))
+        try:
+            lin = nn.Linear(4, 2)
+            np.testing.assert_allclose(np.asarray(lin.weight.value),
+                                       np.full((4, 2), 3.0))
+            np.testing.assert_allclose(np.asarray(lin.bias.value),
+                                       np.full((2,), -1.0))
+        finally:
+            nn.initializer.set_global_initializer(None, None)
+        lin2 = nn.Linear(4, 2)
+        assert not np.allclose(np.asarray(lin2.weight.value), 3.0)
+        with pytest.raises(TypeError):
+            nn.initializer.set_global_initializer("xavier")
+
+
+class TestLookAhead:
+    def test_slow_weights_sync_every_k(self):
+        from paddle_tpu.incubate import LookAhead
+        paddle.seed(0)
+        lin = nn.Linear(2, 1)
+        inner = paddle.optimizer.SGD(1.0, parameters=lin.parameters())
+        opt = LookAhead(inner, alpha=0.5, k=3)
+        params = {"w": jnp.asarray([4.0])}
+        state = opt.init_state(params)
+        g = {"w": jnp.asarray([1.0])}
+        # steps 1,2: fast falls by 1 each; slow stays 4
+        for expect_fast in (3.0, 2.0):
+            params, state = opt.apply_gradients(params, dict(g), state,
+                                                lr=1.0)
+            assert float(params["w"][0]) == pytest.approx(expect_fast)
+        # step 3: fast would be 1; sync: slow = 4 + .5*(1-4) = 2.5 = fast
+        params, state = opt.apply_gradients(params, dict(g), state, lr=1.0)
+        assert float(params["w"][0]) == pytest.approx(2.5)
+        assert float(state["slow"]["w"][0]) == pytest.approx(2.5)
+
+    def test_validation(self):
+        from paddle_tpu.incubate import LookAhead
+        inner = paddle.optimizer.SGD(0.1, parameters=[])
+        with pytest.raises(ValueError):
+            LookAhead(inner, alpha=1.5)
+        with pytest.raises(ValueError):
+            LookAhead(inner, k=0)
+
+    def test_trains_in_parallel_trainer(self):
+        from paddle_tpu.distributed.engine import ParallelTrainer
+        from paddle_tpu.distributed.mesh import build_mesh
+        from paddle_tpu.incubate import LookAhead
+        build_mesh({"data": 1})
+        paddle.seed(1)
+        net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+        opt = LookAhead(paddle.optimizer.SGD(
+            0.1, parameters=net.parameters()), alpha=0.8, k=5)
+        tr = ParallelTrainer(net, opt,
+                             lambda o, y: jnp.mean((o - y) ** 2))
+        rs = np.random.RandomState(0)
+        x = rs.randn(16, 8).astype("f4")
+        y = x.sum(1, keepdims=True).astype("f4")
+        losses = [float(tr.train_step(x, y)) for _ in range(12)]
+        assert losses[-1] < losses[0]
+
+
+class TestModelAverage:
+    def test_window_average_and_apply_restore(self):
+        from paddle_tpu.incubate import ModelAverage
+        paddle.seed(0)
+        lin = nn.Linear(1, 1, bias_attr=False)
+        ma = ModelAverage(0.5, parameters=lin.parameters(),
+                          min_average_window=2, max_average_window=4)
+        seen = []
+        for v in (1.0, 2.0, 3.0, 4.0):
+            lin.weight.value = jnp.full((1, 1), v)
+            seen.append(v)
+            ma.step()
+        live = float(np.asarray(lin.weight.value)[0, 0])
+        with ma.apply():
+            avg = float(np.asarray(lin.weight.value)[0, 0])
+            # all 4 values still in the (sum_1+sum_2) window
+            assert avg == pytest.approx(np.mean(seen))
+        assert float(np.asarray(lin.weight.value)[0, 0]) == \
+            pytest.approx(live)  # restored
+
+    def test_apply_without_restore(self):
+        from paddle_tpu.incubate import ModelAverage
+        lin = nn.Linear(1, 1, bias_attr=False)
+        ma = ModelAverage(1.0, parameters=lin.parameters(),
+                          min_average_window=100)
+        lin.weight.value = jnp.full((1, 1), 10.0)
+        ma.step()
+        with ma.apply(need_restore=False):
+            pass
+        assert float(np.asarray(lin.weight.value)[0, 0]) == \
+            pytest.approx(10.0)
+        ma.restore()
+
+
+class TestSoftmaxMaskFuse:
+    def test_matches_masked_softmax(self):
+        from paddle_tpu.incubate import softmax_mask_fuse_upper_triangle
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 3, 5, 5).astype("f4")
+        out = np.asarray(softmax_mask_fuse_upper_triangle(x))
+        mask = np.tril(np.ones((5, 5), bool))
+        ref = np.where(mask, x, -1e30)
+        ref = np.exp(ref - ref.max(-1, keepdims=True))
+        ref = ref / ref.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out, np.where(mask, ref, 0.0),
+                                   rtol=1e-5, atol=1e-7)
+        assert (out[..., 0, 1:] == 0).all()
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+
+class TestFolderDatasets:
+    def _make_tree(self, root):
+        from PIL import Image
+        for cls, color in (("cat", (255, 0, 0)), ("dog", (0, 255, 0))):
+            d = os.path.join(root, cls)
+            os.makedirs(d)
+            for i in range(3):
+                Image.new("RGB", (8, 8), color).save(
+                    os.path.join(d, f"{i}.png"))
+
+    def test_dataset_folder(self, tmp_path):
+        from paddle_tpu.vision.datasets import DatasetFolder
+        self._make_tree(str(tmp_path))
+        ds = DatasetFolder(str(tmp_path))
+        assert ds.classes == ["cat", "dog"]
+        assert len(ds) == 6
+        img, label = ds[0]
+        assert label == 0
+        assert np.asarray(img).shape == (8, 8, 3)
+        labels = sorted(t for _, t in ds.samples)
+        assert labels == [0, 0, 0, 1, 1, 1]
+
+    def test_image_folder_flat(self, tmp_path):
+        from paddle_tpu.vision.datasets import ImageFolder
+        self._make_tree(str(tmp_path))
+        ds = ImageFolder(str(tmp_path))
+        assert len(ds) == 6
+        (img,) = ds[0]
+        assert np.asarray(img).shape == (8, 8, 3)
+
+    def test_flowers_voc_gate_without_files(self):
+        from paddle_tpu.vision.datasets import VOC2012, Flowers
+        with pytest.raises(FileNotFoundError, match="egress"):
+            Flowers()
+        with pytest.raises(FileNotFoundError, match="egress"):
+            VOC2012()
+
+
+def test_is_compiled_with_rocm():
+    assert paddle.device.is_compiled_with_rocm() is False
+    assert paddle.is_compiled_with_rocm() is False

@@ -150,6 +150,8 @@ TENSOR_CONFIGS = {
     "inner": lambda: (lambda x: T.inner(x, jnp.asarray(_x((2, 3)))), _x()),
     "inverse": lambda: (lambda x: T.inverse(x @ x.T + 3 * jnp.eye(3)),
                         _x((3, 3))),
+    "inv": lambda: (lambda x: T.inv(x @ x.T + 3 * jnp.eye(3)),
+                    _x((3, 3))),
     "kron": lambda: (lambda x: T.kron(x, jnp.ones((2, 2))), _x()),
     "lerp": lambda: (lambda x: T.lerp(x, jnp.ones_like(x), 0.3), _x()),
     "logaddexp": lambda: (lambda x: T.logaddexp(x, jnp.zeros_like(x)),
