@@ -33,6 +33,7 @@ from . import nn  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import io  # noqa: F401,E402
+from . import reader  # noqa: F401,E402
 from . import amp  # noqa: F401,E402
 from . import jit  # noqa: F401,E402
 from . import vision  # noqa: F401,E402

@@ -1,0 +1,78 @@
+"""Legacy reader decorators (reference reader/decorator.py; unittests
+test_multiprocess_reader_exception.py, reader tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import reader
+
+
+def _r(n=10):
+    def rd():
+        return iter(range(n))
+    return rd
+
+
+def test_cache_replays():
+    calls = []
+    def rd():
+        calls.append(1)
+        return iter([1, 2, 3])
+    c = reader.cache(rd)
+    assert list(c()) == [1, 2, 3]
+    assert list(c()) == [1, 2, 3]
+    assert len(calls) == 1
+
+
+def test_map_readers():
+    out = list(reader.map_readers(lambda a, b: a + b, _r(3), _r(3))())
+    assert out == [0, 2, 4]
+
+
+def test_shuffle_is_permutation():
+    out = list(reader.shuffle(_r(20), buf_size=7)())
+    assert sorted(out) == list(range(20))
+
+
+def test_chain_and_firstn():
+    assert list(reader.chain(_r(2), _r(3))()) == [0, 1, 0, 1, 2]
+    assert list(reader.firstn(_r(100), 4)()) == [0, 1, 2, 3]
+
+
+def test_compose_flattens_and_checks_alignment():
+    pairs = lambda: iter([(1, 2), (3, 4)])
+    out = list(reader.compose(_r(2), pairs)())
+    assert out == [(0, 1, 2), (1, 3, 4)]
+    with pytest.raises(RuntimeError, match="lengths"):
+        list(reader.compose(_r(2), _r(5))())
+    # misaligned but unchecked: stops at the shortest
+    assert list(reader.compose(_r(2), _r(5),
+                               check_alignment=False)()) == [(0, 0), (1, 1)]
+
+
+def test_buffered_preserves_order_and_raises():
+    assert list(reader.buffered(_r(50), 8)()) == list(range(50))
+    def bad():
+        yield 1
+        raise ValueError("boom")
+    with pytest.raises(ValueError, match="boom"):
+        list(reader.buffered(bad, 4)())
+
+
+def test_xmap_ordered_and_unordered():
+    sq = lambda x: x * x
+    assert list(reader.xmap_readers(sq, _r(10), 4, 8, order=True)()) == \
+        [i * i for i in range(10)]
+    out = list(reader.xmap_readers(sq, _r(10), 4, 8)())
+    assert sorted(out) == sorted(i * i for i in range(10))
+
+
+def test_multiprocess_reader_interleaves_all():
+    out = list(reader.multiprocess_reader([_r(5), _r(5)])())
+    assert sorted(out) == sorted(list(range(5)) * 2)
+    with pytest.raises(ValueError):
+        reader.multiprocess_reader([])
+
+
+def test_top_level_namespace():
+    assert paddle.reader.buffered is reader.buffered
