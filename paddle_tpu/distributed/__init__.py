@@ -17,3 +17,4 @@ from .spawn import spawn  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import ps  # noqa: F401
 from . import sharding  # noqa: F401,E402
+from . import auto  # noqa: F401,E402

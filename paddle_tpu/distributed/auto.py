@@ -1,0 +1,156 @@
+"""Auto-parallel planning: propose mesh degrees from a memory model.
+
+Beyond the reference (v2.1 has no auto-parallel): mechanizes the
+"How to Scale Your Model" recipe — pick a mesh, check the per-device
+memory arithmetic, prefer the cheapest collectives. The planner searches
+(data, sharding, model, pipe) factorizations of the device count and
+returns the first layout whose estimated per-device bytes fit HBM,
+ordered by communication cost (DP < ZeRO < TP < PP — reshard over the
+fastest axes first; TP pays per-layer collectives, PP pays bubble).
+
+Estimates use the standard transformer accounting:
+  params/device    = P * b_param / (tp * pp * zshard)
+  grads/device     = P * b_param / (tp * pp * zshard_g)
+  opt state/device = P * 8 bytes (adam m+v fp32) / (tp * pp * zshard_o)
+  activations      ~ L/pp * B * S * H * c_act * b_act / tp   (remat ÷ ~L)
+
+This is a PLANNER, not a profiler: numbers are first-order sizing to pick
+a starting layout; profile and iterate for the last 20%.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+__all__ = ["MemoryEstimate", "Plan", "plan"]
+
+_ADAM_BYTES = 8          # m + v, fp32 each
+_ACT_COEFF = 18          # bytes-ish per (B,S,H) element across a block's
+                         # live set with flash attention (no S^2 term)
+
+
+@dataclass
+class MemoryEstimate:
+    params: float
+    grads: float
+    opt_state: float
+    activations: float
+
+    @property
+    def total(self) -> float:
+        return self.params + self.grads + self.opt_state + self.activations
+
+
+@dataclass
+class Plan:
+    degrees: Dict[str, int]
+    per_device: MemoryEstimate
+    hbm_bytes: float
+    rationale: List[str] = field(default_factory=list)
+
+    @property
+    def fits(self) -> bool:
+        return self.per_device.total <= self.hbm_bytes
+
+    def build_mesh(self):
+        from .mesh import build_mesh
+        return build_mesh({k: v for k, v in self.degrees.items() if v > 1}
+                          or {"data": 1})
+
+
+def _factorizations(n: int):
+    """All (data, sharding, model, pipe) with product n, model/pipe powers
+    of 2 (TP wants the MXU-friendly head splits)."""
+    out = []
+    def divs(x):
+        return [d for d in range(1, x + 1) if x % d == 0]
+    for pipe in divs(n):
+        for model in divs(n // pipe):
+            if model & (model - 1):      # non-power-of-2 TP: skip
+                continue
+            rest = n // (pipe * model)
+            for shard in divs(rest):
+                out.append({"data": rest // shard, "sharding": shard,
+                            "model": model, "pipe": pipe})
+    return out
+
+
+def _estimate(n_params: float, deg: Dict[str, int], *, layers, hidden,
+              seq_len, batch_per_device, param_bytes, zero_stage,
+              remat) -> MemoryEstimate:
+    tp, pp, z = deg["model"], deg["pipe"], deg["sharding"]
+    shard_p = z if zero_stage >= 3 else 1
+    shard_g = z if zero_stage >= 2 else 1
+    shard_o = z if zero_stage >= 1 else 1
+    mp = tp * pp
+    params = n_params * param_bytes / (mp * shard_p)
+    grads = n_params * param_bytes / (mp * shard_g)
+    opt = n_params * _ADAM_BYTES / (mp * shard_o)
+    act = (layers / pp) * batch_per_device * seq_len * hidden \
+        * _ACT_COEFF / tp
+    if remat:
+        act = act / max(1.0, layers / pp) + \
+            batch_per_device * seq_len * hidden * _ACT_COEFF / tp
+    return MemoryEstimate(params, grads, opt, act)
+
+
+def _comm_cost(deg: Dict[str, int]) -> tuple:
+    """Sort key: prefer fewer model/pipe degrees (TP = per-layer
+    collectives, PP = bubble + schedule complexity), then less ZeRO
+    resharding, then more plain DP."""
+    return (deg["pipe"], deg["model"], deg["sharding"], -deg["data"])
+
+
+def plan(n_params: float, n_devices: int, *, layers: int = 24,
+         hidden: int = 2048, seq_len: int = 2048,
+         batch_per_device: int = 8, hbm_bytes: float = 16e9,
+         param_bytes: int = 2, zero_stage: int = 1,
+         remat: Optional[bool] = None, max_model: int = 8,
+         headroom: float = 0.9) -> Plan:
+    """Propose mesh degrees for training an n_params transformer on
+    n_devices chips. Returns the cheapest-communication Plan that fits
+    ``headroom * hbm_bytes``; raises ValueError if nothing fits (with the
+    closest layout's numbers in the message)."""
+    if n_devices < 1:
+        raise ValueError("n_devices must be >= 1")
+    budget = headroom * hbm_bytes
+    candidates = []
+    for deg in _factorizations(n_devices):
+        if deg["model"] > max_model or deg["model"] > max(1, hidden // 128):
+            continue
+        if deg["pipe"] > max(1, layers):
+            continue
+        for use_remat in ((remat,) if remat is not None else (False, True)):
+            est = _estimate(n_params, deg, layers=layers, hidden=hidden,
+                            seq_len=seq_len,
+                            batch_per_device=batch_per_device,
+                            param_bytes=param_bytes,
+                            zero_stage=zero_stage, remat=use_remat)
+            candidates.append((deg, use_remat, est))
+    fitting = [(d, r, e) for d, r, e in candidates if e.total <= budget]
+    if not fitting:
+        best = min(candidates, key=lambda t: t[2].total)
+        raise ValueError(
+            f"no layout fits: closest is {best[0]} "
+            f"(remat={best[1]}) at {best[2].total / 1e9:.1f} GB/device vs "
+            f"budget {budget / 1e9:.1f} GB — add devices, raise "
+            f"zero_stage, or shrink the per-device batch")
+    deg, use_remat, est = min(
+        fitting, key=lambda t: (_comm_cost(t[0]), t[1]))
+    why = [
+        f"{n_devices} devices -> data={deg['data']} sharding="
+        f"{deg['sharding']} model={deg['model']} pipe={deg['pipe']}",
+        f"per-device: params {est.params/1e9:.2f} GB + grads "
+        f"{est.grads/1e9:.2f} GB + opt {est.opt_state/1e9:.2f} GB + act "
+        f"{est.activations/1e9:.2f} GB = {est.total/1e9:.2f} GB "
+        f"(budget {budget/1e9:.1f} GB)",
+        f"zero_stage={zero_stage}, remat={use_remat}",
+    ]
+    if deg["model"] > 1:
+        why.append("TP engaged: params exceed what DP+ZeRO fits alone")
+    if deg["pipe"] > 1:
+        why.append("PP engaged: per-layer state exceeds TP ceiling")
+    p = Plan(degrees=deg, per_device=est, hbm_bytes=hbm_bytes,
+             rationale=why)
+    p.remat = use_remat
+    return p

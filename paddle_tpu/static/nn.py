@@ -113,10 +113,19 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
 
 
 def sparse_embedding(input, size, padding_idx=None, param_attr=None,
-                     is_test=False, name=None):
+                     is_test=False, entry=None, name=None):
     """reference static/nn/common.py sparse_embedding — the PS-backed
-    trillion-row table. Dense fallback here; the distributed PS path lives in
-    distributed/ps (csrc/ps native store)."""
+    trillion-row table. Dense fallback here (``entry`` admission policies
+    then have nothing to gate and are accepted for source compat); the
+    distributed PS path with entry-gated row admission lives in
+    distributed/ps (DistributedEmbedding(entry=...), csrc/ps native
+    store)."""
+    if entry is not None:
+        from ..distributed.entry import EntryAttr
+        if not isinstance(entry, EntryAttr):
+            raise ValueError(
+                "entry must be a ProbabilityEntry/CountFilterEntry "
+                f"(paddle.distributed), got {type(entry).__name__}")
     return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
                      param_attr=param_attr, name=name)
 

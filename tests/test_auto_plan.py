@@ -1,0 +1,61 @@
+"""Auto-parallel planner tests (beyond-reference capability)."""
+import pytest
+
+from paddle_tpu.distributed import auto
+
+
+def test_small_model_prefers_pure_dp():
+    # GPT-base 124M on 8 x 16GB chips: fits with plain DP
+    p = auto.plan(124e6, 8, layers=12, hidden=768, seq_len=1024,
+                  batch_per_device=8)
+    assert p.fits
+    assert p.degrees["model"] == 1 and p.degrees["pipe"] == 1
+    assert p.degrees["data"] * p.degrees["sharding"] == 8
+
+def test_large_model_engages_tp_or_pp():
+    # 30B params on 64 chips cannot be DP-only (opt state alone ~240GB)
+    p = auto.plan(30e9, 64, layers=48, hidden=7168, seq_len=2048,
+                  batch_per_device=4, zero_stage=1)
+    assert p.fits
+    assert p.degrees["model"] * p.degrees["pipe"] * \
+        p.degrees["sharding"] > 1
+    prod = 1
+    for v in p.degrees.values():
+        prod *= v
+    assert prod == 64
+
+def test_infeasible_raises_with_guidance():
+    with pytest.raises(ValueError, match="no layout fits"):
+        auto.plan(175e9, 2, layers=96, hidden=12288)
+
+def test_zero3_avoids_pipeline_that_zero0_needs():
+    kw = dict(layers=32, hidden=4096, seq_len=2048, batch_per_device=4,
+              max_model=1)
+    p3 = auto.plan(13e9, 16, zero_stage=3, **kw)
+    assert p3.fits
+    assert p3.degrees["pipe"] == 1       # sharded states fit without PP
+    p0 = auto.plan(13e9, 16, zero_stage=0, **kw)
+    assert p0.fits
+    assert p0.degrees["pipe"] > 1        # unsharded states force PP
+
+def test_plan_builds_a_mesh():
+    p = auto.plan(10e6, 8, layers=4, hidden=256, seq_len=256,
+                  batch_per_device=2)
+    mesh = p.build_mesh()
+    total = 1
+    for s in mesh.shape.values():
+        total *= s
+    assert total == 8
+    assert p.rationale  # human-readable why
+
+def test_estimate_monotone_in_sharding():
+    e1 = auto._estimate(1e9, {"data": 8, "sharding": 1, "model": 1,
+                              "pipe": 1}, layers=24, hidden=2048,
+                        seq_len=2048, batch_per_device=8, param_bytes=2,
+                        zero_stage=1, remat=False)
+    e8 = auto._estimate(1e9, {"data": 1, "sharding": 8, "model": 1,
+                              "pipe": 1}, layers=24, hidden=2048,
+                        seq_len=2048, batch_per_device=8, param_bytes=2,
+                        zero_stage=1, remat=False)
+    assert e8.opt_state < e1.opt_state
+    assert e8.total < e1.total
