@@ -28,28 +28,50 @@ import numpy as np
 V5E_BF16_PEAK = 197e12
 
 
-def _init_backend(retries: int = 4, backoff_s: float = 15.0):
-    """Import jax and force backend init, retrying with backoff.
+def _init_backend(retries: int = 4, backoff_s: float = 15.0,
+                  attempt_timeout_s: float = 300.0):
+    """Import jax and force backend init, retrying with backoff AND a
+    per-attempt watchdog.
 
-    Round 1's rc=1 was a one-shot crash in axon backend setup; transient
-    tunnel/plugin failures deserve another attempt, not an empty bench.
-    """
+    Round 1's rc=1 was a one-shot crash in axon backend setup; round 3
+    additionally observed jax.devices() HANGING indefinitely when the
+    tunnel wedges — an exception-only retry never fires then. Init runs
+    on a daemon thread with a hard join timeout so the bench always emits
+    its JSON line instead of blocking the driver."""
+    import threading
     last = None
     for attempt in range(retries):
-        try:
-            import jax
-            devs = jax.devices()  # forces platform/plugin initialization
-            # one tiny computation proves the runtime actually works
-            float(jax.numpy.zeros(()).sum())
-            return jax, devs
-        except Exception as e:  # noqa: BLE001 — anything in init is fatal-ish
-            last = e
-            sys.stderr.write(
-                f"bench: backend init attempt {attempt + 1}/{retries} "
-                f"failed: {e}\n")
-            if attempt < retries - 1:
-                time.sleep(backoff_s * (attempt + 1))
-    raise RuntimeError(f"backend init failed after {retries} attempts: {last}")
+        result: dict = {}
+
+        def _try():
+            try:
+                import jax
+                devs = jax.devices()  # forces platform/plugin init
+                # one tiny computation proves the runtime actually works
+                float(jax.numpy.zeros(()).sum())
+                result["jax"], result["devs"] = jax, devs
+            except Exception as e:  # noqa: BLE001 — init errors are fatal-ish
+                result["err"] = e
+
+        t = threading.Thread(target=_try, daemon=True)
+        t.start()
+        t.join(attempt_timeout_s)
+        if "jax" in result:
+            return result["jax"], result["devs"]
+        last = result.get(
+            "err",
+            RuntimeError(f"init hung > {attempt_timeout_s:.0f}s "
+                         "(tunnel wedged)"))
+        sys.stderr.write(
+            f"bench: backend init attempt {attempt + 1}/{retries} "
+            f"failed: {last}\n")
+        if t.is_alive():
+            # the stuck native call poisons this process's plugin state;
+            # further in-process retries would block on the same lock
+            break
+        if attempt < retries - 1:
+            time.sleep(backoff_s * (attempt + 1))
+    raise RuntimeError(f"backend init failed: {last}")
 
 
 def _timed_steps(trainer, inputs, labels, warmup: int, iters: int):
