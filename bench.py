@@ -159,6 +159,45 @@ def bench_resnet50(on_tpu: bool):
             "final_loss": round(final_loss, 4)}
 
 
+def bench_widedeep(on_tpu: bool):
+    """BASELINE configs[4]: sparse recommender throughput (Criteo-shaped
+    synthetic CTR: 26 categorical fields + 13 dense)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.engine import ParallelTrainer
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.rec import WideDeep
+
+    paddle.seed(0)
+    build_mesh({"data": 1})
+    if on_tpu:
+        fields, batch, iters, warmup = [100_000] * 26, 4096, 20, 8
+        hidden = (400, 400, 400)
+    else:
+        fields, batch, iters, warmup = [1000] * 8, 256, 2, 1
+        hidden = (64, 32)
+    model = WideDeep(fields, dense_dim=13, embedding_dim=16,
+                     hidden_sizes=hidden)
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+
+    def bce(logit, y):
+        return jnp.mean(jnp.maximum(logit, 0) - logit * y
+                        + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    trainer = ParallelTrainer(model, opt, bce)
+    rng = np.random.RandomState(0)
+    ids = np.stack([rng.randint(0, d, batch) for d in fields], 1) \
+        .astype("int64")
+    dense = rng.randn(batch, 13).astype("float32")
+    label = rng.randint(0, 2, batch).astype("float32")
+    dt, final_loss = _timed_steps(trainer, (ids, dense), label,
+                                  warmup, iters)
+    return {"samples_per_sec": round(batch * iters / dt, 1),
+            "final_loss": round(final_loss, 4)}
+
+
 def bench_bert_amp(on_tpu: bool):
     """BERT-base MLM+NSP, bf16 (the TPU AMP: reference fp16_utils.py:322
     cast_model_to_fp16 O2 maps to whole-model bf16 on TPU)."""
@@ -212,7 +251,8 @@ def main():
     extra = {}
     for name, fn in (("gpt_base", bench_gpt),
                      ("resnet50", bench_resnet50),
-                     ("bert_base_amp", bench_bert_amp)):
+                     ("bert_base_amp", bench_bert_amp),
+                     ("widedeep_ctr", bench_widedeep)):
         try:
             extra[name] = fn(on_tpu)
         except Exception as e:  # partial results beat an empty bench

@@ -38,6 +38,7 @@ from . import amp  # noqa: F401,E402
 from . import jit  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import text  # noqa: F401,E402
+from . import rec  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import monitor  # noqa: F401,E402

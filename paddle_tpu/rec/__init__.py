@@ -1,0 +1,135 @@
+"""Sparse recommender models — Wide&Deep and DeepFM.
+
+BASELINE.md's configs[4] names the "Wide&Deep / DeepFM sparse recommender"
+workload (the reference serves it via PaddleRec on the PS tier:
+dist_fleet_ctr.py fixtures, common_sparse_table.cc storage). Two storage
+modes, same math:
+
+- bounded-vocab (default): `nn.Embedding` parameters — fully jit-compiled,
+  shards over the mesh like any dense model (collective tier).
+- unbounded-vocab: pass `sparse=True` to back the id features with the
+  host-side PS `DistributedEmbedding` (csrc/ps native table; rows
+  materialize on first touch, optimizer applied server-side at push).
+
+Inputs: ``ids`` (B, F) one categorical id per field (use id -1 for
+missing), ``dense`` (B, D) continuous features. Output: CTR logit (B,).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+
+__all__ = ["WideDeep", "DeepFM"]
+
+
+def _sparse_tables(field_dims, dim, sparse, lr):
+    if not sparse:
+        return nn.Embedding(sum(field_dims), dim)
+    from ..distributed.ps import DistributedEmbedding
+    return DistributedEmbedding(dim, "adagrad", lr=lr)
+
+
+class _RecBase(nn.Layer):
+    def __init__(self, field_dims: Sequence[int], dense_dim: int,
+                 embedding_dim: int, sparse: bool, sparse_lr: float):
+        super().__init__()
+        self.field_dims = list(field_dims)
+        self.num_fields = len(self.field_dims)
+        self.dense_dim = dense_dim
+        self.embedding_dim = embedding_dim
+        self.sparse = sparse
+        # offsets fold per-field vocabularies into one id space, so one
+        # table serves all fields (the reference's single sparse table
+        # with slot-prefixed keys)
+        offs = jnp.asarray(
+            [0] + list(jnp.cumsum(jnp.asarray(self.field_dims))[:-1]),
+            jnp.int32)
+        self.register_buffer("field_offsets", offs, persistable=False)
+        self.embedding = _sparse_tables(self.field_dims, embedding_dim,
+                                        sparse, sparse_lr)
+        self.linear_emb = _sparse_tables(self.field_dims, 1, sparse,
+                                         sparse_lr)
+
+    def _fold_ids(self, ids):
+        ids = jnp.asarray(ids)
+        folded = ids + self.field_offsets[None, :]
+        # missing ids (-1) stay negative -> PS path zeros them; the dense
+        # Embedding path clamps and masks
+        return jnp.where(ids < 0, -1, folded)
+
+    def _lookup(self, table, folded):
+        if self.sparse:
+            return table(folded)
+        mask = (folded >= 0)
+        safe = jnp.where(mask, folded, 0)
+        out = table(safe)
+        return out * mask[..., None].astype(out.dtype)
+
+
+class WideDeep(_RecBase):
+    """wide (linear over sparse ids + dense) + deep (MLP over embeddings
+    ++ dense); logit = wide + deep."""
+
+    def __init__(self, field_dims: Sequence[int], dense_dim: int = 13,
+                 embedding_dim: int = 16,
+                 hidden_sizes: Sequence[int] = (128, 64, 32),
+                 sparse: bool = False, sparse_lr: float = 0.05):
+        super().__init__(field_dims, dense_dim, embedding_dim, sparse,
+                         sparse_lr)
+        self.wide_dense = nn.Linear(dense_dim, 1)
+        layers, prev = [], self.num_fields * embedding_dim + dense_dim
+        for h in hidden_sizes:
+            layers += [nn.Linear(prev, h), nn.ReLU()]
+            prev = h
+        layers.append(nn.Linear(prev, 1))
+        self.deep = nn.Sequential(*layers)
+
+    def forward(self, ids, dense=None):
+        if dense is None:          # engine convention: one inputs pytree
+            ids, dense = ids
+        folded = self._fold_ids(ids)
+        dense = jnp.asarray(dense, jnp.float32)
+        wide = self._lookup(self.linear_emb, folded).sum(axis=(1, 2)) \
+            + self.wide_dense(dense)[:, 0]
+        emb = self._lookup(self.embedding, folded)           # (B, F, E)
+        deep_in = jnp.concatenate(
+            [emb.reshape(emb.shape[0], -1), dense], axis=-1)
+        return wide + self.deep(deep_in)[:, 0]
+
+
+class DeepFM(_RecBase):
+    """FM first-order + pairwise second-order (0.5[(Σv)² − Σv²]) + deep
+    MLP over the same embeddings."""
+
+    def __init__(self, field_dims: Sequence[int], dense_dim: int = 13,
+                 embedding_dim: int = 16,
+                 hidden_sizes: Sequence[int] = (128, 64),
+                 sparse: bool = False, sparse_lr: float = 0.05):
+        super().__init__(field_dims, dense_dim, embedding_dim, sparse,
+                         sparse_lr)
+        self.dense_first = nn.Linear(dense_dim, 1)
+        layers, prev = [], self.num_fields * embedding_dim + dense_dim
+        for h in hidden_sizes:
+            layers += [nn.Linear(prev, h), nn.ReLU()]
+            prev = h
+        layers.append(nn.Linear(prev, 1))
+        self.deep = nn.Sequential(*layers)
+
+    def forward(self, ids, dense=None):
+        if dense is None:          # engine convention: one inputs pytree
+            ids, dense = ids
+        folded = self._fold_ids(ids)
+        dense = jnp.asarray(dense, jnp.float32)
+        first = self._lookup(self.linear_emb, folded).sum(axis=(1, 2)) \
+            + self.dense_first(dense)[:, 0]
+        v = self._lookup(self.embedding, folded)             # (B, F, E)
+        sum_sq = jnp.square(v.sum(axis=1))
+        sq_sum = jnp.square(v).sum(axis=1)
+        second = 0.5 * (sum_sq - sq_sum).sum(axis=-1)
+        deep_in = jnp.concatenate([v.reshape(v.shape[0], -1), dense],
+                                  axis=-1)
+        return first + second + self.deep(deep_in)[:, 0]
