@@ -1,0 +1,127 @@
+"""Chunked LM-head cross entropy: hidden @ W -> softmax CE without ever
+materializing the (tokens, vocab) logits tensor.
+
+Capability target: the reference fuses softmax+CE per-op
+(operators/softmax_with_cross_entropy_op.cu) but still materializes the
+logits produced by the head matmul. On TPU the (B*S, V) bf16 logits of a
+50k-vocab model are the single largest HBM tensor in the step (e.g.
+8x1024x50304 = 824 MB written + re-read in fwd and bwd). This op scans
+the vocab in chunks with an online logsumexp (flash-attention's trick
+applied to the classifier): peak extra memory is O(tokens * chunk), and
+the backward recomputes each chunk's logits instead of re-reading them.
+
+Numerics: logits accumulate in fp32 regardless of input dtype; the
+returned loss is the mean over tokens with label != ignore_index.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["chunked_lm_ce"]
+
+
+def _chunk_w(weight, chunk):
+    """(H, V) -> (n_chunks, H, chunk), zero-padded; also returns V."""
+    h, v = weight.shape
+    n = -(-v // chunk)
+    pad = n * chunk - v
+    if pad:
+        weight = jnp.pad(weight, ((0, 0), (0, pad)))
+    return weight.reshape(h, n, chunk).transpose(1, 0, 2), v
+
+
+def _fwd_scan(hidden32, wc, labels, v, chunk):
+    """Online LSE over vocab chunks. hidden32 (N,H) fp32, wc (n,H,C)."""
+    n_tok = hidden32.shape[0]
+
+    def step(carry, xs):
+        m, s, tgt = carry
+        w_c, c0 = xs
+        logits = hidden32 @ w_c.astype(jnp.float32)          # (N, C)
+        col = c0 + jnp.arange(chunk)
+        logits = jnp.where(col[None, :] < v, logits, -jnp.inf)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        s = s * jnp.exp(m - m_new) + \
+            jnp.exp(logits - m_new[:, None]).sum(axis=-1)
+        in_chunk = (labels >= c0) & (labels < c0 + chunk)
+        local = jnp.clip(labels - c0, 0, chunk - 1)
+        picked = jnp.take_along_axis(logits, local[:, None],
+                                     axis=1)[:, 0]
+        tgt = jnp.where(in_chunk, picked, tgt)
+        return (m_new, s, tgt), None
+
+    n_chunks = wc.shape[0]
+    c0s = jnp.arange(n_chunks) * chunk
+    init = (jnp.full((n_tok,), -jnp.inf, jnp.float32),
+            jnp.zeros((n_tok,), jnp.float32),
+            jnp.zeros((n_tok,), jnp.float32))
+    (m, s, tgt), _ = lax.scan(step, init, (wc, c0s))
+    lse = m + jnp.log(s)
+    return lse, tgt
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def chunked_lm_ce(hidden, weight, labels, chunk: int = 8192,
+                  ignore_index: int = -100):
+    """Mean CE of softmax(hidden @ weight) vs integer labels.
+
+    hidden: (..., H); weight: (H, V); labels: (...) int. Returns a scalar
+    (fp32). Differentiable wrt hidden and weight."""
+    loss, _ = _ce_fwd(hidden, weight, labels, chunk, ignore_index)
+    return loss
+
+
+def _ce_fwd(hidden, weight, labels, chunk, ignore_index):
+    h_dim = hidden.shape[-1]
+    hid32 = hidden.reshape(-1, h_dim).astype(jnp.float32)
+    lbl = labels.reshape(-1)
+    wc, v = _chunk_w(weight, chunk)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    lse, tgt = _fwd_scan(hid32, wc, safe, v, chunk)
+    per_tok = jnp.where(valid, lse - tgt, 0.0)
+    denom = jnp.maximum(valid.sum().astype(jnp.float32), 1.0)
+    loss = per_tok.sum() / denom
+    return loss, (hidden, weight, labels, lse, denom)
+
+
+def _ce_bwd(chunk, ignore_index, res, g):
+    hidden, weight, labels, lse, denom = res
+    h_dim = hidden.shape[-1]
+    hid32 = hidden.reshape(-1, h_dim).astype(jnp.float32)
+    lbl = labels.reshape(-1)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    wc, v = _chunk_w(weight, chunk)
+    scale = (g / denom) * valid.astype(jnp.float32)          # (N,)
+
+    def step(dh, xs):
+        w_c, c0 = xs
+        w32 = w_c.astype(jnp.float32)
+        logits = hid32 @ w32
+        col = c0 + jnp.arange(chunk)
+        logits = jnp.where(col[None, :] < v, logits, -jnp.inf)
+        p = jnp.exp(logits - lse[:, None])                   # softmax chunk
+        in_chunk = (safe >= c0) & (safe < c0 + chunk)
+        local = jnp.clip(safe - c0, 0, chunk - 1)
+        onehot = (jnp.arange(chunk)[None, :] == local[:, None]) \
+            & in_chunk[:, None]
+        d_logits = (p - onehot.astype(jnp.float32)) * scale[:, None]
+        dh = dh + d_logits @ w32.T
+        dw_c = hid32.T @ d_logits                            # (H, C)
+        return dh, dw_c
+
+    n_chunks = wc.shape[0]
+    c0s = jnp.arange(n_chunks) * chunk
+    dh, dw_chunks = lax.scan(step, jnp.zeros_like(hid32), (wc, c0s))
+    dw = dw_chunks.transpose(1, 0, 2).reshape(h_dim, n_chunks * chunk)
+    dw = dw[:, :v]
+    return (dh.reshape(hidden.shape).astype(hidden.dtype),
+            dw.astype(weight.dtype), None)
+
+
+chunked_lm_ce.defvjp(_ce_fwd, _ce_bwd)

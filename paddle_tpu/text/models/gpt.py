@@ -225,6 +225,19 @@ class GPTForPretraining(Layer):
         per_tok = self.parallel_loss(logits, labels)
         return jnp.mean(per_tok)
 
+    def fused_head_loss(self, input_ids, labels, chunk: int = 8192,
+                        attn_mask=None):
+        """Trunk -> chunked head+CE (ops/chunked_ce.py): the (B, S, vocab)
+        logits are never materialized — the vocab is scanned in chunks
+        with an online logsumexp, and the backward recomputes each
+        chunk's logits. Single-device / DP path (the TP path keeps the
+        vocab-sharded head + ParallelCrossEntropy, which already splits
+        the logits tensor over "model")."""
+        from ...ops.chunked_ce import chunked_lm_ce
+        h = self.gpt(input_ids, attn_mask)
+        w = jnp.swapaxes(self.lm_head.weight.value, 0, 1)   # (H, V)
+        return chunked_lm_ce(h, w, labels, chunk)
+
 
 # -- pipeline variant --------------------------------------------------------
 class _EmbeddingPipe(GPTEmbeddings):
