@@ -10,6 +10,11 @@ the vocab in chunks with an online logsumexp (flash-attention's trick
 applied to the classifier): peak extra memory is O(tokens * chunk), and
 the backward recomputes each chunk's logits instead of re-reading them.
 
+The weight is sliced in place per chunk (lax.dynamic_slice) — no
+(n_chunks, H, chunk) relayout of the full weight enters the scan, and
+the backward accumulates dW into one fp32 buffer via
+dynamic_update_slice instead of stacking per-chunk outputs.
+
 Numerics: logits accumulate in fp32 regardless of input dtype; the
 returned loss is the mean over tokens with label != ignore_index.
 """
@@ -24,24 +29,26 @@ from jax import lax
 __all__ = ["chunked_lm_ce"]
 
 
-def _chunk_w(weight, chunk):
-    """(H, V) -> (n_chunks, H, chunk), zero-padded; also returns V."""
+def _pad_w(weight, chunk):
+    """Zero-pad (H, V) to a chunk multiple. One O(H*pad) concat at most
+    (pad < chunk); zero columns are masked to -inf logits downstream."""
     h, v = weight.shape
     n = -(-v // chunk)
     pad = n * chunk - v
     if pad:
-        weight = jnp.pad(weight, ((0, 0), (0, pad)))
-    return weight.reshape(h, n, chunk).transpose(1, 0, 2), v
+        weight = jnp.concatenate(
+            [weight, jnp.zeros((h, pad), weight.dtype)], axis=1)
+    return weight, n, v
 
 
-def _fwd_scan(hidden32, wc, labels, v, chunk):
-    """Online LSE over vocab chunks. hidden32 (N,H) fp32, wc (n,H,C)."""
-    n_tok = hidden32.shape[0]
+def _fwd_scan(hid32, wpad, labels, v, chunk, n_chunks):
+    """Online LSE over vocab chunks. hid32 (N,H) fp32, wpad (H, n*chunk)."""
+    n_tok = hid32.shape[0]
 
-    def step(carry, xs):
+    def step(carry, c0):
         m, s, tgt = carry
-        w_c, c0 = xs
-        logits = hidden32 @ w_c.astype(jnp.float32)          # (N, C)
+        w_c = lax.dynamic_slice_in_dim(wpad, c0, chunk, axis=1)
+        logits = hid32 @ w_c.astype(jnp.float32)             # (N, C)
         col = c0 + jnp.arange(chunk)
         logits = jnp.where(col[None, :] < v, logits, -jnp.inf)
         m_new = jnp.maximum(m, logits.max(axis=-1))
@@ -54,12 +61,11 @@ def _fwd_scan(hidden32, wc, labels, v, chunk):
         tgt = jnp.where(in_chunk, picked, tgt)
         return (m_new, s, tgt), None
 
-    n_chunks = wc.shape[0]
     c0s = jnp.arange(n_chunks) * chunk
     init = (jnp.full((n_tok,), -jnp.inf, jnp.float32),
             jnp.zeros((n_tok,), jnp.float32),
             jnp.zeros((n_tok,), jnp.float32))
-    (m, s, tgt), _ = lax.scan(step, init, (wc, c0s))
+    (m, s, tgt), _ = lax.scan(step, init, c0s)
     lse = m + jnp.log(s)
     return lse, tgt
 
@@ -79,10 +85,10 @@ def _ce_fwd(hidden, weight, labels, chunk, ignore_index):
     h_dim = hidden.shape[-1]
     hid32 = hidden.reshape(-1, h_dim).astype(jnp.float32)
     lbl = labels.reshape(-1)
-    wc, v = _chunk_w(weight, chunk)
+    wpad, n_chunks, v = _pad_w(weight, chunk)
     valid = lbl != ignore_index
     safe = jnp.where(valid, lbl, 0)
-    lse, tgt = _fwd_scan(hid32, wc, safe, v, chunk)
+    lse, tgt = _fwd_scan(hid32, wpad, safe, v, chunk, n_chunks)
     per_tok = jnp.where(valid, lse - tgt, 0.0)
     denom = jnp.maximum(valid.sum().astype(jnp.float32), 1.0)
     loss = per_tok.sum() / denom
@@ -96,11 +102,12 @@ def _ce_bwd(chunk, ignore_index, res, g):
     lbl = labels.reshape(-1)
     valid = lbl != ignore_index
     safe = jnp.where(valid, lbl, 0)
-    wc, v = _chunk_w(weight, chunk)
+    wpad, n_chunks, v = _pad_w(weight, chunk)
     scale = (g / denom) * valid.astype(jnp.float32)          # (N,)
 
-    def step(dh, xs):
-        w_c, c0 = xs
+    def step(carry, c0):
+        dh, dw = carry
+        w_c = lax.dynamic_slice_in_dim(wpad, c0, chunk, axis=1)
         w32 = w_c.astype(jnp.float32)
         logits = hid32 @ w32
         col = c0 + jnp.arange(chunk)
@@ -112,13 +119,14 @@ def _ce_bwd(chunk, ignore_index, res, g):
             & in_chunk[:, None]
         d_logits = (p - onehot.astype(jnp.float32)) * scale[:, None]
         dh = dh + d_logits @ w32.T
-        dw_c = hid32.T @ d_logits                            # (H, C)
-        return dh, dw_c
+        dw = lax.dynamic_update_slice_in_dim(
+            dw, hid32.T @ d_logits, c0, axis=1)
+        return (dh, dw), None
 
-    n_chunks = wc.shape[0]
     c0s = jnp.arange(n_chunks) * chunk
-    dh, dw_chunks = lax.scan(step, jnp.zeros_like(hid32), (wc, c0s))
-    dw = dw_chunks.transpose(1, 0, 2).reshape(h_dim, n_chunks * chunk)
+    init = (jnp.zeros_like(hid32),
+            jnp.zeros((h_dim, n_chunks * chunk), jnp.float32))
+    (dh, dw), _ = lax.scan(step, init, c0s)
     dw = dw[:, :v]
     return (dh.reshape(hidden.shape).astype(hidden.dtype),
             dw.astype(weight.dtype), None)

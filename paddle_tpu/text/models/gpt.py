@@ -233,7 +233,16 @@ class GPTForPretraining(Layer):
         chunk's logits. Single-device / DP path (the TP path keeps the
         vocab-sharded head + ParallelCrossEntropy, which already splits
         the logits tensor over "model")."""
+        from ...distributed.meta_parallel.parallel_layers.mp_layers import (
+            _in_shard_map)
         from ...ops.chunked_ce import chunked_lm_ce
+        if self.tensor_parallel and _in_shard_map():
+            # vocab-sharded head: local weight covers only V/mp columns —
+            # the chunked op would silently miss every off-shard label.
+            raise RuntimeError(
+                "fused_head_loss is the single-device/DP path; under "
+                "tensor parallelism use forward() + the vocab-sharded "
+                "ParallelCrossEntropy loss")
         h = self.gpt(input_ids, attn_mask)
         w = jnp.swapaxes(self.lm_head.weight.value, 0, 1)   # (H, V)
         return chunked_lm_ce(h, w, labels, chunk)
