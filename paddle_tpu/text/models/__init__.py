@@ -6,3 +6,5 @@ from .bert import (  # noqa: F401
     BertForSequenceClassification, BertModel, BertPooler,
     BertPretrainingHeads, ErnieForPretraining, ErnieModel, bert_base,
     bert_large)
+from .transformer import (  # noqa: F401
+    InferTransformerModel, TransformerModel, position_encoding_init)
