@@ -113,3 +113,22 @@ def test_sparse_ps_backed_mode_trains():
         losses.append(float(l))
     assert losses[-1] < losses[0] - 0.05, (losses[0], losses[-1])
     assert len(model.embedding.table) > 0     # PS rows materialized
+
+
+def test_jit_save_load_widedeep(tmp_path):
+    """Serving-path roundtrip for the CTR model (StableHLO export)."""
+    from paddle_tpu.jit import InputSpec
+    build_mesh({"data": 1})
+    paddle.seed(9)
+    m = WideDeep(FIELDS, dense_dim=DENSE, embedding_dim=8,
+                 hidden_sizes=(16,))
+    m.eval()
+    path = str(tmp_path / "wd" / "model")
+    paddle.jit.save(m, path, input_spec=[
+        InputSpec([2, len(FIELDS)], dtype="int64"),
+        InputSpec([2, DENSE], dtype="float32")])
+    loaded = paddle.jit.load(path)
+    ids, dense, _ = _ctr_data(2, seed=7)
+    np.testing.assert_allclose(np.asarray(m(ids, dense)),
+                               np.asarray(loaded(ids, dense)),
+                               rtol=1e-5, atol=1e-5)

@@ -92,3 +92,19 @@ def test_copy_task_trains_and_beam_decodes():
     best = np.asarray(ids)[:, 0, :L]
     assert (best == body).mean() > 0.9, (best, body)
     assert np.all(np.diff(np.asarray(scores), axis=1) <= 1e-5)  # sorted
+
+
+def test_jit_save_load_mt(tmp_path):
+    from paddle_tpu.jit import InputSpec
+    m = _model()
+    m.eval()
+    path = str(tmp_path / "mt" / "model")
+    paddle.jit.save(m, path, input_spec=[
+        InputSpec([1, 7], dtype="int32"), InputSpec([1, 5], dtype="int32")])
+    loaded = paddle.jit.load(path)
+    rs = np.random.RandomState(3)
+    src = rs.randint(2, V, (1, 7)).astype("i4")
+    trg = rs.randint(2, V, (1, 5)).astype("i4")
+    np.testing.assert_allclose(np.asarray(m(src, trg)),
+                               np.asarray(loaded(src, trg)),
+                               rtol=1e-4, atol=1e-4)
