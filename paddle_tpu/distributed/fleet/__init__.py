@@ -219,8 +219,35 @@ class Fleet:
                                        self._strategy or DistributedStrategy())
 
     # -- checkpoint passthrough -------------------------------------------
-    def save_persistables(self, executor=None, dirname=None, main_program=None):
-        raise NotImplementedError("use paddle_tpu.save / distributed.checkpoint")
+    def save_persistables(self, executor=None, dirname=None,
+                          main_program=None, model=None):
+        """reference fleet_base.py save_persistables: persist trainable
+        state. Here the persistable state is (a) a Layer's state_dict
+        when ``model`` is given, else (b) the static global scope
+        (programs hold params in the Scope), plus (c) this rank's PS
+        shard if one is hosted (fleet.init_server)."""
+        import os
+
+        import numpy as np
+
+        if dirname is None:
+            raise ValueError("save_persistables needs dirname")
+        os.makedirs(dirname, exist_ok=True)
+        if model is not None:
+            from ... import save as _save
+            _save(model.state_dict(), os.path.join(dirname, "model.pdparams"))
+        else:
+            from ...static import global_scope
+            scope = global_scope()
+            state = {n: np.asarray(scope.find_var(n))
+                     for n in scope.local_var_names()
+                     if scope.find_var(n) is not None}
+            from ... import save as _save
+            _save(state, os.path.join(dirname, "scope.pdparams"))
+        if getattr(self, "_ps_server", None) is not None:
+            self._ps_server.table.save(
+                os.path.join(dirname, "sparse_shard.bin"))
+        return dirname
 
 
 from . import utils  # noqa: F401,E402

@@ -98,3 +98,35 @@ def test_gradient_merge_matches_big_batch():
 
     assert abs(l1 - l2) < 1e-6
     np.testing.assert_allclose(w2, w1, rtol=1e-5, atol=1e-6)
+
+
+class TestSavePersistables:
+    def test_model_scope_and_ps_shard(self, tmp_path, monkeypatch):
+        import os
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, static
+        from paddle_tpu.distributed import fleet as fleet_mod
+
+        f = fleet_mod.Fleet()
+        net = nn.Linear(4, 2)
+        out = f.save_persistables(dirname=str(tmp_path / "m"), model=net)
+        st = paddle.load(os.path.join(out, "model.pdparams"))
+        np.testing.assert_array_equal(np.asarray(st["weight"]),
+                                      np.asarray(net.weight.value))
+        # scope variant picks up static-program parameters
+        prog = static.Program.trace(
+            lambda x: static.nn.fc(x, 3), static.data("x", [2, 4]))
+        static.Executor().run(prog, feed={"x": np.ones((2, 4), "f4")})
+        out2 = f.save_persistables(dirname=str(tmp_path / "s"))
+        assert len(paddle.load(os.path.join(out2, "scope.pdparams"))) > 0
+        # hosted PS shard rides along
+        srv = f.init_server(dim=4, optimizer="sgd", port=0)
+        srv.table.pull(np.asarray([1, 2], np.int64))
+        out3 = f.save_persistables(dirname=str(tmp_path / "p"), model=net)
+        assert os.path.exists(os.path.join(out3, "sparse_shard.bin"))
+        f.stop_server()
+
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="dirname"):
+            f.save_persistables()
