@@ -134,6 +134,36 @@ class TestPipelineTrajectory:
         np.testing.assert_allclose(dense, hybrid, rtol=3e-4)
         assert dense[-1] < dense[0]
 
+    def test_pp_zero_composition_matches_dense(self):
+        """pipe=2 x sharding=2 x data=2 with ZeRO-1 optimizer-state
+        sharding composed with pipe-sharded stage params: 4-step
+        trajectory equals dense."""
+        descs = lambda: gpt_pipeline_descs(  # noqa: E731
+            tensor_parallel=False, tie_embeddings=True, **CFG)
+        x, y = _data()
+
+        build_mesh({"data": 1})
+        paddle.seed(7)
+        pl_d = PipelineLayer(descs(), num_stages=2, seg_method=SEG)
+        tr_d = ParallelTrainer(
+            pl_d, paddle.optimizer.Adam(1e-3,
+                                        parameters=pl_d.parameters()),
+            _loss_fn)
+        dense = [float(tr_d.train_step(x, y)) for _ in range(4)]
+
+        build_mesh({"data": 2, "pipe": 2, "sharding": 2})
+        paddle.seed(7)
+        pl_h = PipelineLayer(descs(), num_stages=2, seg_method=SEG)
+        topo = CommunicateTopology(("data", "pipe", "sharding", "model"),
+                                   (2, 2, 2, 1))
+        pp = PipelineParallel(pl_h, HybridCommunicateGroup(topo, 0),
+                              _Strat(2))
+        tr_h = ParallelTrainer(
+            pp, paddle.optimizer.Adam(1e-3, parameters=pp.parameters()),
+            _loss_fn, micro_batches=2, zero_stage=1)
+        hybrid = [float(tr_h.train_step(x, y)) for _ in range(4)]
+        np.testing.assert_allclose(dense, hybrid, rtol=3e-4)
+
     def test_pp_with_data_parallel_and_adam(self):
         """PP composed with DP under a stateful optimizer."""
         x, y = _data()
