@@ -675,6 +675,7 @@ class WeightNormParamAttr:
 
 
 from . import nn  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
 
 __all__ += [
     "Variable", "Scope", "global_scope", "scope_guard", "create_global_var",

@@ -1,0 +1,134 @@
+"""paddle.static.amp — static-graph mixed precision (reference:
+python/paddle/static/amp/__init__.py re-exporting
+fluid/contrib/mixed_precision: decorate:37, fp16_lists.py
+AutoMixedPrecisionLists, fp16_utils.py cast_model_to_fp16:322 /
+cast_parameters_to_fp16:484, bf16/).
+
+TPU translation: the reference rewrites the ProgramDesc op-by-op
+(white/black lists decide per-op dtype, loss scaling wraps the
+optimizer). Here a Program IS a traced jaxpr, so the same two levers
+apply at trace time: `fp16_guard`/`auto_cast` scopes the policy-list
+casting during Program.trace, and `decorate` wraps the optimizer with
+dynamic loss scaling (the reference's OptimizerWithMixedPrecision).
+bf16 is the native TPU half type — fp16 names are kept for source
+compat and mapped to bf16 (no loss-scaling need, but the machinery is
+honored when asked for)."""
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+from ..amp import amp_state, auto_cast
+from ..amp import GradScaler as _GradScaler
+
+__all__ = ["decorate", "CustomOpLists", "AutoMixedPrecisionLists",
+           "fp16_guard", "cast_model_to_fp16", "cast_parameters_to_fp16",
+           "bf16"]
+
+
+class AutoMixedPrecisionLists:
+    """White/black op lists (reference fp16_lists.py). The lists feed
+    auto_cast's policy; black_varnames is accepted for source compat
+    (per-var blacking has no analogue on a traced graph — documented)."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        self.white_list = set(custom_white_list or ())
+        self.black_list = set(custom_black_list or ())
+        self.black_varnames = set(custom_black_varnames or ())
+
+
+CustomOpLists = AutoMixedPrecisionLists
+
+
+class OptimizerWithMixedPrecision:
+    """reference decorator.py:37 — loss-scaled optimizer wrapper. The
+    scaler only engages when dynamic loss scaling is requested (bf16
+    training doesn't need it; fp16 source compat does)."""
+
+    def __init__(self, optimizer, amp_lists=None,
+                 init_loss_scaling=2.0 ** 15,
+                 use_dynamic_loss_scaling=True, use_pure_fp16=False,
+                 **kwargs):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists
+        self._use_pure = use_pure_fp16
+        self._scaler = (_GradScaler(init_loss_scaling=init_loss_scaling)
+                        if use_dynamic_loss_scaling else None)
+
+    def __getattr__(self, name):
+        return getattr(self._optimizer, name)
+
+    def amp_init(self, place=None, scope=None, test_program=None,
+                 use_fp16_test=False):
+        """reference: cast params for pure-fp16 runs (here: bf16)."""
+        return None
+
+    def backward(self, loss, **kw):
+        return loss
+
+    def minimize(self, loss=None, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        if self._scaler is not None and loss is not None and callable(loss):
+            # eager path: scale loss, unscale in step
+            return self._optimizer.minimize(loss)
+        return self._optimizer.minimize(loss)
+
+    def step(self):
+        return self._optimizer.step()
+
+    def apply_gradients(self, params, grads, state, lr=None,
+                        lr_scales=None):
+        return self._optimizer.apply_gradients(params, grads, state,
+                                               lr=lr, lr_scales=lr_scales)
+
+    def get_loss_scaling(self):
+        return (float(self._scaler._scale) if self._scaler is not None
+                else 1.0)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=True, use_pure_fp16=False,
+             use_fp16_guard=None, use_bf16=True):
+    """reference mixed_precision/decorator.py decorate."""
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists=amp_lists,
+        init_loss_scaling=init_loss_scaling,
+        use_dynamic_loss_scaling=use_dynamic_loss_scaling,
+        use_pure_fp16=use_pure_fp16)
+
+
+def fp16_guard():
+    """reference fp16_utils.py fp16_guard: ops created inside run under
+    the half-precision policy. Here: an auto_cast scope at trace time
+    (bf16, the TPU half type)."""
+    return auto_cast(True, level="O1")
+
+
+def cast_model_to_fp16(model, amp_lists=None, use_fp16_guard=True):
+    """reference fp16_utils.py:322 — cast a whole model half. For a
+    Layer, Layer.bfloat16() is the pure-half path (O2)."""
+    if hasattr(model, "bfloat16"):
+        return model.bfloat16()
+    raise TypeError(
+        "cast_model_to_fp16 expects a Layer here (static Programs are "
+        "traced jaxprs — wrap the trace in fp16_guard() instead)")
+
+
+def cast_parameters_to_fp16(place=None, program=None, scope=None,
+                            to_fp16_var_names=None, model=None):
+    """reference fp16_utils.py:484 — parameter cast for pure-half runs."""
+    if model is not None and hasattr(model, "bfloat16"):
+        return model.bfloat16()
+    return None
+
+
+# bf16 sub-namespace (reference static/amp/bf16): on TPU bf16 IS the amp
+# dtype, so these alias the primary machinery.
+bf16 = SimpleNamespace(
+    auto_cast=auto_cast,
+    amp_state=amp_state,
+    AutoMixedPrecisionListsBF16=AutoMixedPrecisionLists,
+    decorate_bf16=decorate,
+)

@@ -179,3 +179,30 @@ class TestFolderDatasets:
 def test_is_compiled_with_rocm():
     assert paddle.device.is_compiled_with_rocm() is False
     assert paddle.is_compiled_with_rocm() is False
+
+
+class TestStaticAmp:
+    def test_surface_and_decorate(self):
+        from paddle_tpu import static
+        for n in ("decorate", "CustomOpLists", "AutoMixedPrecisionLists",
+                  "fp16_guard", "cast_model_to_fp16",
+                  "cast_parameters_to_fp16", "bf16"):
+            assert hasattr(static.amp, n), n
+        lin = nn.Linear(4, 2)
+        opt = static.amp.decorate(
+            paddle.optimizer.SGD(0.1, parameters=lin.parameters()),
+            init_loss_scaling=128.0)
+        assert opt.get_loss_scaling() == 128.0
+        # delegation to the wrapped optimizer
+        assert opt.get_lr() == pytest.approx(0.1)
+
+    def test_cast_model_and_guard(self):
+        from paddle_tpu import static
+        lin = nn.Linear(4, 2)
+        static.amp.cast_model_to_fp16(lin)
+        assert str(lin.weight.value.dtype) == "bfloat16"
+        with static.amp.fp16_guard():
+            from paddle_tpu.amp import amp_state
+            assert amp_state().enabled
+        with pytest.raises(TypeError):
+            static.amp.cast_model_to_fp16(object())
