@@ -134,6 +134,18 @@ class TestPipelineTrajectory:
         np.testing.assert_allclose(dense, hybrid, rtol=3e-4)
         assert dense[-1] < dense[0]
 
+        # same composition under the 1F1B manual-VJP schedule
+        build_mesh({"data": 2, "pipe": 2, "model": 2})
+        paddle.seed(7)
+        pl_f = PipelineLayer(descs(), num_stages=2, seg_method=SEG)
+        ppf = PipelineParallel(pl_f, HybridCommunicateGroup(topo, 0),
+                               _Strat(2, "1f1b"))
+        tr_f = ParallelTrainer(
+            ppf, paddle.optimizer.SGD(0.05, parameters=ppf.parameters()),
+            loss_fn, micro_batches=2)
+        f1b = [float(tr_f.train_step(x, y)) for _ in range(4)]
+        np.testing.assert_allclose(dense, f1b, rtol=3e-4)
+
     def test_pp_zero_composition_matches_dense(self):
         """pipe=2 x sharding=2 x data=2 with ZeRO-1 optimizer-state
         sharding composed with pipe-sharded stage params: 4-step
