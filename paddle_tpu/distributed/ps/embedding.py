@@ -35,11 +35,17 @@ def make_lookup(table: SparseTable):
 
     def _pull(ids):
         def host_pull(ids_np):
+            # padded slots (-1) never touch the table: no phantom pulls of
+            # key 0 (which would create rows, skew entry-admission counts,
+            # and bump LRU stats for a feature that was never seen)
             ids_np = np.asarray(ids_np)
-            safe = np.where(ids_np < 0, 0, ids_np)
-            emb = table.pull(safe)
-            emb[ids_np < 0] = 0.0
-            return emb
+            flat = ids_np.reshape(-1)
+            valid = flat >= 0
+            emb = np.zeros((flat.size, dim), np.float32)
+            if valid.any():
+                emb[valid] = np.asarray(
+                    table.pull(flat[valid])).reshape(-1, dim)
+            return emb.reshape(ids_np.shape + (dim,))
 
         out = jax.ShapeDtypeStruct(tuple(ids.shape) + (dim,), jnp.float32)
         return jax.pure_callback(host_pull, out, ids)

@@ -160,20 +160,29 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     def _reader():
         in_q: "queue.Queue" = queue.Queue(maxsize=buffer_size)
         out_q: "queue.Queue" = queue.Queue(maxsize=buffer_size)
+        errs: list = []
 
         def feed():
-            for e in reader():
-                in_q.put(e)
-            for _ in range(process_num):
-                in_q.put(end)
+            try:
+                for e in reader():
+                    in_q.put(e)
+            except BaseException as e:
+                errs.append(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(end)
 
         def work():
-            while True:
-                e = in_q.get()
-                if e is end:
-                    out_q.put(end)
-                    return
-                out_q.put(mapper(e))
+            try:
+                while True:
+                    e = in_q.get()
+                    if e is end:
+                        return
+                    out_q.put(mapper(e))
+            except BaseException as e:
+                errs.append(e)
+            finally:
+                out_q.put(end)
 
         threading.Thread(target=feed, daemon=True).start()
         for _ in range(process_num):
@@ -185,6 +194,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 done += 1
                 continue
             yield e
+        if errs:
+            raise errs[0]
 
     return _reader
 
@@ -199,11 +210,14 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
 
     def _reader():
         q: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        errs: list = []
 
         def run(r):
             try:
                 for e in r():
                     q.put(e)
+            except BaseException as e:   # propagate, don't truncate
+                errs.append(e)
             finally:
                 q.put(end)
 
@@ -216,5 +230,7 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
                 done += 1
                 continue
             yield e
+        if errs:
+            raise errs[0]
 
     return _reader

@@ -47,13 +47,18 @@ class OptimizerWithMixedPrecision:
 
     def __init__(self, optimizer, amp_lists=None,
                  init_loss_scaling=2.0 ** 15,
-                 use_dynamic_loss_scaling=True, use_pure_fp16=False,
-                 **kwargs):
+                 incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+                 incr_ratio=2.0, decr_ratio=0.8,
+                 use_dynamic_loss_scaling=True, use_pure_fp16=False):
         self._optimizer = optimizer
         self._amp_lists = amp_lists
         self._use_pure = use_pure_fp16
-        self._scaler = (_GradScaler(init_loss_scaling=init_loss_scaling)
-                        if use_dynamic_loss_scaling else None)
+        self._scaler = (_GradScaler(
+            init_loss_scaling=init_loss_scaling,
+            incr_every_n_steps=incr_every_n_steps,
+            decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+            incr_ratio=incr_ratio, decr_ratio=decr_ratio)
+            if use_dynamic_loss_scaling else None)
 
     def __getattr__(self, name):
         return getattr(self._optimizer, name)
@@ -64,22 +69,46 @@ class OptimizerWithMixedPrecision:
         return None
 
     def backward(self, loss, **kw):
+        """Scale the loss before gradient computation (reference
+        decorator.py backward: loss * loss_scaling). Compute grads from
+        the RETURNED value; step()/minimize() unscale them."""
+        if self._scaler is not None:
+            return self._scaler.scale(loss)
         return loss
+
+    def step(self):
+        """Unscale Parameter.grad, skip the update on non-finite grads,
+        and advance the dynamic scale (reference decorator.py
+        apply_gradients: check_finite_and_unscale + update_loss_scaling)."""
+        if self._scaler is None:
+            return self._optimizer.step()
+        return self._scaler.step(self._optimizer)
 
     def minimize(self, loss=None, startup_program=None, parameters=None,
                  no_grad_set=None):
-        if self._scaler is not None and loss is not None and callable(loss):
-            # eager path: scale loss, unscale in step
-            return self._optimizer.minimize(loss)
-        return self._optimizer.minimize(loss)
-
-    def step(self):
-        return self._optimizer.step()
+        return self.step()
 
     def apply_gradients(self, params, grads, state, lr=None,
                         lr_scales=None):
-        return self._optimizer.apply_gradients(params, grads, state,
-                                               lr=lr, lr_scales=lr_scales)
+        """Functional path (jitted steps): unscale + finite-gate here."""
+        if self._scaler is None:
+            return self._optimizer.apply_gradients(params, grads, state,
+                                                   lr=lr,
+                                                   lr_scales=lr_scales)
+        import jax.numpy as jnp
+
+        grads, found_inf = self._scaler.unscale_(dict(grads))
+        new_p, new_s = self._optimizer.apply_gradients(
+            params, grads, state, lr=lr, lr_scales=lr_scales)
+        # non-finite step: keep old params AND optimizer state (inf grads
+        # would otherwise poison the moments) — traced-safe select
+        keep = jnp.asarray(found_inf)
+        import jax
+        new_p = jax.tree.map(lambda n, o: jnp.where(keep, o, n), new_p,
+                             dict(params))
+        new_s = jax.tree.map(lambda n, o: jnp.where(keep, o, n), new_s,
+                             state)
+        return new_p, new_s
 
     def get_loss_scaling(self):
         return (float(self._scaler._scale) if self._scaler is not None

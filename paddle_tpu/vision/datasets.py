@@ -262,15 +262,24 @@ class Flowers(Dataset):
             mode.lower()]
         self.indexes = setid[key].ravel()
         self.labels = labels
-        self._tar = tarfile.open(data_file, "r:*")
+        self._data_file = data_file
+        self._pid = None
         self._names = {os.path.basename(m.name): m
-                       for m in self._tar.getmembers() if m.isfile()}
+                       for m in self._open().getmembers() if m.isfile()}
+
+    def _open(self):
+        # one TarFile PER PROCESS: a fork-inherited handle shares its file
+        # offset across DataLoader workers (interleaved seeks corrupt reads)
+        if self._pid != os.getpid():
+            self._tar = tarfile.open(self._data_file, "r:*")
+            self._pid = os.getpid()
+        return self._tar
 
     def __getitem__(self, idx):
         from PIL import Image
         flower_id = int(self.indexes[idx])
         member = self._names[f"image_{flower_id:05d}.jpg"]
-        img = Image.open(self._tar.extractfile(member)).convert("RGB")
+        img = Image.open(self._open().extractfile(member)).convert("RGB")
         label = np.asarray(self.labels[flower_id - 1] - 1, dtype=np.int64)
         if self.transform is not None:
             img = self.transform(img)
@@ -294,25 +303,33 @@ class VOC2012(Dataset):
                 f"{data_file} not found; no network egress — place the "
                 f"VOC2012 tar locally")
         self.transform = transform
-        self._tar = tarfile.open(data_file, "r:*")
-        members = {m.name: m for m in self._tar.getmembers()}
+        self._data_file = data_file
+        self._pid = None
+        members = {m.name: m for m in self._open().getmembers()}
         mode = {"train": "train", "valid": "val", "test": "val",
                 "trainval": "trainval"}[mode.lower()]
         listname = next(n for n in members
                         if n.endswith(f"ImageSets/Segmentation/{mode}.txt"))
-        ids = self._tar.extractfile(members[listname]).read() \
+        ids = self._open().extractfile(members[listname]).read() \
             .decode().split()
         prefix = listname.split("ImageSets")[0]
         self._pairs = [(members[f"{prefix}JPEGImages/{i}.jpg"],
                         members[f"{prefix}SegmentationClass/{i}.png"])
                        for i in ids]
 
+    def _open(self):
+        # per-process TarFile — see Flowers._open
+        if self._pid != os.getpid():
+            self._tar = tarfile.open(self._data_file, "r:*")
+            self._pid = os.getpid()
+        return self._tar
+
     def __getitem__(self, idx):
         from PIL import Image
         im_m, lb_m = self._pairs[idx]
-        img = np.asarray(Image.open(self._tar.extractfile(im_m))
+        img = np.asarray(Image.open(self._open().extractfile(im_m))
                          .convert("RGB"))
-        label = np.asarray(Image.open(self._tar.extractfile(lb_m)))
+        label = np.asarray(Image.open(self._open().extractfile(lb_m)))
         if self.transform is not None:
             img = self.transform(img)
         return img, label

@@ -206,3 +206,23 @@ class TestStaticAmp:
             assert amp_state().enabled
         with pytest.raises(TypeError):
             static.amp.cast_model_to_fp16(object())
+
+    def test_loss_scaling_engages(self):
+        from paddle_tpu import static
+        lin = nn.Linear(1, 1, bias_attr=False)
+        opt = static.amp.decorate(
+            paddle.optimizer.SGD(1.0, parameters=lin.parameters()),
+            init_loss_scaling=4.0)
+        # backward() scales the loss by the live scale
+        assert float(opt.backward(jnp.asarray(1.0))) == pytest.approx(4.0)
+        # functional path: scaled grads are unscaled before the update
+        params = {"w": jnp.asarray([2.0])}
+        state = opt._optimizer.init_state(params)
+        scaled_g = {"w": jnp.asarray([4.0])}      # true grad 1.0, scale 4
+        new_p, state = opt.apply_gradients(params, scaled_g, state, lr=1.0)
+        assert float(new_p["w"][0]) == pytest.approx(1.0)   # 2 - 1*1
+        # non-finite grads: parameters and optimizer state keep old values
+        inf_g = {"w": jnp.asarray([jnp.inf])}
+        new_p2, state2 = opt.apply_gradients(new_p, inf_g, state, lr=1.0)
+        assert float(new_p2["w"][0]) == pytest.approx(1.0)
+        assert int(state2["step"]) == int(state["step"])

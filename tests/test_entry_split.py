@@ -104,3 +104,18 @@ class TestSplitAndWait:
     def test_datasets_reexported(self):
         assert dist.InMemoryDataset is not None
         assert dist.QueueDataset is not None
+
+
+def test_padded_ids_never_counted_or_created():
+    """-1 padding must not touch the table: no key-0 phantom pulls
+    (admission counts, row creation, LRU stats)."""
+    build_mesh({"data": 1})
+    paddle.seed(5)
+    entry = dist.CountFilterEntry(1)        # admit on first real sight
+    emb = DistributedEmbedding(4, "sgd", lr=1.0, init_range=0.0,
+                               entry=entry)
+    ids = np.asarray([[7, -1, -1, -1]], np.int64)
+    out = np.asarray(emb(ids))
+    np.testing.assert_array_equal(out[0, 1:], np.zeros((3, 4)))
+    assert len(emb.table) == 1              # only id 7, never key 0
+    assert entry.is_admitted(np.asarray([0]))[0] == False  # noqa: E712

@@ -76,3 +76,23 @@ def test_multiprocess_reader_interleaves_all():
 
 def test_top_level_namespace():
     assert paddle.reader.buffered is reader.buffered
+
+
+def test_xmap_unordered_propagates_errors_without_hanging():
+    bad = lambda x: 1 // x
+    src = lambda: iter([1, 0, 2])
+    with pytest.raises(ZeroDivisionError):
+        list(reader.xmap_readers(bad, src, 2, 4)())
+    def broken_reader():
+        yield 1
+        raise RuntimeError("src boom")
+    with pytest.raises(RuntimeError, match="src boom"):
+        list(reader.xmap_readers(lambda x: x, broken_reader, 2, 4)())
+
+
+def test_multiprocess_reader_propagates_errors():
+    def broken():
+        yield 1
+        raise RuntimeError("dead reader")
+    with pytest.raises(RuntimeError, match="dead reader"):
+        list(reader.multiprocess_reader([_r(3), broken])())
