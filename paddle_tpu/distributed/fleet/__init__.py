@@ -219,6 +219,32 @@ class Fleet:
                                        self._strategy or DistributedStrategy())
 
     # -- checkpoint passthrough -------------------------------------------
+    def save(self, dirname, feed=(), fetch=(), model=None, input_spec=None,
+             **configs):
+        """reference fleet_base.py:654 save — persistables when no
+        feed/fetch are given, else an inference artifact. Here the
+        inference artifact is the StableHLO export (jit.save) of
+        ``model`` traced at ``input_spec``."""
+        if not feed and not fetch and input_spec is None:
+            return self.save_persistables(dirname=dirname, model=model)
+        if model is None:
+            raise ValueError("fleet.save(inference) needs model= (a Layer) "
+                             "and input_spec=[InputSpec...]")
+        import os
+        from ... import jit as _jit
+        path = os.path.join(dirname, "model")
+        _jit.save(model, path, input_spec=list(input_spec or ()))
+        return path
+
+    def save_inference_model(self, executor=None, dirname=None,
+                             feeded_var_names=None, target_vars=None,
+                             main_program=None, export_for_deployment=True,
+                             model=None, input_spec=None):
+        """reference fleet_base.py:697 (deprecated alias of save)."""
+        return self.save(dirname, feed=feeded_var_names or ("x",),
+                         fetch=target_vars or ("out",), model=model,
+                         input_spec=input_spec)
+
     def save_persistables(self, executor=None, dirname=None,
                           main_program=None, model=None):
         """reference fleet_base.py save_persistables: persist trainable

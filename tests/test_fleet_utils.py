@@ -130,3 +130,31 @@ class TestSavePersistables:
         import pytest as _pytest
         with _pytest.raises(ValueError, match="dirname"):
             f.save_persistables()
+
+
+class TestFleetSave:
+    def test_save_persistables_and_inference_paths(self, tmp_path):
+        import os
+        import numpy as np
+        import jax.numpy as jnp
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.distributed import fleet as fleet_mod
+        from paddle_tpu.jit import InputSpec
+
+        f = fleet_mod.Fleet()
+        net = nn.Linear(4, 2)
+        net.eval()
+        # no feed/fetch -> persistables
+        out = f.save(str(tmp_path / "pers"), model=net)
+        assert os.path.exists(os.path.join(out, "model.pdparams"))
+        # with input_spec -> StableHLO inference artifact, loadable
+        path = f.save(str(tmp_path / "inf"), model=net,
+                      input_spec=[InputSpec([1, 4])])
+        loaded = paddle.jit.load(path)
+        x = jnp.ones((1, 4))
+        np.testing.assert_allclose(np.asarray(net(x)),
+                                   np.asarray(loaded(x)), rtol=1e-5)
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="model"):
+            f.save(str(tmp_path / "bad"), feed=["x"], fetch=["out"])
