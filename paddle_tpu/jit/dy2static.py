@@ -353,6 +353,53 @@ def _has_exits(stmts):
     return found
 
 
+def _rewrite_exits(stmts, brk, cont):
+    """Lower this loop's OWN break/continue into flag assignments
+    (``brk``/``cont`` = True); statements following a possibly-flagging
+    If are wrapped in ``if not (brk or cont): ...`` so the rest of the
+    iteration is skipped. Nested loops own their exits and are left
+    alone; code after a bare break/continue is unreachable and dropped.
+    Returns the new statement list."""
+    def flag_set(name):
+        return ast.Assign(targets=[_name(name, ast.Store())],
+                          value=_const(True))
+
+    def skip_guard(rest):
+        test = ast.UnaryOp(
+            op=ast.Not(),
+            operand=ast.BoolOp(op=ast.Or(),
+                               values=[_name(brk), _name(cont)]))
+        return ast.If(test=test, body=rest, orelse=[])
+
+    out = []
+    for i, s in enumerate(stmts):
+        if isinstance(s, ast.Break):
+            out.append(ast.copy_location(flag_set(brk), s))
+            return out                      # rest of block unreachable
+        if isinstance(s, ast.Continue):
+            out.append(ast.copy_location(flag_set(cont), s))
+            return out
+        if isinstance(s, ast.If):
+            body = _rewrite_exits(s.body, brk, cont)
+            orelse = _rewrite_exits(s.orelse, brk, cont)
+            flagged = (body != s.body or orelse != s.orelse)
+            s = ast.copy_location(
+                ast.If(test=s.test, body=body or [ast.Pass()],
+                       orelse=orelse), s)
+            ast.fix_missing_locations(s)
+            out.append(s)
+            rest = stmts[i + 1:]
+            if flagged and rest:
+                g = skip_guard(_rewrite_exits(rest, brk, cont))
+                ast.copy_location(g, s)
+                ast.fix_missing_locations(g)
+                out.append(g)
+                return out
+            continue
+        out.append(s)
+    return out
+
+
 def _name(id_, ctx=None):
     return ast.Name(id=id_, ctx=ctx or ast.Load())
 
@@ -486,19 +533,79 @@ class _Transformer(ast.NodeTransformer):
         return [ast.copy_location(ast.fix_missing_locations(s), node)
                 for s in stmts]
 
+    def _lower_loop_exits(self, node):
+        """Try to lower break/continue in a raw (pre-visit) loop body into
+        flag form. Returns (new_body, new_test_wrapper, setup_stmts) or
+        None when lowering doesn't apply (returns present, or exits
+        hiding where the rewriter can't reach, e.g. under with/try)."""
+        exits = _has_exits(node.body)
+        if not exits:
+            return None
+        if "return" in exits:
+            return None
+        # single-underscore prefix: unlike __dy2s_* temporaries, the flags
+        # MUST be visible to _assigned_names so convert_ifelse branches
+        # and the while state thread them through
+        self.counter += 1
+        brk = f"_dy2s_brk_{self.counter}"
+        self.counter += 1
+        cont = f"_dy2s_cont_{self.counter}"
+        body = _rewrite_exits(list(node.body), brk, cont)
+        if _has_exits(body):
+            return None
+        false_c = _const(False)
+        reset_cont = ast.Assign(targets=[_name(cont, ast.Store())],
+                                value=false_c)
+        init = [ast.Assign(targets=[_name(f, ast.Store())], value=false_c)
+                for f in (brk, cont)]
+        for s in init + [reset_cont]:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+
+        def wrap_test(test):
+            t = ast.BoolOp(op=ast.And(),
+                           values=[ast.UnaryOp(op=ast.Not(),
+                                               operand=_name(brk)), test])
+            ast.copy_location(t, test)
+            return ast.fix_missing_locations(t)
+
+        return [reset_cont] + body, wrap_test, init
+
     def visit_While(self, node):
-        self.generic_visit(node)
         if node.orelse:
+            self.generic_visit(node)
             return node  # while/else: Python-only construct, leave as-is
+        setup = []
+        lowered = self._lower_loop_exits(node)
+        if lowered is not None:
+            body, wrap_test, setup = lowered
+            node = ast.copy_location(
+                ast.While(test=wrap_test(node.test), body=body, orelse=[]),
+                node)
+            ast.fix_missing_locations(node)
+        self.generic_visit(node)
         if _has_exits(node.body):
             node.test = ast.copy_location(
                 _call("assert_python_value",
                       [node.test, _const("while"), _const(self.filename),
                        _const(node.lineno)]), node.test)
-            return node
-        return self._while_form(node, node.test, node.body)
+            return setup + [node] if setup else node
+        return setup + self._while_form(node, node.test, node.body)
 
     def visit_For(self, node):
+        setup_exits = []
+        test_wrap = None
+        if (isinstance(node.target, ast.Name) and not node.orelse
+                and isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"):
+            lowered = self._lower_loop_exits(node)
+            if lowered is not None:
+                body, test_wrap, setup_exits = lowered
+                node = ast.copy_location(
+                    ast.For(target=node.target, iter=node.iter, body=body,
+                            orelse=[], type_comment=None), node)
+                ast.fix_missing_locations(node)
         self.generic_visit(node)
         is_range = (isinstance(node.iter, ast.Call)
                     and isinstance(node.iter.func, ast.Name)
@@ -518,6 +625,17 @@ class _Transformer(ast.NodeTransformer):
                           [a, _const("for"), _const(self.filename),
                            _const(node.lineno)]), a)
                     for a in node.iter.args]
+            if setup_exits:
+                # lowered flag form still runs correctly in Python, but it
+                # needs its not-yet-staged test guard: reinstate a plain
+                # break on the flag at body top
+                node.body.insert(0, ast.copy_location(
+                    ast.fix_missing_locations(ast.If(
+                        test=self.visit(ast.Name(
+                            id=setup_exits[0].targets[0].id,
+                            ctx=ast.Load())),
+                        body=[ast.Break()], orelse=[])), node))
+                return setup_exits + [node]
             return node
         t = node.target.id
         start_n, stop_n, step_n, it_n = (self._n("start"), self._n("stop"),
@@ -551,11 +669,16 @@ class _Transformer(ast.NodeTransformer):
         # (Python range semantics), instead of leaking the post-increment
         test = _call("range_cond", [_name(it_n), _name(stop_n),
                                     _name(step_n)])
+        if test_wrap is not None:
+            # break support: test becomes (not brk) and range_cond(...);
+            # re-visit so the BoolOp/Not lower to the convert_* helpers
+            test = self.visit(ast.fix_missing_locations(
+                ast.copy_location(test_wrap(test), node)))
         set_t = ast.Assign(targets=[_name(t, ast.Store())],
                            value=_name(it_n))
         inc = ast.AugAssign(target=_name(it_n, ast.Store()), op=ast.Add(),
                             value=_name(step_n))
-        return setup + self._while_form(
+        return setup_exits + setup + self._while_form(
             node, test, [set_t] + list(node.body) + [inc],
             extra_loop_names=(it_n, t))
 

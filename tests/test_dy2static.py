@@ -158,13 +158,67 @@ class TestLoops:
         g = jax.jit(convert_function(f), static_argnums=1)
         np.testing.assert_allclose(g(jnp.ones(()), 3), 8.0)
 
-    def test_break_diagnostic(self):
+    def test_break_in_tensor_while_staged(self):
+        """break inside a traced while now lowers to a loop-carried flag
+        (reference break_continue_transformer) instead of erroring."""
         def f(x):
             s = jnp.zeros(())
             while s < 10.0:
                 s = s + jnp.sum(x)
                 if s > 5.0:
                     break
+            return s
+
+        # s: 3 -> 6 (>5, break) — without break it would run to 12
+        assert float(jax.jit(convert_function(f))(jnp.ones((3,)))) == 6.0
+
+    def test_break_in_for_range_staged(self):
+        def f(x):
+            found = jnp.zeros(())
+            for i in range(5):
+                if x[i] > 0.5:
+                    found = x[i]
+                    break
+            return found
+
+        x = jnp.asarray([0.1, 0.7, 0.9, 0.2, 0.8])
+        assert float(jax.jit(convert_function(f))(x)) == \
+            pytest.approx(0.7)  # first hit, NOT overwritten by 0.9/0.8
+
+    def test_continue_in_for_range_staged(self):
+        def f(x):
+            s = jnp.zeros(())
+            for i in range(5):
+                if x[i] < 0:
+                    continue
+                s = s + x[i]
+            return s
+
+        x = jnp.asarray([1.0, -2.0, 3.0, -4.0, 5.0])
+        assert float(jax.jit(convert_function(f))(x)) == pytest.approx(9.0)
+
+    def test_break_and_continue_mixed(self):
+        def f(x):
+            s = jnp.zeros(())
+            for i in range(6):
+                if x[i] < 0:
+                    continue
+                if s > 4.0:
+                    break
+                s = s + x[i]
+            return s
+
+        # adds 1, skips -1, adds 2, adds 3 (s=6 > 4), breaks before 10
+        x = jnp.asarray([1.0, -1.0, 2.0, 3.0, 10.0, 20.0])
+        assert float(jax.jit(convert_function(f))(x)) == pytest.approx(6.0)
+
+    def test_return_in_tensor_while_still_diagnosed(self):
+        def f(x):
+            s = jnp.zeros(())
+            while s < 10.0:
+                s = s + jnp.sum(x)
+                if s > 5.0:
+                    return s
             return s
 
         with pytest.raises(Dy2StaticError, match="return/break/continue"):
