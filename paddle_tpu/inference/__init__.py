@@ -169,3 +169,72 @@ def create_predictor(config: Config) -> Predictor:
 
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor"]
+
+
+class DataType:
+    """reference paddle_infer_declare.h PaddleDType — dtype tags carried
+    by inference Tensors."""
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"  # source-compat tag; TPU half type is bfloat16
+    BFLOAT16 = "bfloat16"
+    INT64 = "int64"
+    INT32 = "int32"
+    UINT8 = "uint8"
+    INT8 = "int8"
+    BOOL = "bool"
+
+
+class PlaceType:
+    """reference place tags; XLA owns placement here."""
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    TPU = 3
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+_DTYPE_BYTES = {DataType.FLOAT32: 4, DataType.FLOAT16: 2,
+                DataType.BFLOAT16: 2, DataType.INT64: 8,
+                DataType.INT32: 4, DataType.UINT8: 1, DataType.INT8: 1,
+                DataType.BOOL: 1}
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    """reference inference/api/paddle_inference_api.h."""
+    try:
+        return _DTYPE_BYTES[dtype]
+    except KeyError:
+        raise ValueError(f"unknown inference DataType {dtype!r}") from None
+
+
+def get_version() -> str:
+    from .. import __version__
+    return f"paddle_tpu {__version__}"
+
+
+class PredictorPool:
+    """reference paddle_infer::services::PredictorPool — N predictors over
+    one config for concurrent serving threads. Predictors share the
+    compiled executable (jit cache); each retains its own IO handles."""
+
+    def __init__(self, config: Config, size: int = 1):
+        if size < 1:
+            raise ValueError("pool size must be >= 1")
+        self._preds = [create_predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._preds[idx]
+
+    def __len__(self):
+        return len(self._preds)
+
+
+__all__ += ["DataType", "PlaceType", "PrecisionType", "PredictorPool",
+            "get_num_bytes_of_data_type", "get_version"]

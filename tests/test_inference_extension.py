@@ -140,3 +140,39 @@ def test_setup_builds_extension(tmp_path):
         "one_test", cpp_extension.CppExtension([str(src)], name="one_test"))
     x = np.array([1.0, -2.0], dtype=np.float32)
     np.testing.assert_array_equal(np.asarray(mod.neg(x)), [-1.0, 2.0])
+
+
+def test_inference_type_tags_and_pool(tmp_path):
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import inference, nn
+    from paddle_tpu.jit import InputSpec
+
+    assert inference.get_num_bytes_of_data_type(
+        inference.DataType.FLOAT32) == 4
+    assert inference.get_num_bytes_of_data_type(
+        inference.DataType.INT64) == 8
+    with pytest.raises(ValueError):
+        inference.get_num_bytes_of_data_type("float128")
+    assert "paddle_tpu" in inference.get_version()
+
+    net = nn.Linear(4, 2)
+    net.eval()
+    path = str(tmp_path / "m" / "model")
+    paddle.jit.save(net, path, input_spec=[InputSpec([1, 4])])
+    cfg = inference.Config(path)
+    pool = inference.PredictorPool(cfg, 2)
+    assert len(pool) == 2
+    x = np.ones((1, 4), "float32")
+    outs = []
+    for i in range(2):
+        p = pool.retrieve(i)
+        inp = p.get_input_handle(p.get_input_names()[0])
+        inp.copy_from_cpu(x)
+        p.run()
+        outs.append(p.get_output_handle(
+            p.get_output_names()[0]).copy_to_cpu())
+    np.testing.assert_allclose(outs[0], outs[1])
+    with pytest.raises(ValueError):
+        inference.PredictorPool(cfg, 0)
