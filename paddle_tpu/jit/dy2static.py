@@ -194,6 +194,29 @@ def init_loop_var(cur, fallback):
     return fallback if cur is UNDEFINED else cur
 
 
+def is_tensor(x):
+    """Runtime dispatch for `for v in X`: jax arrays (incl. tracers) take
+    the staged row-loop, everything else the plain Python loop."""
+    import jax
+    return isinstance(x, jax.Array)
+
+
+def tensor_len(x):
+    """Leading-axis length of a tensor — static under trace."""
+    if not getattr(x, "shape", ()):
+        raise Dy2StaticError(
+            "cannot iterate a 0-d tensor in a converted function")
+    return x.shape[0]
+
+
+def row_init(x):
+    """Typed pre-loop init for the row variable of a staged
+    `for v in tensor` (while_loop needs an initial value for every
+    body-assigned name; the first iteration overwrites it)."""
+    import jax.numpy as jnp
+    return jnp.zeros(x.shape[1:], x.dtype)
+
+
 def normalize_range(*args):
     if len(args) == 1:
         return 0, args[0], 1
@@ -592,9 +615,57 @@ class _Transformer(ast.NodeTransformer):
             return setup + [node] if setup else node
         return setup + self._while_form(node, node.test, node.body)
 
+    def _rewrite_tensor_iter(self, node):
+        """`for v in X:` (X not a range call) -> runtime dual form:
+        is_tensor(X) dispatches between a STAGED row loop
+        (for __row in range(tensor_len(X)): v = X[__row]; body) and the
+        original Python loop. Both copies are then transformed normally;
+        the Python copy is marked to stop re-rewriting."""
+        x = self._n("iterable")
+        row = self._n("row")
+        assign_x = ast.Assign(targets=[_name(x, ast.Store())],
+                              value=node.iter)
+        set_v = ast.Assign(
+            targets=[ast.Name(id=node.target.id, ctx=ast.Store())],
+            value=ast.Subscript(value=_name(x), slice=_name(row),
+                                ctx=ast.Load()))
+        import copy as _copy
+        init_v = ast.Assign(
+            targets=[ast.Name(id=node.target.id, ctx=ast.Store())],
+            value=_call("row_init", [_name(x)]))
+        tensor_for = ast.For(
+            target=_name(row, ast.Store()),
+            iter=ast.Call(func=ast.Name(id="range", ctx=ast.Load()),
+                          args=[_call("tensor_len", [_name(x)])],
+                          keywords=[]),
+            body=[set_v] + _copy.deepcopy(node.body), orelse=[],
+            type_comment=None)
+        tensor_branch = [init_v, tensor_for]
+        python_for = ast.For(target=node.target, iter=_name(x),
+                             body=node.body, orelse=[], type_comment=None)
+        python_for._dy2s_plain = True
+        dispatch = ast.If(test=_call("is_tensor", [_name(x)]),
+                          body=tensor_branch, orelse=[python_for])
+        out = []
+        for s in (assign_x, dispatch):
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+            v = self.visit(s)
+            out.extend(v if isinstance(v, list) else [v])
+        return out
+
     def visit_For(self, node):
         setup_exits = []
         test_wrap = None
+        is_range_call = (isinstance(node.iter, ast.Call)
+                         and isinstance(node.iter.func, ast.Name)
+                         and node.iter.func.id == "range")
+        if (isinstance(node.target, ast.Name) and not node.orelse
+                and not is_range_call
+                and not getattr(node, "_dy2s_plain", False)
+                and not isinstance(node.iter, (ast.List, ast.Tuple,
+                                               ast.Dict, ast.Set))):
+            return self._rewrite_tensor_iter(node)
         if (isinstance(node.target, ast.Name) and not node.orelse
                 and isinstance(node.iter, ast.Call)
                 and isinstance(node.iter.func, ast.Name)

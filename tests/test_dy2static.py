@@ -485,3 +485,60 @@ class TestToStaticIntegration:
             assert paddle.jit.to_static(f) is f
         finally:
             ProgramTranslator.get_instance().enable(True)
+
+
+class TestTensorIteration:
+    def test_for_over_tensor_rows_staged(self):
+        def f(x):
+            s = jnp.zeros(x.shape[1])
+            for row in x:
+                s = s + row
+            return s
+
+        x = jnp.asarray(np.arange(12, dtype="f4").reshape(4, 3))
+        out = jax.jit(convert_function(f))(x)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(x).sum(0))
+
+    def test_for_over_tensor_with_break(self):
+        def f(x):
+            s = jnp.zeros(())
+            for v in x:
+                if v > 2.5:
+                    break
+                s = s + v
+            return s
+
+        x = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        assert float(jax.jit(convert_function(f))(x)) == pytest.approx(3.0)
+
+    def test_python_iterables_untouched(self):
+        def f(x):
+            s = x
+            for v in [1.0, 2.0, 3.0]:
+                s = s + v
+            total = 0.0
+            for v in (10, 20):
+                total += v
+            return s + total
+
+        assert float(convert_function(f)(jnp.zeros(()))) == 36.0
+
+    def test_list_expression_iter_dispatches_python(self):
+        def f(x, items):
+            s = x
+            for v in items:
+                s = s + v
+            return s
+
+        assert float(convert_function(f)(jnp.zeros(()), [1, 2, 3])) == 6.0
+
+    def test_zero_dim_tensor_iteration_diagnosed(self):
+        def f(x):
+            s = jnp.zeros(())
+            for v in x:
+                s = s + v
+            return s
+
+        with pytest.raises(Dy2StaticError, match="0-d"):
+            convert_function(f)(jnp.asarray(1.0))
