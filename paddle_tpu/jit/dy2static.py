@@ -654,12 +654,66 @@ class _Transformer(ast.NodeTransformer):
             out.extend(v if isinstance(v, list) else [v])
         return out
 
+    def _rewrite_tensor_enumerate(self, node):
+        """`for i, v in enumerate(X):` -> the same runtime dual form as
+        _rewrite_tensor_iter, with the index bound inside the staged row
+        loop (reference test_for_enumerate.py capability)."""
+        i_name = node.target.elts[0].id
+        v_name = node.target.elts[1].id
+        x = self._n("iterable")
+        row = self._n("row")
+        src = node.iter.args[0]
+        assign_x = ast.Assign(targets=[_name(x, ast.Store())], value=src)
+        import copy as _copy
+        init_i = ast.Assign(targets=[ast.Name(id=i_name, ctx=ast.Store())],
+                            value=_const(0))
+        init_v = ast.Assign(targets=[ast.Name(id=v_name, ctx=ast.Store())],
+                            value=_call("row_init", [_name(x)]))
+        set_i = ast.Assign(targets=[ast.Name(id=i_name, ctx=ast.Store())],
+                           value=_name(row))
+        set_v = ast.Assign(
+            targets=[ast.Name(id=v_name, ctx=ast.Store())],
+            value=ast.Subscript(value=_name(x), slice=_name(row),
+                                ctx=ast.Load()))
+        tensor_for = ast.For(
+            target=_name(row, ast.Store()),
+            iter=ast.Call(func=ast.Name(id="range", ctx=ast.Load()),
+                          args=[_call("tensor_len", [_name(x)])],
+                          keywords=[]),
+            body=[set_i, set_v] + _copy.deepcopy(node.body), orelse=[],
+            type_comment=None)
+        python_for = ast.For(
+            target=node.target,
+            iter=ast.Call(func=ast.Name(id="enumerate", ctx=ast.Load()),
+                          args=[_name(x)], keywords=[]),
+            body=node.body, orelse=[], type_comment=None)
+        python_for._dy2s_plain = True
+        dispatch = ast.If(test=_call("is_tensor", [_name(x)]),
+                          body=[init_i, init_v, tensor_for],
+                          orelse=[python_for])
+        out = []
+        for s in (assign_x, dispatch):
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+            v = self.visit(s)
+            out.extend(v if isinstance(v, list) else [v])
+        return out
+
     def visit_For(self, node):
         setup_exits = []
         test_wrap = None
         is_range_call = (isinstance(node.iter, ast.Call)
                          and isinstance(node.iter.func, ast.Name)
                          and node.iter.func.id == "range")
+        if (isinstance(node.target, ast.Tuple) and not node.orelse
+                and len(node.target.elts) == 2
+                and all(isinstance(e, ast.Name) for e in node.target.elts)
+                and not getattr(node, "_dy2s_plain", False)
+                and isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "enumerate"
+                and len(node.iter.args) == 1 and not node.iter.keywords):
+            return self._rewrite_tensor_enumerate(node)
         if (isinstance(node.target, ast.Name) and not node.orelse
                 and not is_range_call
                 and not getattr(node, "_dy2s_plain", False)

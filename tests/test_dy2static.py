@@ -542,3 +542,25 @@ class TestTensorIteration:
 
         with pytest.raises(Dy2StaticError, match="0-d"):
             convert_function(f)(jnp.asarray(1.0))
+
+    def test_enumerate_over_tensor_staged(self):
+        # ref: test_for_enumerate.py
+        def f(x):
+            s = jnp.zeros(())
+            for i, v in enumerate(x):
+                s = s + v * i
+            return s
+
+        x = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        expect = sum(i * float(v) for i, v in enumerate(np.asarray(x)))
+        assert float(jax.jit(convert_function(f))(x)) == \
+            pytest.approx(expect)
+
+    def test_enumerate_over_list_stays_python(self):
+        def f(x, items):
+            s = x
+            for i, v in enumerate(items):
+                s = s + v * (i + 1)
+            return s
+
+        assert float(convert_function(f)(jnp.zeros(()), [1.0, 2.0])) == 5.0
