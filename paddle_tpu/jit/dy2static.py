@@ -699,6 +699,54 @@ class _Transformer(ast.NodeTransformer):
             out.extend(v if isinstance(v, list) else [v])
         return out
 
+    def _rewrite_tensor_zip(self, node):
+        """`for a, b[, c] in zip(X, Y[, Z]):` -> runtime dual form; the
+        staged branch row-loops over the min leading length (zip
+        semantics), requiring EVERY argument to be a tensor."""
+        names = [e.id for e in node.target.elts]
+        xs = [self._n("iterable") for _ in names]
+        row = self._n("row")
+        assigns = [ast.Assign(targets=[_name(x, ast.Store())], value=a)
+                   for x, a in zip(xs, node.iter.args)]
+        import copy as _copy
+        inits = [ast.Assign(targets=[ast.Name(id=n, ctx=ast.Store())],
+                            value=_call("row_init", [_name(x)]))
+                 for n, x in zip(names, xs)]
+        sets = [ast.Assign(
+            targets=[ast.Name(id=n, ctx=ast.Store())],
+            value=ast.Subscript(value=_name(x), slice=_name(row),
+                                ctx=ast.Load()))
+            for n, x in zip(names, xs)]
+        min_len = ast.Call(
+            func=ast.Name(id="min", ctx=ast.Load()),
+            args=[_call("tensor_len", [_name(x)]) for x in xs],
+            keywords=[])
+        tensor_for = ast.For(
+            target=_name(row, ast.Store()),
+            iter=ast.Call(func=ast.Name(id="range", ctx=ast.Load()),
+                          args=[min_len], keywords=[]),
+            body=sets + _copy.deepcopy(node.body), orelse=[],
+            type_comment=None)
+        python_for = ast.For(
+            target=node.target,
+            iter=ast.Call(func=ast.Name(id="zip", ctx=ast.Load()),
+                          args=[_name(x) for x in xs], keywords=[]),
+            body=node.body, orelse=[], type_comment=None)
+        python_for._dy2s_plain = True
+        test = _call("is_tensor", [_name(xs[0])])
+        for x in xs[1:]:
+            test = ast.BoolOp(op=ast.And(),
+                              values=[test, _call("is_tensor", [_name(x)])])
+        dispatch = ast.If(test=test, body=inits + [tensor_for],
+                          orelse=[python_for])
+        out = []
+        for s in assigns + [dispatch]:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+            v = self.visit(s)
+            out.extend(v if isinstance(v, list) else [v])
+        return out
+
     def visit_For(self, node):
         setup_exits = []
         test_wrap = None
@@ -714,6 +762,16 @@ class _Transformer(ast.NodeTransformer):
                 and node.iter.func.id == "enumerate"
                 and len(node.iter.args) == 1 and not node.iter.keywords):
             return self._rewrite_tensor_enumerate(node)
+        if (isinstance(node.target, ast.Tuple) and not node.orelse
+                and len(node.target.elts) in (2, 3)
+                and all(isinstance(e, ast.Name) for e in node.target.elts)
+                and not getattr(node, "_dy2s_plain", False)
+                and isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "zip"
+                and len(node.iter.args) == len(node.target.elts)
+                and not node.iter.keywords):
+            return self._rewrite_tensor_zip(node)
         if (isinstance(node.target, ast.Name) and not node.orelse
                 and not is_range_call
                 and not getattr(node, "_dy2s_plain", False)

@@ -564,3 +564,25 @@ class TestTensorIteration:
             return s
 
         assert float(convert_function(f)(jnp.zeros(()), [1.0, 2.0])) == 5.0
+
+    def test_zip_over_tensors_staged(self):
+        def f(x, y):
+            s = jnp.zeros(())
+            for a, b in zip(x, y):
+                s = s + a * b
+            return s
+
+        x = jnp.asarray([1.0, 2.0, 3.0])
+        y = jnp.asarray([4.0, 5.0, 6.0, 7.0])   # min-length semantics
+        assert float(jax.jit(convert_function(f))(x, y)) == \
+            pytest.approx(1 * 4 + 2 * 5 + 3 * 6)
+
+    def test_zip_over_lists_stays_python(self):
+        def f(x, a, b):
+            s = x
+            for u, v in zip(a, b):
+                s = s + u * v
+            return s
+
+        assert float(convert_function(f)(
+            jnp.zeros(()), [1.0, 2.0], [3.0, 4.0])) == 11.0
