@@ -197,15 +197,15 @@ def init_loop_var(cur, fallback):
 def is_tensor(x):
     """Runtime dispatch for `for v in X`: jax arrays (incl. tracers) take
     the staged row-loop, everything else the plain Python loop."""
-    import jax
     return isinstance(x, jax.Array)
 
 
-def tensor_len(x):
+def tensor_len(x, filename="<dy2static>", lineno=0):
     """Leading-axis length of a tensor — static under trace."""
     if not getattr(x, "shape", ()):
         raise Dy2StaticError(
-            "cannot iterate a 0-d tensor in a converted function")
+            f"{_loc(filename, lineno)}: cannot iterate a 0-d tensor in a "
+            "converted function")
     return x.shape[0]
 
 
@@ -213,8 +213,16 @@ def row_init(x):
     """Typed pre-loop init for the row variable of a staged
     `for v in tensor` (while_loop needs an initial value for every
     body-assigned name; the first iteration overwrites it)."""
-    import jax.numpy as jnp
     return jnp.zeros(x.shape[1:], x.dtype)
+
+
+def row_at(x, i):
+    """x[i] made trace-safe for a 0-row tensor: the staged loop body is
+    TRACED even when the (static) trip count is zero, and indexing a
+    size-0 axis raises at trace time although the body never runs."""
+    if x.shape[0] == 0:
+        return jnp.zeros(x.shape[1:], x.dtype)
+    return x[i]
 
 
 def normalize_range(*args):
@@ -615,123 +623,75 @@ class _Transformer(ast.NodeTransformer):
             return setup + [node] if setup else node
         return setup + self._while_form(node, node.test, node.body)
 
-    def _rewrite_tensor_iter(self, node):
-        """`for v in X:` (X not a range call) -> runtime dual form:
-        is_tensor(X) dispatches between a STAGED row loop
-        (for __row in range(tensor_len(X)): v = X[__row]; body) and the
-        original Python loop. Both copies are then transformed normally;
-        the Python copy is marked to stop re-rewriting."""
-        x = self._n("iterable")
-        row = self._n("row")
-        assign_x = ast.Assign(targets=[_name(x, ast.Store())],
-                              value=node.iter)
-        set_v = ast.Assign(
-            targets=[ast.Name(id=node.target.id, ctx=ast.Store())],
-            value=ast.Subscript(value=_name(x), slice=_name(row),
-                                ctx=ast.Load()))
-        import copy as _copy
-        init_v = ast.Assign(
-            targets=[ast.Name(id=node.target.id, ctx=ast.Store())],
-            value=_call("row_init", [_name(x)]))
-        tensor_for = ast.For(
-            target=_name(row, ast.Store()),
-            iter=ast.Call(func=ast.Name(id="range", ctx=ast.Load()),
-                          args=[_call("tensor_len", [_name(x)])],
-                          keywords=[]),
-            body=[set_v] + _copy.deepcopy(node.body), orelse=[],
-            type_comment=None)
-        tensor_branch = [init_v, tensor_for]
-        python_for = ast.For(target=node.target, iter=_name(x),
-                             body=node.body, orelse=[], type_comment=None)
-        python_for._dy2s_plain = True
-        dispatch = ast.If(test=_call("is_tensor", [_name(x)]),
-                          body=tensor_branch, orelse=[python_for])
-        out = []
-        for s in (assign_x, dispatch):
-            ast.copy_location(s, node)
-            ast.fix_missing_locations(s)
-            v = self.visit(s)
-            out.extend(v if isinstance(v, list) else [v])
-        return out
+    def _rewrite_tensor_loop(self, node, targets, sources, index=None,
+                             mode="iter"):
+        """Shared dual-form builder for `for v in X`, `for i, v in
+        enumerate(X)` and `for a, b[, c] in zip(X, Y[, Z])`:
 
-    def _rewrite_tensor_enumerate(self, node):
-        """`for i, v in enumerate(X):` -> the same runtime dual form as
-        _rewrite_tensor_iter, with the index bound inside the staged row
-        loop (reference test_for_enumerate.py capability)."""
-        i_name = node.target.elts[0].id
-        v_name = node.target.elts[1].id
-        x = self._n("iterable")
-        row = self._n("row")
-        src = node.iter.args[0]
-        assign_x = ast.Assign(targets=[_name(x, ast.Store())], value=src)
-        import copy as _copy
-        init_i = ast.Assign(targets=[ast.Name(id=i_name, ctx=ast.Store())],
-                            value=_const(0))
-        init_v = ast.Assign(targets=[ast.Name(id=v_name, ctx=ast.Store())],
-                            value=_call("row_init", [_name(x)]))
-        set_i = ast.Assign(targets=[ast.Name(id=i_name, ctx=ast.Store())],
-                           value=_name(row))
-        set_v = ast.Assign(
-            targets=[ast.Name(id=v_name, ctx=ast.Store())],
-            value=ast.Subscript(value=_name(x), slice=_name(row),
-                                ctx=ast.Load()))
-        tensor_for = ast.For(
-            target=_name(row, ast.Store()),
-            iter=ast.Call(func=ast.Name(id="range", ctx=ast.Load()),
-                          args=[_call("tensor_len", [_name(x)])],
-                          keywords=[]),
-            body=[set_i, set_v] + _copy.deepcopy(node.body), orelse=[],
-            type_comment=None)
-        python_for = ast.For(
-            target=node.target,
-            iter=ast.Call(func=ast.Name(id="enumerate", ctx=ast.Load()),
-                          args=[_name(x)], keywords=[]),
-            body=node.body, orelse=[], type_comment=None)
-        python_for._dy2s_plain = True
-        dispatch = ast.If(test=_call("is_tensor", [_name(x)]),
-                          body=[init_i, init_v, tensor_for],
-                          orelse=[python_for])
-        out = []
-        for s in (assign_x, dispatch):
-            ast.copy_location(s, node)
-            ast.fix_missing_locations(s)
-            v = self.visit(s)
-            out.extend(v if isinstance(v, list) else [v])
-        return out
+            __x_j = SOURCE_j ...
+            if is_tensor(__x_0) [and ...]:
+                t_j = init_loop_var(prior_or_UNDEFINED, row_init(__x_j))
+                [i = init_loop_var(prior, 0)]
+                for __row in range(min(tensor_len(__x_j, ...), ...)):
+                    [i = __row]; t_j = __x_j[__row] ...; BODY
+            else:
+                for <original targets> in <original form over __x_j>: BODY
 
-    def _rewrite_tensor_zip(self, node):
-        """`for a, b[, c] in zip(X, Y[, Z]):` -> runtime dual form; the
-        staged branch row-loops over the min leading length (zip
-        semantics), requiring EVERY argument to be a tensor."""
-        names = [e.id for e in node.target.elts]
-        xs = [self._n("iterable") for _ in names]
-        row = self._n("row")
-        assigns = [ast.Assign(targets=[_name(x, ast.Store())], value=a)
-                   for x, a in zip(xs, node.iter.args)]
+        Both copies are then transformed normally (the Python copy is
+        marked to stop re-rewriting). The init_loop_var wrapper keeps a
+        pre-existing binding when the leading dim is 0, matching Python's
+        empty-loop semantics (same contract as the range path).
+
+        Note: each nested non-range loop doubles its body (tensor +
+        Python branch) — 2^k copies at nesting depth k. Acceptable for
+        realistic nesting; revisit if it ever bites.
+        """
         import copy as _copy
-        inits = [ast.Assign(targets=[ast.Name(id=n, ctx=ast.Store())],
-                            value=_call("row_init", [_name(x)]))
-                 for n, x in zip(names, xs)]
+        xs = [self._n("iterable") for _ in sources]
+        row = self._n("row")
+        assigns = [ast.Assign(targets=[_name(x, ast.Store())], value=src)
+                   for x, src in zip(xs, sources)]
+
+        def keep_prior(name, fallback):
+            prior = ast.Call(
+                func=ast.Attribute(
+                    value=ast.Call(func=_name("locals"), args=[],
+                                   keywords=[]),
+                    attr="get", ctx=ast.Load()),
+                args=[_const(name), _jst_attr("UNDEFINED")], keywords=[])
+            return ast.Assign(
+                targets=[ast.Name(id=name, ctx=ast.Store())],
+                value=_call("init_loop_var", [prior, fallback]))
+
+        inits = [keep_prior(t, _call("row_init", [_name(x)]))
+                 for t, x in zip(targets, xs)]
         sets = [ast.Assign(
-            targets=[ast.Name(id=n, ctx=ast.Store())],
-            value=ast.Subscript(value=_name(x), slice=_name(row),
-                                ctx=ast.Load()))
-            for n, x in zip(names, xs)]
-        min_len = ast.Call(
-            func=ast.Name(id="min", ctx=ast.Load()),
-            args=[_call("tensor_len", [_name(x)]) for x in xs],
+            targets=[ast.Name(id=t, ctx=ast.Store())],
+            value=_call("row_at", [_name(x), _name(row)]))
+            for t, x in zip(targets, xs)]
+        if index is not None:
+            inits.insert(0, keep_prior(index, _const(0)))
+            sets.insert(0, ast.Assign(
+                targets=[ast.Name(id=index, ctx=ast.Store())],
+                value=_name(row)))
+        lens = [_call("tensor_len", [_name(x), _const(self.filename),
+                                     _const(node.lineno)]) for x in xs]
+        bound = lens[0] if len(lens) == 1 else ast.Call(
+            func=ast.Name(id="min", ctx=ast.Load()), args=lens,
             keywords=[])
         tensor_for = ast.For(
             target=_name(row, ast.Store()),
             iter=ast.Call(func=ast.Name(id="range", ctx=ast.Load()),
-                          args=[min_len], keywords=[]),
+                          args=[bound], keywords=[]),
             body=sets + _copy.deepcopy(node.body), orelse=[],
             type_comment=None)
-        python_for = ast.For(
-            target=node.target,
-            iter=ast.Call(func=ast.Name(id="zip", ctx=ast.Load()),
-                          args=[_name(x) for x in xs], keywords=[]),
-            body=node.body, orelse=[], type_comment=None)
+        if mode == "iter":
+            py_iter = _name(xs[0])
+        else:
+            py_iter = ast.Call(func=ast.Name(id=mode, ctx=ast.Load()),
+                               args=[_name(x) for x in xs], keywords=[])
+        python_for = ast.For(target=node.target, iter=py_iter,
+                             body=node.body, orelse=[], type_comment=None)
         python_for._dy2s_plain = True
         test = _call("is_tensor", [_name(xs[0])])
         for x in xs[1:]:
@@ -761,7 +721,10 @@ class _Transformer(ast.NodeTransformer):
                 and isinstance(node.iter.func, ast.Name)
                 and node.iter.func.id == "enumerate"
                 and len(node.iter.args) == 1 and not node.iter.keywords):
-            return self._rewrite_tensor_enumerate(node)
+            return self._rewrite_tensor_loop(
+                node, targets=[node.target.elts[1].id],
+                sources=[node.iter.args[0]],
+                index=node.target.elts[0].id, mode="enumerate")
         if (isinstance(node.target, ast.Tuple) and not node.orelse
                 and len(node.target.elts) in (2, 3)
                 and all(isinstance(e, ast.Name) for e in node.target.elts)
@@ -771,13 +734,17 @@ class _Transformer(ast.NodeTransformer):
                 and node.iter.func.id == "zip"
                 and len(node.iter.args) == len(node.target.elts)
                 and not node.iter.keywords):
-            return self._rewrite_tensor_zip(node)
+            return self._rewrite_tensor_loop(
+                node, targets=[e.id for e in node.target.elts],
+                sources=list(node.iter.args), mode="zip")
         if (isinstance(node.target, ast.Name) and not node.orelse
                 and not is_range_call
                 and not getattr(node, "_dy2s_plain", False)
                 and not isinstance(node.iter, (ast.List, ast.Tuple,
                                                ast.Dict, ast.Set))):
-            return self._rewrite_tensor_iter(node)
+            return self._rewrite_tensor_loop(
+                node, targets=[node.target.id], sources=[node.iter],
+                mode="iter")
         if (isinstance(node.target, ast.Name) and not node.orelse
                 and isinstance(node.iter, ast.Call)
                 and isinstance(node.iter.func, ast.Name)

@@ -586,3 +586,22 @@ class TestTensorIteration:
 
         assert float(convert_function(f)(
             jnp.zeros(()), [1.0, 2.0], [3.0, 4.0])) == 11.0
+
+    def test_empty_leading_dim_keeps_prior_binding(self):
+        """Python keeps the prior loop-variable value when the iterable
+        is empty; the staged dual form must too (same init_loop_var
+        contract as the range path)."""
+        def f(x, empty):
+            v = x                    # prior binding
+            i = jnp.asarray(7)
+            for v in empty:          # zero rows: v must stay == x
+                pass
+            for i, v in enumerate(empty):
+                pass
+            return v, i
+
+        x = jnp.ones((3,))
+        empty = jnp.zeros((0, 3))
+        v, i = jax.jit(convert_function(f))(x, empty)
+        np.testing.assert_allclose(np.asarray(v), np.asarray(x))
+        assert int(i) == 7
