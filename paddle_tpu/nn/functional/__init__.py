@@ -6,9 +6,9 @@ from .activation import (  # noqa: F401
     relu6, relu_, rrelu, selu, sigmoid, silu, softmax, softplus, softshrink,
     softmax_, softsign, swish, tanh, tanh_, tanhshrink, thresholded_relu)
 from .common import (  # noqa: F401
-    alpha_dropout, bilinear, cosine_similarity, dropout, dropout2d, dropout3d,
-    embedding, fold, interpolate, label_smooth, linear, one_hot, pad,
-    sequence_mask, unfold, upsample)
+    alpha_dropout, bilinear, class_center_sample, cosine_similarity, dropout,
+    dropout2d, dropout3d, embedding, fold, interpolate, label_smooth, linear,
+    one_hot, pad, sequence_mask, unfold, upsample)
 from .conv import (  # noqa: F401
     conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d, conv3d_transpose)
 from .extension import diag_embed, gather_tree, temporal_shift  # noqa: F401

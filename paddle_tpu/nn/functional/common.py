@@ -222,8 +222,27 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name
 
 
 def class_center_sample(label, num_classes, num_samples, group=None):
-    raise NotImplementedError("class_center_sample requires dynamic shapes; "
-                              "use ParallelCrossEntropy for large-class training")
+    """PartialFC-style class-center sampling (reference
+    operators/class_center_sample_op.cu; python/paddle/nn/functional/
+    common.py class_center_sample): keep every positive class in the
+    batch, fill the remaining of ``num_samples`` slots with random
+    negative classes, and remap labels into the sampled index space.
+
+    XLA-static formulation: rank all classes by (positive-first, random)
+    and take the top ``num_samples`` via argsort — no dynamic shapes.
+    Returns (remapped_label, sampled_class_index) with sampled shape
+    (num_samples,). Labels whose class was not sampled (only possible
+    when positives > num_samples) map to -1. Deterministic under
+    paddle.seed via the framework RNG."""
+    label = jnp.asarray(label).reshape(-1)
+    present = jnp.zeros((num_classes,), bool).at[label].set(True)
+    rand = jax.random.uniform(get_rng_key(), (num_classes,))
+    # positives sort below every negative; negatives shuffle uniformly
+    score = jnp.where(present, rand - 2.0, rand)
+    sampled = jnp.argsort(score)[:num_samples].astype(label.dtype)
+    inv = jnp.full((num_classes,), -1, label.dtype) \
+        .at[sampled].set(jnp.arange(num_samples, dtype=label.dtype))
+    return inv[label], sampled
 
 
 def sequence_mask(lengths, maxlen=None, dtype="int64"):

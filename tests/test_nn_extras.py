@@ -188,3 +188,45 @@ def test_compat_shims():
         paddle.vision.set_image_backend("bogus")
     from paddle_tpu.text import Imdb, WMT14  # noqa: F401
     assert paddle.nn.functional.elu_ is not None
+
+
+class TestClassCenterSample:
+    def test_positives_kept_and_remap_consistent(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.nn import functional as F
+        paddle.seed(0)
+        label = jnp.asarray([3, 77, 3, 500, 77], jnp.int32)
+        remapped, sampled = F.class_center_sample(label, 1000, 16)
+        sampled = np.asarray(sampled)
+        assert sampled.shape == (16,)
+        assert len(set(sampled.tolist())) == 16          # no duplicates
+        for cls in (3, 77, 500):
+            assert cls in sampled                        # positives kept
+        # remapped labels index into sampled and round-trip
+        r = np.asarray(remapped)
+        assert (r >= 0).all()
+        np.testing.assert_array_equal(sampled[r], np.asarray(label))
+
+    def test_deterministic_under_seed_and_jit(self):
+        import jax as _jax
+        import paddle_tpu as paddle
+        from paddle_tpu.framework.random import rng_guard
+        from paddle_tpu.nn import functional as F
+        label = jnp.asarray([1, 2], jnp.int32)
+        paddle.seed(7)
+        _, s1 = F.class_center_sample(label, 100, 8)
+        paddle.seed(7)
+        _, s2 = F.class_center_sample(label, 100, 8)
+        np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+        # under jit, scope the RNG like functional_call does (a raw
+        # get_rng_key inside jit would leak a tracer — same contract as
+        # dropout)
+        key = _jax.random.PRNGKey(0)
+
+        @_jax.jit
+        def f(l, key):
+            with rng_guard(key):
+                return F.class_center_sample(l, 100, 8)
+
+        _, s3 = f(label, key)
+        assert len(set(np.asarray(s3).tolist())) == 8

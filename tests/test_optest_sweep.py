@@ -256,7 +256,9 @@ TENSOR_EXEMPT = {
 }
 
 
-F_NONDIFF = {"one_hot", "sequence_mask", "gather_tree"}
+F_NONDIFF = {"one_hot", "sequence_mask", "gather_tree",
+             "class_center_sample"}  # integer sampling (tested in
+                                     # test_nn_extras.py)
 F_STOCHASTIC = {"dropout", "dropout2d", "dropout3d", "alpha_dropout",
                 "rrelu", "gumbel_softmax"}
 F_UTILITY = set()
