@@ -206,8 +206,12 @@ class ParallelTrainer:
             fwd = functional_call
             if self.remat:
                 def fwd(m, p, b, *a, rng=None):
+                    # [0]: keep only the model output — returning the
+                    # (out, new_buffers) pair through jax.checkpoint
+                    # would hand the tuple to loss_fn as "out"
                     f = jax.checkpoint(
-                        lambda pp_, xx: functional_call(m, pp_, b, xx, rng=rng))
+                        lambda pp_, xx: functional_call(
+                            m, pp_, b, xx, rng=rng)[0])
                     return f(p, *a), b
             out, _ = fwd(model, params, buffers, inputs, rng=key)
             return loss_fn(out, labels)
