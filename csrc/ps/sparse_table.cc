@@ -240,6 +240,44 @@ class SparseTable {
     });
   }
 
+  int row_width() const { return row_width_; }
+
+  // Tier-exchange API (the HeterPS hot/cold handoff,
+  // framework/fleet/heter_ps/heter_comm.h capability): read/write FULL
+  // rows — value followed by the optimizer slot columns — so a device-
+  // resident hot tier can take over a row (promote) and hand it back
+  // (flush) without losing optimizer state.
+  void ExportRows(const int64_t* keys, int64_t n, float* out,
+                  bool create_missing) {
+    RunSharded(n, [&](int, int tid, int nthreads) {
+      for (int64_t i = 0; i < n; ++i) {
+        int s = ShardOf(keys[i]);
+        if (s % nthreads != tid) continue;
+        float* dst = out + i * row_width_;
+        std::lock_guard<std::mutex> lk(shards_[s].mu);
+        const float* row = FindOrCreate(keys[i], create_missing);
+        if (row) {
+          std::memcpy(dst, row, sizeof(float) * row_width_);
+        } else {
+          std::memset(dst, 0, sizeof(float) * row_width_);
+        }
+      }
+    });
+  }
+
+  void ImportRows(const int64_t* keys, int64_t n, const float* data) {
+    RunSharded(n, [&](int, int tid, int nthreads) {
+      for (int64_t i = 0; i < n; ++i) {
+        int s = ShardOf(keys[i]);
+        if (s % nthreads != tid) continue;
+        std::lock_guard<std::mutex> lk(shards_[s].mu);
+        float* row = const_cast<float*>(FindOrCreate(keys[i], true));
+        std::memcpy(row, data + i * row_width_,
+                    sizeof(float) * row_width_);
+      }
+    });
+  }
+
   // Binary format: header(dim, opt, slots, step, nrows) then per row:
   // key + row_width floats.
   bool Save(const char* path) {
@@ -502,6 +540,21 @@ void ps_sparse_pull(void* t, const int64_t* keys, int64_t n, float* out,
 void ps_sparse_push(void* t, const int64_t* keys, int64_t n,
                     const float* grads, float lr) {
   static_cast<SparseTable*>(t)->Push(keys, n, grads, lr);
+}
+
+int ps_sparse_row_width(void* t) {
+  return static_cast<SparseTable*>(t)->row_width();
+}
+
+void ps_sparse_export_rows(void* t, const int64_t* keys, int64_t n,
+                           float* out, int create_missing) {
+  static_cast<SparseTable*>(t)->ExportRows(keys, n, out,
+                                           create_missing != 0);
+}
+
+void ps_sparse_import_rows(void* t, const int64_t* keys, int64_t n,
+                           const float* data) {
+  static_cast<SparseTable*>(t)->ImportRows(keys, n, data);
 }
 
 int ps_sparse_save(void* t, const char* path) {

@@ -76,6 +76,14 @@ class ParallelTrainer:
         self.state = None
         self._init_state()
         self._build()
+        # layers that manage live training state between steps (the
+        # HeterPS hot tier) bind themselves here — forgetting a manual
+        # attach() would silently train on the stale eager parameters
+        if hasattr(model, "named_sublayers"):
+            for _, sub in model.named_sublayers(include_self=True):
+                hook = getattr(sub, "_on_trainer_built", None)
+                if hook is not None:
+                    hook(self)
 
     # -- state -------------------------------------------------------------
     def _param_spec(self, name, p):
@@ -480,6 +488,44 @@ class ParallelTrainer:
                     raise AssertionError(
                         f"param {k!r} declared replicated but devices "
                         f"{shards[0].device} and {s.device} disagree")
+
+    # -- live-state access (HeterPS hot-tier insert/evict between steps) ----
+    def param_name_of(self, box) -> Optional[str]:
+        """Full name of a model Parameter by identity (None if absent)."""
+        for n, b in self.model.named_parameters():
+            if b is box:
+                return n
+        return None
+
+    def get_param(self, name):
+        return self.state["params"][name]
+
+    def set_param(self, name, value):
+        """Replace a parameter in live state, preserving its sharding."""
+        self.state["params"][name] = jax.device_put(
+            value, NamedSharding(self.mesh, self.param_specs[name]))
+
+    def get_opt_slot(self, name, slot):
+        """Optimizer slot array for a param (None when the optimizer
+        keeps no such slot or nests them — wrapper optimizers)."""
+        try:
+            v = self.state["opt"]["slots"][name][slot]
+            return v if hasattr(v, "shape") else None
+        except (KeyError, TypeError):
+            return None
+
+    def opt_slot_names(self, name):
+        """Names of the flat optimizer slot arrays kept for a param."""
+        try:
+            d = self.state["opt"]["slots"][name]
+            return [k for k, v in d.items() if hasattr(v, "shape")]
+        except (KeyError, TypeError):
+            return []
+
+    def set_opt_slot(self, name, slot, value):
+        spec = self.opt_specs["slots"][name][slot]
+        self.state["opt"]["slots"][name][slot] = jax.device_put(
+            value, NamedSharding(self.mesh, spec))
 
     def sync_to_model(self):
         boxes = OrderedDict(self.model.named_parameters())

@@ -8,6 +8,7 @@ dataset. See each module's docstring for the reference capability map
 """
 from .datafeed import InMemoryDataset, QueueDataset  # noqa: F401
 from .embedding import DistributedEmbedding, make_lookup  # noqa: F401
+from .heter import HeterEmbedding  # noqa: F401
 from .service import DistributedSparseTable, PsServer  # noqa: F401
 from .table import (DenseTable, GraphTable, SparseTable,  # noqa: F401
                     shard_keys)

@@ -1,0 +1,327 @@
+"""HeterEmbedding — device-resident (HBM) hot embedding tier over the
+host PS cold tier.
+
+Capability map (reference): HeterPS keeps hot embedding rows ON the
+accelerator in a GPU hash table with a device-side optimizer and
+inter-device comm (`framework/fleet/heter_ps/hashtable.h:47`,
+`heter_comm.h:50`, `heter_ps.cu`); the CPU parameter server is the full
+(cold) store, exchanged with the device tier at pass boundaries.
+
+TPU-native redesign — the hash table is SPLIT across host and device by
+what each does best:
+- the DEVICE owns the row data: a fixed-capacity ``(capacity, dim)``
+  HBM-resident array (a normal trainable Parameter — XLA gathers at HBM
+  bandwidth, the model optimizer updates hot rows on-device, exactly the
+  HeterPS division where the accelerator applies updates);
+- the HOST owns the hash map: key->slot assignment, LRU eviction, and
+  the promote/flush traffic with the PS table happen in plain Python/
+  numpy BETWEEN jitted steps (``prepare``), so the jitted step sees only
+  static-shaped integer slot ids and touches the host zero times.
+
+Per-step transfer is O(cache misses * row_width) instead of the
+O(batch * dim) host round-trip the ``pure_callback`` path
+(``embedding.py``) pays on every lookup.
+
+Tier handoff moves FULL rows (value + optimizer slot columns) through
+``SparseTable.export_rows/import_rows``: a promoted row carries its
+host-side accumulator into the device optimizer's slot state, and an
+evicted row carries the device accumulator back, so adagrad/adam
+trajectories survive migration. When the device optimizer's slots are
+not reachable (eager mode, wrapper optimizers), eviction preserves the
+PS's existing slot columns and rewrites only the values.
+
+Sharded mode (``shard_axis="model"``): the hot array carries
+``P("model", None)`` so the engine places 1/mp of it per device;
+lookups inside shard_map use the masked-gather + psum exchange (the
+vocab-parallel pattern; for batch-sharded alltoall id-exchange see
+``ops/sharded_embedding.alltoall_lookup``).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...nn.layer import Layer
+from .table import SparseTable
+
+__all__ = ["HeterEmbedding"]
+
+# native row layout per optimizer: value columns then these slot columns,
+# named as the DEVICE optimizer's matching slot pytree keys
+_SLOT_COLUMNS = {"sgd": (), "adagrad": ("moment",), "adam": ("m", "v")}
+
+
+class HeterEmbedding(Layer):
+    """Two-tier embedding: HBM hot rows + host PS cold store.
+
+    Usage: call ``slots = emb.prepare(ids)`` on the host before each
+    step (insert/evict happens here), then run the jitted step on
+    ``slots``. With ``ParallelTrainer``, call ``emb.attach(trainer)``
+    once after building the trainer so tier handoff reads/writes the
+    live training state (including optimizer slots).
+    """
+
+    def __init__(self, dim: int, capacity: int,
+                 optimizer: str = "adagrad", table: Optional[SparseTable]
+                 = None, pooling: Optional[str] = None, seed: int = 0,
+                 init_range: float = 0.01, shard_axis: Optional[str]
+                 = None):
+        super().__init__()
+        from ...nn.initializer import Constant
+        if table is not None and not hasattr(table, "export_rows"):
+            raise TypeError("HeterEmbedding needs a table with the "
+                            "export_rows/import_rows tier-exchange API "
+                            "(local SparseTable)")
+        self.dim = dim
+        self.capacity = int(capacity)
+        self.pooling = pooling  # None | "sum" | "mean"
+        self.table = table if table is not None else SparseTable(
+            dim, optimizer=optimizer, seed=seed, init_range=init_range)
+        assert self.table.dim == dim
+        self._slot_names = _SLOT_COLUMNS.get(self.table.optimizer, ())
+        # hot rows: a regular trainable parameter — the model optimizer
+        # IS the device-side optimizer of the hot tier
+        self.hot = self.create_parameter((self.capacity, dim),
+                                         initializer=Constant(0.0))
+        if shard_axis:
+            from jax.sharding import PartitionSpec as P
+            self.hot.pspec = P(shard_axis, None)
+        self._shard_axis = shard_axis
+        # host-side hash map mirror
+        self._key2slot: dict = {}
+        self._slot2key = np.full(self.capacity, -1, np.int64)
+        self._lru: OrderedDict = OrderedDict()
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self._trainer = None
+        self._pname = None
+        self.stats = {"lookups": 0, "hits": 0, "misses": 0, "evicts": 0}
+
+    # -- live-state plumbing ------------------------------------------------
+    def attach(self, trainer):
+        """Bind to a ParallelTrainer so insert/evict act on live state.
+        ParallelTrainer calls this automatically via _on_trainer_built;
+        manual attach is only needed for hand-rolled training loops over
+        trainer-style state."""
+        name = trainer.param_name_of(self.hot)
+        if name is None:
+            raise ValueError("this HeterEmbedding's hot parameter is not "
+                             "part of the trainer's model")
+        self._trainer = trainer
+        self._pname = name
+        return self
+
+    # ParallelTrainer auto-binds at construction: without it, prepare()
+    # would write rows into the eager Parameter the jitted step never
+    # reads, and evictions would flush zeros over real PS rows
+    _on_trainer_built = attach
+
+    def _get_values(self):
+        if self._trainer is not None:
+            return self._trainer.get_param(self._pname)
+        return self.hot.value
+
+    def _set_values(self, v):
+        if self._trainer is not None:
+            self._trainer.set_param(self._pname, v)
+        else:
+            self.hot.value = v
+
+    def _get_slot(self, slot_name):
+        if self._trainer is not None:
+            return self._trainer.get_opt_slot(self._pname, slot_name)
+        return None
+
+    def _set_slot(self, slot_name, v):
+        if self._trainer is not None:
+            self._trainer.set_opt_slot(self._pname, slot_name, v)
+
+    # -- tier exchange ------------------------------------------------------
+    def _flush(self, slots: np.ndarray, keys: np.ndarray):
+        """Evicted rows -> PS, carrying optimizer slots when reachable."""
+        vals = np.asarray(self._get_values()[slots], np.float32)
+        slot_arrays = [self._get_slot(sn) for sn in self._slot_names]
+        if all(a is not None for a in slot_arrays):
+            cols = [vals] + [np.asarray(a[slots], np.float32)
+                             for a in slot_arrays]
+            self.table.import_rows(keys, np.concatenate(cols, axis=1))
+        else:
+            # device slot state unreachable: keep the PS's existing slot
+            # columns, rewrite only the values
+            cur = self.table.export_rows(keys, create_missing=True)
+            cur[:, :self.dim] = vals
+            self.table.import_rows(keys, cur)
+
+    def _promote(self, slots: np.ndarray, keys: np.ndarray):
+        """PS rows -> device (values + optimizer slot columns). Every
+        reachable device slot array is written for the reused slots:
+        mapped columns get the PS state, anything else resets to zero —
+        a promoted key must never inherit the evicted key's accumulator
+        or momentum."""
+        rows = self.table.export_rows(keys, create_missing=True)
+        self._set_values(
+            self._get_values().at[slots].set(rows[:, :self.dim]))
+        mapped = {sn: rows[:, (1 + j) * self.dim:(2 + j) * self.dim]
+                  for j, sn in enumerate(self._slot_names)}
+        for sn in self._device_slot_names():
+            arr = self._get_slot(sn)
+            if arr is None:
+                continue
+            col = mapped.get(sn)
+            self._set_slot(sn, arr.at[slots].set(
+                col if col is not None else 0.0))
+
+    def _device_slot_names(self):
+        if self._trainer is not None:
+            return self._trainer.opt_slot_names(self._pname)
+        return self._slot_names
+
+    def _check_handoff(self):
+        """Warn once when optimizer state cannot migrate between tiers
+        (eager mode, wrapper optimizers, or a device optimizer whose
+        slots don't match the table's): values still move correctly but
+        adagrad/adam trajectories will diverge from the host-PS path."""
+        if getattr(self, "_handoff_checked", False):
+            return
+        self._handoff_checked = True
+        if not self._slot_names:
+            return  # sgd: nothing to migrate
+        reachable = [sn for sn in self._slot_names
+                     if self._get_slot(sn) is not None]
+        if len(reachable) != len(self._slot_names):
+            import warnings
+            warnings.warn(
+                f"HeterEmbedding: table optimizer "
+                f"{self.table.optimizer!r} keeps slot columns "
+                f"{self._slot_names} but the device optimizer exposes "
+                f"{self._device_slot_names() or 'none'} — optimizer "
+                f"state will NOT migrate on evict/promote (values "
+                f"still do). Match the training optimizer to the table "
+                f"optimizer, or attach() a ParallelTrainer.",
+                stacklevel=3)
+
+    # -- per-step host work -------------------------------------------------
+    def prepare(self, ids) -> np.ndarray:
+        """Map raw keys -> hot slots, inserting misses and evicting LRU
+        rows as needed. Returns int32 slots shaped like ``ids`` (-1
+        padding preserved). Host-only; call OUTSIDE the jitted step."""
+        self._check_handoff()
+        ids_np = np.asarray(ids)
+        flat = ids_np.reshape(-1)
+        valid = flat >= 0
+        uniq = np.unique(flat[valid])
+        k2s = self._key2slot
+        misses = [k for k in uniq.tolist() if k not in k2s]
+        self.stats["lookups"] += int(uniq.size)
+        self.stats["misses"] += len(misses)
+        self.stats["hits"] += int(uniq.size) - len(misses)
+
+        need = len(misses) - len(self._free)
+        if need > 0:
+            current = set(uniq.tolist())
+            evict_keys = []
+            for k in self._lru:
+                if k not in current:
+                    evict_keys.append(k)
+                    if len(evict_keys) == need:
+                        break
+            if len(evict_keys) < need:
+                raise RuntimeError(
+                    f"HeterEmbedding capacity {self.capacity} cannot hold "
+                    f"the {uniq.size} distinct keys of this batch")
+            slots = np.asarray([k2s[k] for k in evict_keys], np.int64)
+            self._flush(slots, np.asarray(evict_keys, np.int64))
+            for k, s in zip(evict_keys, slots.tolist()):
+                del k2s[k]
+                del self._lru[k]
+                self._slot2key[s] = -1
+                self._free.append(s)
+            self.stats["evicts"] += len(evict_keys)
+
+        if misses:
+            new_slots = np.asarray([self._free.pop() for _ in misses],
+                                   np.int64)
+            mkeys = np.asarray(misses, np.int64)
+            self._promote(new_slots, mkeys)
+            for k, s in zip(misses, new_slots.tolist()):
+                k2s[k] = s
+                self._slot2key[s] = k
+
+        for k in uniq.tolist():
+            self._lru[k] = None
+            self._lru.move_to_end(k)
+
+        out = np.full(flat.shape, -1, np.int64)
+        out[valid] = [k2s[k] for k in flat[valid].tolist()]
+        return out.reshape(ids_np.shape).astype(np.int32)
+
+    # -- jitted lookup ------------------------------------------------------
+    def forward(self, slot_ids):
+        slot_ids = jnp.asarray(slot_ids)
+        mask = slot_ids >= 0
+        safe = jnp.where(mask, slot_ids, 0)
+        if self._shard_axis:
+            from ..meta_parallel.parallel_layers.mp_layers import (
+                _in_shard_map)
+            if _in_shard_map(self._shard_axis):
+                emb = self._sharded_gather(safe)
+            else:
+                emb = self.hot.value[safe]
+        else:
+            emb = self.hot.value[safe]
+        emb = emb * mask[..., None].astype(emb.dtype)
+        if self.pooling is None:
+            return emb
+        maskf = mask.astype(jnp.float32)[..., None]
+        s = jnp.sum(emb * maskf, axis=-2)
+        if self.pooling == "sum":
+            return s
+        cnt = jnp.maximum(jnp.sum(maskf, axis=-2), 1.0)
+        return s / cnt
+
+    def _sharded_gather(self, safe):
+        """Masked local gather + forward-psum over the shard axis (the
+        vocab-parallel exchange). The psum must be the identity-backward
+        variant: under shard_map a plain lax.psum transposes to another
+        psum, scaling every hot-row gradient by the axis size (see
+        mp_layers.reduce_from_parallel_region)."""
+        from jax import lax
+
+        from ..meta_parallel.parallel_layers.mp_layers import (
+            reduce_from_parallel_region)
+        local = self.hot.value            # (capacity/mp, dim) this shard
+        per = local.shape[0]
+        rank = lax.axis_index(self._shard_axis)
+        lo = rank * per
+        mine = (safe >= lo) & (safe < lo + per)
+        idx = jnp.clip(safe - lo, 0, per - 1)
+        rows = jnp.where(mine[..., None], local[idx], 0.0)
+        return reduce_from_parallel_region(rows, self._shard_axis)
+
+    # -- persistence --------------------------------------------------------
+    def flush_all(self):
+        """Write every hot row back to the PS table (checkpoint/export
+        boundary; the cache stays valid)."""
+        live = np.where(self._slot2key >= 0)[0]
+        if live.size:
+            self._flush(live, self._slot2key[live])
+
+    def save(self, path: str):
+        self.flush_all()
+        self.table.save(path)
+
+    def load(self, path: str):
+        self.table.load(path)
+        # drop the cache: rows re-promote lazily with fresh table state
+        self._key2slot.clear()
+        self._lru.clear()
+        self._slot2key[:] = -1
+        self._free = list(range(self.capacity - 1, -1, -1))
+
+    @property
+    def hit_rate(self) -> float:
+        n = self.stats["lookups"]
+        return self.stats["hits"] / n if n else 0.0

@@ -71,6 +71,11 @@ def lib() -> ctypes.CDLL:
         dll.ps_sparse_size.argtypes = [c.c_void_p]
         dll.ps_sparse_pull.argtypes = [c.c_void_p, p_i64, i64, p_f32, c.c_int]
         dll.ps_sparse_push.argtypes = [c.c_void_p, p_i64, i64, p_f32, f32]
+        dll.ps_sparse_row_width.restype = c.c_int
+        dll.ps_sparse_row_width.argtypes = [c.c_void_p]
+        dll.ps_sparse_export_rows.argtypes = [c.c_void_p, p_i64, i64, p_f32,
+                                              c.c_int]
+        dll.ps_sparse_import_rows.argtypes = [c.c_void_p, p_i64, i64, p_f32]
         dll.ps_sparse_save.restype = c.c_int
         dll.ps_sparse_save.argtypes = [c.c_void_p, c.c_char_p]
         dll.ps_sparse_spill.restype = c.c_int

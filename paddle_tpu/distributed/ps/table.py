@@ -76,6 +76,31 @@ class SparseTable:
         self._lib.ps_sparse_push(self._h, _ip(keys), keys.size, _fp(grads),
                                  lr)
 
+    @property
+    def row_width(self) -> int:
+        """dim * (1 + optimizer slot columns) — the full-row stride used
+        by the tier-exchange API."""
+        return int(self._lib.ps_sparse_row_width(self._h))
+
+    def export_rows(self, keys, create_missing: bool = True) -> np.ndarray:
+        """Read FULL rows — (N, row_width): value columns then optimizer
+        slot columns — for handing rows to a device-resident hot tier
+        (HeterPS promote; reference heter_ps/heter_comm.h)."""
+        keys = _as_i64(keys).reshape(-1)
+        out = np.empty((keys.size, self.row_width), dtype=np.float32)
+        self._lib.ps_sparse_export_rows(self._h, _ip(keys), keys.size,
+                                        _fp(out),
+                                        1 if create_missing else 0)
+        return out
+
+    def import_rows(self, keys, rows):
+        """Write FULL rows back (HeterPS flush on eviction): inverse of
+        export_rows, creating absent keys."""
+        keys = _as_i64(keys).reshape(-1)
+        rows = _as_f32(rows).reshape(keys.size, self.row_width)
+        self._lib.ps_sparse_import_rows(self._h, _ip(keys), keys.size,
+                                        _fp(rows))
+
     def spill(self, path: str, max_hot_rows: int):
         """Evict least-recently-touched rows beyond ``max_hot_rows`` to a
         disk file (reference table/ssd_sparse_table.cc cold tier); spilled

@@ -1,0 +1,145 @@
+"""Sharded embedding lookup with alltoall id-exchange.
+
+Capability target: the reference's HeterPS inter-device embedding comm
+(`framework/fleet/heter_ps/heter_comm.h:50` — push/pull of sparse rows
+between GPU-resident table shards) and `c_embedding`'s row-sharded
+lookup. TPU-native shape: the table lives row-sharded over a mesh axis
+(each device owns ``rows/n`` consecutive rows in HBM); a lookup of
+arbitrary global row ids exchanges the IDS to their owning shard with
+``lax.all_to_all``, gathers locally, and alltoalls the rows back —
+moving O(ids * dim) over ICI instead of the O(ids * dim * n_shards)
+a masked-gather + psum (VocabParallelEmbedding-style) pays.
+
+Everything is static-shaped for XLA: ids are bucketed per destination
+shard into fixed-capacity buckets (``bucket_cap``). Ids that overflow a
+bucket (pathological skew) are resolved by a masked-gather + psum
+fallback — correctness never depends on the cap, only performance.
+
+Must be called inside ``shard_map`` with the table's mesh axis mapped;
+the custom_vjp routes row-gradients back to the owning shard through
+the transposed alltoall (scatter-add on the owner).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["alltoall_lookup"]
+
+
+def _exchange(local_rows, ids, axis, bucket_cap, rows_per_shard):
+    """Forward exchange. Returns (out (N, dim), residuals for bwd)."""
+    n = lax.psum(1, axis)
+    n_ids = ids.shape[0]
+    dim = local_rows.shape[-1]
+    cap = int(bucket_cap)
+
+    valid = ids >= 0
+    owner = jnp.clip(jnp.where(valid, ids, 0) // rows_per_shard, 0, n - 1)
+    owner = jnp.where(valid, owner, n)  # invalid ids -> no bucket
+
+    # stable sort by owner; position of each id within its owner group
+    order = jnp.argsort(owner, stable=True)
+    owner_s = owner[order]
+    ids_s = ids[order]
+    group_start = jnp.searchsorted(owner_s, jnp.arange(n + 1))
+    pos_in_group = jnp.arange(n_ids) - group_start[jnp.clip(owner_s, 0, n)]
+    in_bucket = (pos_in_group < cap) & (owner_s < n)
+
+    # send buffers: per-destination buckets of ids (+ original positions
+    # kept locally so returned rows scatter back without a round trip)
+    # sentinel lanes are routed to OOB row n and DROPPED — writing them
+    # to any in-bounds slot could clobber a real bucketed id
+    dst_r = jnp.where(in_bucket, owner_s, n)
+    dst_c = jnp.where(in_bucket, pos_in_group, 0)
+    send_ids = jnp.full((n, cap), -1, ids.dtype)
+    send_ids = send_ids.at[dst_r, dst_c].set(ids_s, mode="drop")
+    home_pos = jnp.full((n, cap), n_ids, jnp.int32)
+    home_pos = home_pos.at[dst_r, dst_c].set(order.astype(jnp.int32),
+                                             mode="drop")
+
+    # ship id buckets to their owners; row j of recv = the bucket device
+    # j sent to THIS shard
+    recv_ids = lax.all_to_all(send_ids, axis, 0, 0)
+    my_lo = lax.axis_index(axis) * rows_per_shard
+    local_idx = jnp.clip(recv_ids - my_lo, 0, local_rows.shape[0] - 1)
+    hit = recv_ids >= 0
+    rows = jnp.where(hit[..., None],
+                     local_rows[local_idx], 0.0)          # (n, cap, dim)
+    # rows ride back along the same lanes
+    back = lax.all_to_all(rows, axis, 0, 0)               # (n, cap, dim)
+
+    out = jnp.zeros((n_ids + 1, dim), local_rows.dtype)
+    out = out.at[home_pos.reshape(-1)].set(
+        back.reshape(-1, dim), mode="drop")[:n_ids]
+
+    # overflow fallback (pathological bucket skew): all_gather every
+    # shard's overflow ids, owners contribute rows, psum_scatter returns
+    # each shard exactly its own slice — exact for per-shard ids, costs
+    # one (n, N, dim) exchange only in traffic, not in correctness
+    ovf = jnp.zeros((n_ids,), jnp.bool_).at[
+        jnp.where(in_bucket, n_ids, order)].set(True, mode="drop")
+    ovf = ovf & (ids >= 0)
+    ovf_ids = jnp.where(ovf, ids, -1)
+    all_ovf = lax.all_gather(ovf_ids, axis)               # (n, N)
+    o_mine = (all_ovf >= my_lo) & (all_ovf < my_lo + rows_per_shard)
+    o_idx = jnp.clip(jnp.where(o_mine, all_ovf, 0) - my_lo, 0,
+                     local_rows.shape[0] - 1)
+    contrib = jnp.where(o_mine[..., None],
+                        local_rows[o_idx], 0.0)           # (n, N, dim)
+    o_rows = lax.psum_scatter(contrib, axis,
+                              scatter_dimension=0)        # (N, dim)
+    out = jnp.where(ovf[:, None], o_rows, out)
+    return out, (home_pos, ovf, o_mine, o_idx, local_idx, hit)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def alltoall_lookup(local_rows, ids, axis: str, bucket_cap: int,
+                    rows_per_shard: int):
+    """Gather rows[ids] from a row-sharded table inside shard_map.
+
+    local_rows: (rows_per_shard, dim) this shard's slice of the table.
+    ids: (N,) THIS shard's global row indices (-1 = padding -> zero
+    row) — per-shard ids, i.e. the shard's slice of the batch, NOT a
+    replicated id list (for replicated ids over a model axis use
+    VocabParallelEmbedding's masked-gather + psum instead). Returns
+    (N, dim) rows for this shard's ids.
+    """
+    out, _ = _exchange(local_rows, ids, axis, bucket_cap, rows_per_shard)
+    return out
+
+
+def _fwd(local_rows, ids, axis, bucket_cap, rows_per_shard):
+    out, res = _exchange(local_rows, ids, axis, bucket_cap,
+                         rows_per_shard)
+    return out, (res, local_rows.shape)
+
+
+def _bwd(axis, bucket_cap, rows_per_shard, saved, g):
+    (home_pos, ovf, o_mine, o_idx, local_idx, hit), shape = saved
+    dim = g.shape[-1]
+
+    # grads ride the transposed route: pack per-owner buckets from the
+    # ORIGINAL positions, alltoall to owners, scatter-add into the shard
+    gpad = jnp.concatenate([g, jnp.zeros((1, dim), g.dtype)], 0)
+    send_g = gpad[jnp.clip(home_pos, 0, g.shape[0])]      # (n, cap, dim)
+    send_g = jnp.where((home_pos < g.shape[0])[..., None], send_g, 0.0)
+    recv_g = lax.all_to_all(send_g, axis, 0, 0)           # (n, cap, dim)
+    d_local = jnp.zeros(shape, g.dtype)
+    d_local = d_local.at[local_idx].add(
+        jnp.where(hit[..., None], recv_g, 0.0))
+
+    # overflow transpose: all_gather every shard's overflow cotangents,
+    # owner scatter-adds the entries it owns
+    g_ovf = jnp.where(ovf[:, None], g, 0.0)
+    all_g = lax.all_gather(g_ovf, axis)                   # (n, N, dim)
+    d_local = d_local.at[o_idx.reshape(-1)].add(
+        jnp.where(o_mine.reshape(-1)[:, None],
+                  all_g.reshape(-1, dim), 0.0))
+    return d_local, None
+
+
+alltoall_lookup.defvjp(_fwd, _bwd)

@@ -2,7 +2,7 @@
 
 BASELINE.md's configs[4] names the "Wide&Deep / DeepFM sparse recommender"
 workload (the reference serves it via PaddleRec on the PS tier:
-dist_fleet_ctr.py fixtures, common_sparse_table.cc storage). Two storage
+dist_fleet_ctr.py fixtures, common_sparse_table.cc storage). Three storage
 modes, same math:
 
 - bounded-vocab (default): `nn.Embedding` parameters — fully jit-compiled,
@@ -10,6 +10,13 @@ modes, same math:
 - unbounded-vocab: pass `sparse=True` to back the id features with the
   host-side PS `DistributedEmbedding` (csrc/ps native table; rows
   materialize on first touch, optimizer applied server-side at push).
+- two-tier: pass `sparse="heter"` for the device-resident hot tier over
+  the host PS (`HeterEmbedding` — the HeterPS capability,
+  fleet/heter_ps/hashtable.h): one (embedding_dim+1)-wide table serves
+  both the wide weight (column 0) and the deep embedding (columns 1:),
+  matching the reference CTR accessor's [w, embedx...] row layout. Call
+  ``slots = model.prepare_batch(ids)`` on the host each step and feed
+  ``slots`` in place of ``ids``.
 
 Inputs: ``ids`` (B, F) one categorical id per field (use id -1 for
 missing), ``dense`` (B, D) continuous features. Output: CTR logit (B,).
@@ -17,6 +24,8 @@ missing), ``dense`` (B, D) continuous features. Output: CTR logit (B,).
 from __future__ import annotations
 
 from typing import Sequence
+
+import numpy as np
 
 import jax.numpy as jnp
 
@@ -35,7 +44,9 @@ def _sparse_tables(field_dims, dim, sparse, lr):
 
 class _RecBase(nn.Layer):
     def __init__(self, field_dims: Sequence[int], dense_dim: int,
-                 embedding_dim: int, sparse: bool, sparse_lr: float):
+                 embedding_dim: int, sparse, sparse_lr: float,
+                 heter_capacity: int = 0,
+                 heter_optimizer: str = "adagrad"):
         super().__init__()
         self.field_dims = list(field_dims)
         self.num_fields = len(self.field_dims)
@@ -45,14 +56,38 @@ class _RecBase(nn.Layer):
         # offsets fold per-field vocabularies into one id space, so one
         # table serves all fields (the reference's single sparse table
         # with slot-prefixed keys)
-        offs = jnp.asarray(
-            [0] + list(jnp.cumsum(jnp.asarray(self.field_dims))[:-1]),
-            jnp.int32)
-        self.register_buffer("field_offsets", offs, persistable=False)
-        self.embedding = _sparse_tables(self.field_dims, embedding_dim,
-                                        sparse, sparse_lr)
-        self.linear_emb = _sparse_tables(self.field_dims, 1, sparse,
-                                         sparse_lr)
+        self._np_offsets = np.concatenate(
+            [[0], np.cumsum(self.field_dims)[:-1]]).astype(np.int64)
+        self.register_buffer("field_offsets",
+                             jnp.asarray(self._np_offsets, jnp.int32),
+                             persistable=False)
+        if sparse == "heter":
+            from ..distributed.ps import HeterEmbedding
+            cap = heter_capacity or max(2048, sum(self.field_dims) // 8)
+            # the table optimizer must match the TRAINING optimizer so
+            # accumulator/momentum state migrates on evict/promote
+            self.ctr_table = HeterEmbedding(embedding_dim + 1,
+                                            capacity=cap,
+                                            optimizer=heter_optimizer)
+        else:
+            self.embedding = _sparse_tables(self.field_dims,
+                                            embedding_dim, sparse,
+                                            sparse_lr)
+            self.linear_emb = _sparse_tables(self.field_dims, 1, sparse,
+                                             sparse_lr)
+
+    def prepare_batch(self, ids) -> np.ndarray:
+        """Heter mode host step: fold raw ids and run the hot-tier
+        insert/evict; returns the slot ids to feed the jitted step."""
+        assert self.sparse == "heter", "prepare_batch is heter-mode only"
+        ids = np.asarray(ids)
+        folded = np.where(ids < 0, -1, ids + self._np_offsets[None, :])
+        return self.ctr_table.prepare(folded)
+
+    def attach_trainer(self, trainer):
+        """Heter mode: bind the hot tier to the trainer's live state."""
+        self.ctr_table.attach(trainer)
+        return self
 
     def _fold_ids(self, ids):
         ids = jnp.asarray(ids)
@@ -69,6 +104,16 @@ class _RecBase(nn.Layer):
         out = table(safe)
         return out * mask[..., None].astype(out.dtype)
 
+    def _wide_and_emb(self, ids):
+        """(wide_per_field (B, F), embeddings (B, F, E)) for any mode.
+        Heter mode receives pre-prepared SLOT ids."""
+        if self.sparse == "heter":
+            rows = self.ctr_table(jnp.asarray(ids))      # (B, F, E+1)
+            return rows[..., 0], rows[..., 1:]
+        folded = self._fold_ids(ids)
+        wide = self._lookup(self.linear_emb, folded)[..., 0]
+        return wide, self._lookup(self.embedding, folded)
+
 
 class WideDeep(_RecBase):
     """wide (linear over sparse ids + dense) + deep (MLP over embeddings
@@ -77,9 +122,11 @@ class WideDeep(_RecBase):
     def __init__(self, field_dims: Sequence[int], dense_dim: int = 13,
                  embedding_dim: int = 16,
                  hidden_sizes: Sequence[int] = (128, 64, 32),
-                 sparse: bool = False, sparse_lr: float = 0.05):
+                 sparse=False, sparse_lr: float = 0.05,
+                 heter_capacity: int = 0,
+                 heter_optimizer: str = "adagrad"):
         super().__init__(field_dims, dense_dim, embedding_dim, sparse,
-                         sparse_lr)
+                         sparse_lr, heter_capacity, heter_optimizer)
         self.wide_dense = nn.Linear(dense_dim, 1)
         layers, prev = [], self.num_fields * embedding_dim + dense_dim
         for h in hidden_sizes:
@@ -91,11 +138,9 @@ class WideDeep(_RecBase):
     def forward(self, ids, dense=None):
         if dense is None:          # engine convention: one inputs pytree
             ids, dense = ids
-        folded = self._fold_ids(ids)
         dense = jnp.asarray(dense, jnp.float32)
-        wide = self._lookup(self.linear_emb, folded).sum(axis=(1, 2)) \
-            + self.wide_dense(dense)[:, 0]
-        emb = self._lookup(self.embedding, folded)           # (B, F, E)
+        wide_f, emb = self._wide_and_emb(ids)                # (B,F),(B,F,E)
+        wide = wide_f.sum(axis=1) + self.wide_dense(dense)[:, 0]
         deep_in = jnp.concatenate(
             [emb.reshape(emb.shape[0], -1), dense], axis=-1)
         return wide + self.deep(deep_in)[:, 0]
@@ -108,9 +153,11 @@ class DeepFM(_RecBase):
     def __init__(self, field_dims: Sequence[int], dense_dim: int = 13,
                  embedding_dim: int = 16,
                  hidden_sizes: Sequence[int] = (128, 64),
-                 sparse: bool = False, sparse_lr: float = 0.05):
+                 sparse=False, sparse_lr: float = 0.05,
+                 heter_capacity: int = 0,
+                 heter_optimizer: str = "adagrad"):
         super().__init__(field_dims, dense_dim, embedding_dim, sparse,
-                         sparse_lr)
+                         sparse_lr, heter_capacity, heter_optimizer)
         self.dense_first = nn.Linear(dense_dim, 1)
         layers, prev = [], self.num_fields * embedding_dim + dense_dim
         for h in hidden_sizes:
@@ -122,11 +169,9 @@ class DeepFM(_RecBase):
     def forward(self, ids, dense=None):
         if dense is None:          # engine convention: one inputs pytree
             ids, dense = ids
-        folded = self._fold_ids(ids)
         dense = jnp.asarray(dense, jnp.float32)
-        first = self._lookup(self.linear_emb, folded).sum(axis=(1, 2)) \
-            + self.dense_first(dense)[:, 0]
-        v = self._lookup(self.embedding, folded)             # (B, F, E)
+        first_f, v = self._wide_and_emb(ids)                 # (B,F),(B,F,E)
+        first = first_f.sum(axis=1) + self.dense_first(dense)[:, 0]
         sum_sq = jnp.square(v.sum(axis=1))
         sq_sum = jnp.square(v).sum(axis=1)
         second = 0.5 * (sum_sq - sq_sum).sum(axis=-1)

@@ -1,0 +1,278 @@
+"""HeterEmbedding (device-resident hot tier over host PS) + the
+alltoall sharded-lookup op.
+
+Reference capability: framework/fleet/heter_ps/hashtable.h:47,
+heter_comm.h:50 (GPU-resident embedding tier with device optimizer and
+inter-device row exchange; CPU PS as the full store). The equality
+tests pin the contract: the two-tier path must produce the SAME
+training trajectory as the host-only PS path.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.engine import ParallelTrainer
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.distributed.ps import (DistributedEmbedding,
+                                       HeterEmbedding, SparseTable)
+
+
+class TestTierExchange:
+    def test_export_import_roundtrip_with_slots(self):
+        t = SparseTable(4, optimizer="adagrad", init_range=0.0)
+        keys = np.asarray([3, 99, 12345], np.int64)
+        t.push(keys, np.ones((3, 4), np.float32), lr=0.1)
+        rows = t.export_rows(keys)
+        assert rows.shape == (3, t.row_width) and t.row_width == 8
+        # adagrad slot column = accumulated g^2 = 1.0 after one push
+        np.testing.assert_allclose(rows[:, 4:], 1.0)
+        rows2 = rows.copy()
+        rows2[:, :4] = 7.0
+        t.import_rows(keys, rows2)
+        np.testing.assert_allclose(t.pull(keys), 7.0)
+        # slot column preserved
+        np.testing.assert_allclose(t.export_rows(keys)[:, 4:], 1.0)
+
+    def test_export_missing_zero_or_init(self):
+        t = SparseTable(4, optimizer="sgd", init_range=0.0)
+        rows = t.export_rows(np.asarray([5], np.int64),
+                             create_missing=False)
+        np.testing.assert_allclose(rows, 0.0)
+        assert len(t) == 0
+
+
+def _make_pooled_model(emb):
+    """score = sum over pooled embedding -> scalar-per-row logit."""
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = emb
+            self.head = nn.Linear(emb.dim, 1)
+
+        def forward(self, ids):
+            return self.head(self.emb(ids))[..., 0, 0]
+
+    return M()
+
+
+def _mse(o, y):
+    return jnp.mean(jnp.square(o - y))
+
+
+def _run_host_path(ids_seq, y_seq, dim, lr, optimizer):
+    build_mesh({"data": 1})
+    paddle.seed(0)
+    emb = DistributedEmbedding(dim, optimizer=optimizer, lr=lr,
+                               init_range=0.0)
+    m = _make_pooled_model(emb)
+    opt = (paddle.optimizer.SGD(lr, parameters=m.parameters())
+           if optimizer == "sgd" else
+           paddle.optimizer.Adagrad(lr, epsilon=1e-8,
+                                    parameters=m.parameters()))
+    tr = ParallelTrainer(m, opt, _mse)
+    return [float(tr.train_step(i, y)) for i, y in zip(ids_seq, y_seq)]
+
+
+def _run_heter_path(ids_seq, y_seq, dim, lr, optimizer, capacity,
+                    shard_axis=None, mesh_degrees=None):
+    build_mesh(mesh_degrees or {"data": 1})
+    paddle.seed(0)
+    emb = HeterEmbedding(dim, capacity=capacity, optimizer=optimizer,
+                         init_range=0.0, shard_axis=shard_axis)
+    m = _make_pooled_model(emb)
+    opt = (paddle.optimizer.SGD(lr, parameters=m.parameters())
+           if optimizer == "sgd" else
+           paddle.optimizer.Adagrad(lr, epsilon=1e-8,
+                                    parameters=m.parameters()))
+    tr = ParallelTrainer(m, opt, _mse)
+    emb.attach(tr)
+    losses = []
+    for ids, y in zip(ids_seq, y_seq):
+        slots = emb.prepare(ids)
+        losses.append(float(tr.train_step(slots, y)))
+    return losses, emb
+
+
+def _batches(n_steps, batch, width, vocab, seed=0, distinct=True):
+    rs = np.random.RandomState(seed)
+    ids_seq, y_seq = [], []
+    for _ in range(n_steps):
+        if distinct:
+            ids = rs.choice(vocab, size=(batch * width,), replace=False)
+        else:
+            ids = rs.randint(0, vocab, size=(batch * width,))
+        ids_seq.append(ids.reshape(batch, width).astype(np.int64))
+        y_seq.append(rs.randn(batch).astype(np.float32))
+    return ids_seq, y_seq
+
+
+class TestHeterVsHost:
+    """The device-tier trajectory must equal the host-PS trajectory."""
+
+    def test_sgd_no_eviction(self):
+        ids_seq, y_seq = _batches(6, 4, 3, vocab=40)
+        host = _run_host_path(ids_seq, y_seq, 8, 0.1, "sgd")
+        het, emb = _run_heter_path(ids_seq, y_seq, 8, 0.1, "sgd",
+                                   capacity=64)
+        np.testing.assert_allclose(host, het, rtol=2e-4)
+        assert emb.stats["evicts"] == 0
+
+    def test_sgd_with_evictions(self):
+        # capacity 16 but 12 distinct keys per batch from a 60-key space
+        # -> heavy churn; SGD handoff is exact (no slot columns)
+        ids_seq, y_seq = _batches(8, 4, 3, vocab=60, seed=3)
+        host = _run_host_path(ids_seq, y_seq, 8, 0.1, "sgd")
+        het, emb = _run_heter_path(ids_seq, y_seq, 8, 0.1, "sgd",
+                                   capacity=16)
+        np.testing.assert_allclose(host, het, rtol=2e-4)
+        assert emb.stats["evicts"] > 0
+
+    def test_adagrad_slot_handoff_across_eviction(self):
+        # repeated keys across batches + eviction churn: the adagrad
+        # accumulator must migrate device->PS->device intact
+        ids_seq, y_seq = _batches(10, 4, 3, vocab=40, seed=5)
+        host = _run_host_path(ids_seq, y_seq, 8, 0.1, "adagrad")
+        het, emb = _run_heter_path(ids_seq, y_seq, 8, 0.1, "adagrad",
+                                   capacity=16)
+        np.testing.assert_allclose(host, het, rtol=5e-4)
+        assert emb.stats["evicts"] > 0
+
+    def test_padding_ids(self):
+        build_mesh({"data": 1})
+        paddle.seed(0)
+        emb = HeterEmbedding(4, capacity=8, optimizer="sgd",
+                             init_range=0.01)
+        slots = emb.prepare(np.asarray([[1, -1], [2, 1]], np.int64))
+        assert slots[0, 1] == -1
+        out = emb(jnp.asarray(slots))
+        np.testing.assert_allclose(np.asarray(out[0, 1]), 0.0)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        build_mesh({"data": 1})
+        paddle.seed(0)
+        emb = HeterEmbedding(4, capacity=8, optimizer="sgd",
+                             init_range=0.05)
+        slots = emb.prepare(np.asarray([3, 7, 11], np.int64))
+        vals = np.asarray(emb(jnp.asarray(slots)))
+        p = str(tmp_path / "heter_table")
+        emb.save(p)
+        emb2 = HeterEmbedding(4, capacity=8, optimizer="sgd",
+                              init_range=0.05)
+        emb2.load(p)
+        slots2 = emb2.prepare(np.asarray([3, 7, 11], np.int64))
+        np.testing.assert_allclose(
+            np.asarray(emb2(jnp.asarray(slots2))), vals, rtol=1e-6)
+
+
+class TestShardedHotTier:
+    def test_model_sharded_matches_replicated(self):
+        ids_seq, y_seq = _batches(5, 4, 3, vocab=30, seed=7)
+        ref, _ = _run_heter_path(ids_seq, y_seq, 8, 0.1, "sgd",
+                                 capacity=64)
+        shd, emb = _run_heter_path(ids_seq, y_seq, 8, 0.1, "sgd",
+                                   capacity=64, shard_axis="model",
+                                   mesh_degrees={"data": 2, "model": 4})
+        np.testing.assert_allclose(ref, shd, rtol=2e-4)
+
+
+class TestWideDeepHeter:
+    def test_e2e_trains_and_matches_host_path(self):
+        from paddle_tpu.rec import WideDeep
+        fields = [50] * 4
+        rs = np.random.RandomState(0)
+        batches = [(rs.randint(0, 50, (8, 4)).astype(np.int64),
+                    rs.randn(8, 5).astype(np.float32),
+                    rs.randint(0, 2, 8).astype(np.float32))
+                   for _ in range(6)]
+
+        def bce(logit, y):
+            return jnp.mean(jnp.maximum(logit, 0) - logit * y
+                            + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+        def run(mode):
+            build_mesh({"data": 1})
+            paddle.seed(0)
+            m = WideDeep(fields, dense_dim=5, embedding_dim=4,
+                         hidden_sizes=(16,), sparse=mode,
+                         heter_capacity=64)  # < 200 keys -> evictions
+            opt = paddle.optimizer.Adagrad(
+                0.05, epsilon=1e-8, parameters=m.parameters())
+            tr = ParallelTrainer(m, opt, bce)
+            if mode == "heter":
+                m.attach_trainer(tr)
+            losses = []
+            for ids, dense, y in batches:
+                if mode == "heter":
+                    ids = m.prepare_batch(ids)
+                losses.append(float(tr.train_step((ids, dense), y)))
+            return losses, m
+
+        host, _ = run(True)
+        het, m = run("heter")
+        assert het[-1] < het[0]          # it trains
+        # same math through both tiers. The paths are not bit-identical:
+        # the host tier applies per-OCCURRENCE adagrad updates while the
+        # device tier scatter-adds duplicate ids then updates once (the
+        # random batches repeat ids within a field), so compare loosely.
+        np.testing.assert_allclose(host, het, rtol=0.15)
+        assert m.ctr_table.stats["evicts"] > 0
+
+
+class TestAlltoallLookup:
+    """ops/sharded_embedding.alltoall_lookup: batch-sharded ids over a
+    row-sharded table (the heter_comm.h id-exchange pattern)."""
+
+    def _setup(self):
+        from paddle_tpu.ops.sharded_embedding import alltoall_lookup
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("data",))
+        R, dim, n_local = 64, 5, 16
+        rows = jnp.asarray(
+            np.random.RandomState(0).randn(R, dim), jnp.float32)
+        ids = np.random.RandomState(1).randint(
+            -1, R, (n_local * 8,)).astype(np.int32)
+        return alltoall_lookup, mesh, R, dim, rows, ids
+
+    @pytest.mark.parametrize("cap", [1, 3, 16])
+    def test_forward_matches_dense(self, cap):
+        op, mesh, R, dim, rows, ids = self._setup()
+
+        def f(local_rows, ids_l):
+            return op(local_rows, ids_l, "data", cap, R // 8)
+
+        out = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=(P("data", None), P("data")),
+            out_specs=P("data"), check_vma=False))(rows, jnp.asarray(ids))
+        ref = np.where((ids >= 0)[:, None],
+                       np.asarray(rows)[np.clip(ids, 0, R - 1)], 0.0)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+    @pytest.mark.parametrize("cap", [1, 3, 16])
+    def test_grads_match_dense(self, cap):
+        op, mesh, R, dim, rows, ids = self._setup()
+        N = ids.shape[0]
+        coef = (1.0 + jnp.arange(N, dtype=jnp.float32))[:, None]
+
+        def loss_sharded(rows, ids, coef):
+            def inner(local_rows, ids_l, coef_l):
+                o = op(local_rows, ids_l, "data", cap, R // 8)
+                return jax.lax.psum(jnp.sum(o * coef_l), "data")
+            return shard_map(
+                inner, mesh=mesh,
+                in_specs=(P("data", None), P("data"), P("data", None)),
+                out_specs=P(), check_vma=False)(rows, ids, coef)
+
+        def loss_dense(rows, ids, coef):
+            o = jnp.where((ids >= 0)[:, None],
+                          rows[jnp.clip(ids, 0, R - 1)], 0.0)
+            return jnp.sum(o * coef)
+
+        g1 = jax.grad(loss_sharded)(rows, jnp.asarray(ids), coef)
+        g2 = jax.grad(loss_dense)(rows, jnp.asarray(ids), coef)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-5)
