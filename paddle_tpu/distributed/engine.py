@@ -199,6 +199,14 @@ class ParallelTrainer:
         reduce_axes = DATA_AXES + ("sep",) if sep else DATA_AXES
 
         pp_loss = pp_grads = None
+        if pp is not None and sep and pp._uniform_fns() is None:
+            raise NotImplementedError(
+                "pipeline + context parallelism ('sep' axis) requires a "
+                "plan that decomposes into prologue/stacked-body/epilogue "
+                "(PipelineLayer.uniform_split): the switch-dispatch "
+                "fallback issues ring-attention collectives from "
+                "per-device branches, which deadlocks or silently "
+                "corrupts the exchange")
         if pp is not None:
             if getattr(pp, "schedule", "gpipe") == "1f1b":
                 # 1F1B computes grads itself (manual per-stage VJP inside

@@ -262,6 +262,31 @@ class PipelineLayer(Layer):
         lo, hi = self.segment[stage_id], self.segment[stage_id + 1]
         return [(i, self.plan[i]) for i in range(lo, hi)]
 
+    def uniform_split(self):
+        """Decompose the plan as (pre_items, stack_gid, post_items) when
+        the pipeline has the canonical transformer shape: stage-0-only
+        prologue (embedding...), ONE stacked group spanning every stage
+        evenly, last-stage-only epilogue (norm/head...).
+
+        This is the shape the collective-safe uniform schedules need:
+        every device executes the SAME pre/stack/post program each tick
+        (heterogeneous parts masked by stage id), so collectives inside
+        layers (ring attention over "sep", TP psums) are issued uniformly
+        — collectives under a per-device lax.switch branch are undefined
+        behavior in SPMD (half the devices join one op instance, half
+        another: deadlock or silent data corruption). Returns None when
+        the plan does not decompose (the switch-based fallback schedules
+        then apply, which are only safe for collective-free stages).
+        """
+        if len(self.groups) != 1:
+            return None
+        a, b, _ = self.groups[0]
+        if a > self.segment[1] or b < self.segment[self.num_stages - 1]:
+            return None  # prologue/epilogue spill into middle stages
+        pre = [(i, self.plan[i]) for i in range(a)]
+        post = [(i, self.plan[i]) for i in range(b, len(self.plan))]
+        return pre, 0, post
+
     def owner_weight_key(self, owner_i: int, attr: str) -> str:
         """Flat param-dict key of a shared owner's weight."""
         return f"mod{owner_i}.{attr}"

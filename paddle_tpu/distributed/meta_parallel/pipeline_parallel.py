@@ -21,9 +21,17 @@ replicated over pipe; their gradients are psum'd over "pipe" by the engine
 so the replication is genuine (each stage contributes zeros for layers it
 does not run).
 
-Stage dispatch inside the SPMD program is a lax.switch on the stage id —
-the first stage consumes the (replicated) token microbatch, the last
-computes the loss; middle stages are pure activation → activation maps.
+Stage dispatch: when the layer plan decomposes as prologue -> uniform
+stacked body -> epilogue (PipelineLayer.uniform_split — the canonical
+transformer shape), every device executes the SAME pre/stack/post program
+each tick with the heterogeneous parts masked by stage id. This is the
+collective-safe form: collectives inside the layers (ring attention's
+ppermute over "sep", TP psums) are issued by all devices in the same
+order. The older dispatch — a lax.switch on the stage id — is kept as a
+fallback for non-decomposable plans, but collectives under a per-device
+switch branch are undefined behavior in SPMD (devices join different op
+instances: ppermute deadlocks or silently exchanges the wrong tensors),
+so the engine refuses that fallback when the mesh has a "sep" axis.
 """
 from __future__ import annotations
 
@@ -68,7 +76,80 @@ class PipelineParallel(Layer):
     def forward(self, x):
         return self._layers(x)
 
-    # -- per-stage functional forward --------------------------------------
+    # -- uniform (collective-safe) building blocks --------------------------
+    def _apply_plain_items(self, items, params, buffers, x, key):
+        """Apply a run of non-stacked plan items functionally."""
+        layers = self._layers
+        for i, ent in items:
+            kind = ent[0]
+            if kind == "layer":
+                mod = getattr(layers, f"mod{i}")
+                x, _ = functional_call(
+                    mod, _extract(params, f"mod{i}"),
+                    _extract(buffers, f"mod{i}"), x,
+                    rng=jax.random.fold_in(key, i))
+            elif kind == "shared":
+                _, owner_i, fw, attr = ent
+                if fw is not None:
+                    w = params[layers.owner_weight_key(owner_i, attr)]
+                    x = fw(x, w)
+                else:
+                    owner = getattr(layers, f"mod{owner_i}")
+                    x, _ = functional_call(
+                        owner, _extract(params, f"mod{owner_i}"),
+                        _extract(buffers, f"mod{owner_i}"), x,
+                        rng=jax.random.fold_in(key, i))
+            else:  # pragma: no cover - uniform_split guarantees no stacks
+                raise AssertionError("stacked item in plain run")
+        return x
+
+    def _uniform_fns(self):
+        """(pre_fn, stack_fn, post_fn) for the uniform schedules, or None.
+
+        Each takes (params, buffers, x, key) and is executed by EVERY
+        device every tick: pre/post touch only pipe-replicated params, so
+        they compute identically everywhere (results masked by stage id
+        at the call site); stack_fn applies this device's k local stacked
+        members — structurally identical across stages, so any
+        collectives inside line up."""
+        split = self._layers.uniform_split()
+        if split is None:
+            return None
+        pre_items, gid, post_items = split
+        layers = self._layers
+        stack = getattr(layers, f"stack{gid}")
+        k = layers.groups[gid][2]
+        a = layers.groups[gid][0]
+
+        def pre_fn(params, buffers, x, key):
+            return self._apply_plain_items(pre_items, params, buffers, x,
+                                           key)
+
+        def stack_fn(params, buffers, x, key):
+            from .parallel_layers.pp_layers import _escape
+            sp = _extract(params, f"stack{gid}")
+            sb = _extract(buffers, f"stack{gid}")
+
+            def blk(h_c, xs):
+                pj, bj, j = xs
+                pj = {n: pj[_escape(n)] for n in stack.param_names}
+                bj = {n: bj[_escape(n)] for n in stack.buffer_names}
+                out, _ = functional_call(
+                    stack._template, pj, bj, h_c,
+                    rng=jax.random.fold_in(key, a + j))
+                return out, None
+
+            x, _ = lax.scan(jax.checkpoint(blk), x,
+                            (sp, sb, jnp.arange(k)))
+            return x
+
+        def post_fn(params, buffers, x, key):
+            return self._apply_plain_items(post_items, params, buffers, x,
+                                           key)
+
+        return pre_fn, stack_fn, post_fn
+
+    # -- per-stage functional forward (switch fallback) ---------------------
     def _stage_forward_fn(self, s):
         """Build fwd(params, buffers, h, key) applying stage `s`'s items.
 
@@ -149,6 +230,72 @@ class PipelineParallel(Layer):
         """
         S = self.num_stages
         M = micro_batches
+        uniform = self._uniform_fns()
+        if uniform is not None:
+            return self._uniform_pipeline_loss(loss_fn, M, uniform)
+        return self._switch_pipeline_loss(loss_fn, M)
+
+    def _uniform_pipeline_loss(self, loss_fn, M, uniform):
+        """Collective-safe GPipe: every tick, every device runs the SAME
+        pre -> stack -> post program; stage identity only selects inputs
+        and masks outputs. jax AD transposes the scan into the reverse
+        pipeline with the same uniformity."""
+        S = self.num_stages
+        pre_fn, stack_fn, post_fn = uniform
+
+        def pure_loss(params, buffers, key, inputs, labels):
+            sid = lax.axis_index(PIPE_AXIS)
+            is_first = sid == 0
+            is_last = sid == S - 1
+            mb = inputs.shape[0] // M
+            micro_in = inputs.reshape((M, mb) + inputs.shape[1:])
+            micro_lb = labels.reshape((M, mb) + labels.shape[1:])
+
+            probe = jax.eval_shape(
+                lambda: stack_fn(params, buffers,
+                                 pre_fn(params, buffers, micro_in[0],
+                                        key), key))
+            h_shape, h_dtype = probe.shape, probe.dtype
+            zeros_h = jnp.zeros(h_shape, h_dtype)
+
+            def compute(h_recv, m, k_t):
+                x_pre = pre_fn(params, buffers, micro_in[m], k_t)
+                x0 = jnp.where(is_first, x_pre.astype(h_dtype), h_recv)
+                h_out = stack_fn(params, buffers, x0, k_t)
+                # non-last stages feed ZEROS to the epilogue: the value
+                # is discarded by the mask below, and zeros keep the
+                # head numerics finite (no inf*0 NaNs in the transpose)
+                x_post = jnp.where(is_last, h_out, zeros_h)
+                out = post_fn(params, buffers, x_post, k_t)
+                l = loss_fn(out, micro_lb[m])
+                return h_out.astype(h_dtype), l
+
+            def tick(carry, t):
+                h_recv, loss_acc = carry
+                m = jnp.clip(t - sid, 0, M - 1)
+                valid = (t - sid >= 0) & (t - sid < M)
+                k_t = jax.random.fold_in(key, t)
+                h_out, l = jax.checkpoint(compute)(h_recv, m, k_t)
+                loss_acc = loss_acc + jnp.where(valid & is_last, l, 0.0)
+                h_next = lax.ppermute(
+                    h_out, PIPE_AXIS, [(i, (i + 1) % S) for i in range(S)])
+                return (h_next, loss_acc), None
+
+            (h_last, loss_acc), _ = lax.scan(
+                tick, (zeros_h, jnp.zeros((), jnp.float32)),
+                jnp.arange(M + S - 1))
+            from .parallel_layers.mp_layers import \
+                reduce_from_parallel_region
+            total = reduce_from_parallel_region(loss_acc, axis=PIPE_AXIS)
+            return total / M
+
+        return pure_loss
+
+    def _switch_pipeline_loss(self, loss_fn, M):
+        """lax.switch stage dispatch — fallback for plans that do not
+        decompose into pre/stack/post. Only safe when stages contain no
+        collectives (see module docstring)."""
+        S = self.num_stages
         stage_fns = [self._stage_forward_fn(s) for s in range(S)]
 
         def pure_loss(params, buffers, key, inputs, labels):
@@ -226,6 +373,121 @@ class PipelineParallel(Layer):
         """
         S = self.num_stages
         M = micro_batches
+        uniform = self._uniform_fns()
+        if uniform is not None:
+            return self._uniform_pipeline_grads(loss_fn, M, uniform)
+        return self._switch_pipeline_grads(loss_fn, M)
+
+    def _uniform_pipeline_grads(self, loss_fn, M, uniform):
+        """Collective-safe 1F1B: each tick every device runs the uniform
+        forward body AND the uniform backward body (a jax.vjp of the same
+        body), with stage identity only masking which results commit.
+        In the steady state different stages genuinely do forward and
+        backward work at the same tick — under the switch dispatch their
+        collectives would pair across phases (the silent-corruption
+        variant of the switch UB); here both phases' collective sequences
+        are issued by every device in the same order."""
+        S = self.num_stages
+        pre_fn, stack_fn, post_fn = uniform
+
+        def pure_grads(params, buffers, key, inputs, labels, wrt):
+            sid = lax.axis_index(PIPE_AXIS)
+            is_first = sid == 0
+            is_last = sid == S - 1
+            mb = inputs.shape[0] // M
+            micro_in = inputs.reshape((M, mb) + inputs.shape[1:])
+            micro_lb = labels.reshape((M, mb) + labels.shape[1:])
+            wrt_params = {k: params[k] for k in wrt}
+            rest = {k: v for k, v in params.items() if k not in wrt}
+
+            probe = jax.eval_shape(
+                lambda: stack_fn(params, buffers,
+                                 pre_fn(params, buffers, micro_in[0],
+                                        key), key))
+            h_shape, h_dtype = probe.shape, probe.dtype
+            zeros_h = jnp.zeros(h_shape, h_dtype)
+            gzero = jax.tree_util.tree_map(
+                lambda v: jnp.zeros(jnp.shape(v), jnp.float32), wrt_params)
+
+            def body_fwd(wp, x0b, m, k_m):
+                full = dict(rest)
+                full.update(wp)
+                x_pre = pre_fn(full, buffers, micro_in[m], k_m)
+                x0 = jnp.where(is_first, x_pre.astype(h_dtype), x0b)
+                return stack_fn(full, buffers, x0, k_m).astype(h_dtype)
+
+            def body_full(wp, x0b, m, k_m):
+                h = body_fwd(wp, x0b, m, k_m)
+                full = dict(rest)
+                full.update(wp)
+                x_post = jnp.where(is_last, h, zeros_h)
+                out = post_fn(full, buffers, x_post, k_m)
+                return h, loss_fn(out, micro_lb[m])
+
+            fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+            bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+            def tick(carry, t):
+                h_recv, cot_recv, stash, gacc, loss_acc = carry
+                # -- forward phase (t = s + 2f; see the switch variant's
+                # timing notes) --
+                td = t - sid
+                f_raw = td // 2
+                fwd_valid = (td >= 0) & (td % 2 == 0) & (f_raw < M)
+                f_idx = jnp.clip(f_raw, 0, M - 1)
+                h_out = body_fwd(wrt_params, h_recv,
+                                 f_idx, jax.random.fold_in(key, f_idx))
+                slot = f_idx % S
+                stash = stash.at[slot].set(
+                    jnp.where(fwd_valid, h_recv, stash[slot]))
+                # -- backward phase (t = 2S - 1 - s + 2m) --
+                bd = t - (2 * S - 1 - sid)
+                m_num = bd // 2
+                bwd_valid = (bd >= 0) & (bd % 2 == 0) & (m_num < M)
+                m_idx = jnp.clip(m_num, 0, M - 1)
+                k_b = jax.random.fold_in(key, m_idx)
+                h_in = stash[m_idx % S]
+                (h_b, l_m), vjp = jax.vjp(
+                    lambda wp, x0b: body_full(wp, x0b, m_idx, k_b),
+                    wrt_params, h_in)
+                # last stage seeds the loss cotangent; others propagate
+                # the received activation cotangent (their h feeds the
+                # next stage, never the loss)
+                cot_h = jnp.where(is_last, jnp.zeros_like(cot_recv),
+                                  cot_recv)
+                cot_l = jnp.where(is_last, jnp.float32(1.0 / M),
+                                  jnp.float32(0.0))
+                gw, gx = vjp((cot_h, cot_l.astype(l_m.dtype)))
+                gacc = jax.tree_util.tree_map(
+                    lambda a, g: a + jnp.where(bwd_valid, g, 0.0),
+                    gacc, gw)
+                loss_acc = loss_acc + jnp.where(bwd_valid & is_last,
+                                                l_m, 0.0)
+                # -- communicate --
+                h_next = lax.ppermute(
+                    jnp.where(fwd_valid, h_out, zeros_h), PIPE_AXIS,
+                    fwd_perm)
+                cot_next = lax.ppermute(
+                    jnp.where(bwd_valid, gx.astype(h_dtype), zeros_h),
+                    PIPE_AXIS, bwd_perm)
+                return (h_next, cot_next, stash, gacc, loss_acc), None
+
+            stash0 = jnp.zeros((S,) + h_shape, h_dtype)
+            carry0 = (zeros_h, zeros_h, stash0, gzero,
+                      jnp.zeros((), jnp.float32))
+            (h_l, c_l, st_l, gacc, loss_acc), _ = lax.scan(
+                tick, carry0, jnp.arange(2 * (M + S - 1)))
+            from .parallel_layers.mp_layers import \
+                reduce_from_parallel_region
+            total = reduce_from_parallel_region(loss_acc, axis=PIPE_AXIS)
+            return total / M, gacc
+
+        return pure_grads
+
+    def _switch_pipeline_grads(self, loss_fn, M):
+        """lax.switch 1F1B — fallback for non-decomposable plans; same
+        collective-safety caveat as _switch_pipeline_loss."""
+        S = self.num_stages
         stage_fns = [self._stage_forward_fn(s) for s in range(S)]
 
         def pure_grads(params, buffers, key, inputs, labels, wrt):

@@ -177,7 +177,7 @@ class HeterEmbedding(Layer):
     def _device_slot_names(self):
         if self._trainer is not None:
             return self._trainer.opt_slot_names(self._pname)
-        return self._slot_names
+        return ()  # eager mode: no optimizer slot state is reachable
 
     def _check_handoff(self):
         """Warn once when optimizer state cannot migrate between tiers
@@ -275,11 +275,11 @@ class HeterEmbedding(Layer):
         emb = emb * mask[..., None].astype(emb.dtype)
         if self.pooling is None:
             return emb
-        maskf = mask.astype(jnp.float32)[..., None]
-        s = jnp.sum(emb * maskf, axis=-2)
+        s = jnp.sum(emb, axis=-2)  # padded rows already zeroed above
         if self.pooling == "sum":
             return s
-        cnt = jnp.maximum(jnp.sum(maskf, axis=-2), 1.0)
+        cnt = jnp.maximum(
+            jnp.sum(mask.astype(jnp.float32)[..., None], axis=-2), 1.0)
         return s / cnt
 
     def _sharded_gather(self, safe):

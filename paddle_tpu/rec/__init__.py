@@ -85,7 +85,10 @@ class _RecBase(nn.Layer):
         return self.ctr_table.prepare(folded)
 
     def attach_trainer(self, trainer):
-        """Heter mode: bind the hot tier to the trainer's live state."""
+        """Heter mode: bind the hot tier to a hand-rolled trainer-style
+        state holder. ParallelTrainer binds automatically at
+        construction (_on_trainer_built) — no call needed there."""
+        assert self.sparse == "heter", "attach_trainer is heter-mode only"
         self.ctr_table.attach(trainer)
         return self
 

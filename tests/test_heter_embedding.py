@@ -90,8 +90,7 @@ def _run_heter_path(ids_seq, y_seq, dim, lr, optimizer, capacity,
            if optimizer == "sgd" else
            paddle.optimizer.Adagrad(lr, epsilon=1e-8,
                                     parameters=m.parameters()))
-    tr = ParallelTrainer(m, opt, _mse)
-    emb.attach(tr)
+    tr = ParallelTrainer(m, opt, _mse)  # auto-binds the hot tier
     losses = []
     for ids, y in zip(ids_seq, y_seq):
         slots = emb.prepare(ids)
@@ -204,8 +203,6 @@ class TestWideDeepHeter:
             opt = paddle.optimizer.Adagrad(
                 0.05, epsilon=1e-8, parameters=m.parameters())
             tr = ParallelTrainer(m, opt, bce)
-            if mode == "heter":
-                m.attach_trainer(tr)
             losses = []
             for ids, dense, y in batches:
                 if mode == "heter":

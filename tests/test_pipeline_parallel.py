@@ -96,6 +96,29 @@ class TestPipelineTrajectory:
         np.testing.assert_allclose(dense, pp, rtol=2e-4)
         assert dense[-1] < dense[0]  # actually learning
 
+    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+    def test_pp_sep_composition_matches_dense(self, schedule):
+        """pipe=2 x sep=2 (ring attention inside pipeline stages): the
+        round-4 regression. Under the old lax.switch stage dispatch the
+        per-branch sep-ppermutes paired across stages — deadlock (gpipe)
+        or silently wrong exchange (1f1b, trajectory diverged from step
+        1). The uniform pre/stack/post schedules issue every collective
+        on every device."""
+        x, y = _data()
+        tr_d, _ = _dense_trainer(_descs(False), data_degree=1)
+        dense = [float(tr_d.train_step(x, y)) for _ in range(4)]
+        build_mesh({"pipe": 2, "sep": 2})
+        paddle.seed(7)
+        pl = PipelineLayer(_descs(False), num_stages=2, seg_method=SEG)
+        topo = CommunicateTopology(("data", "pipe", "sharding", "model"),
+                                   (1, 2, 1, 1))
+        pp = PipelineParallel(pl, HybridCommunicateGroup(topo, 0),
+                              _Strat(4, schedule))
+        opt = paddle.optimizer.SGD(0.05, parameters=pp.parameters())
+        tr_p = ParallelTrainer(pp, opt, _loss_fn, micro_batches=4)
+        sep = [float(tr_p.train_step(x, y)) for _ in range(4)]
+        np.testing.assert_allclose(dense, sep, rtol=2e-4)
+
     def test_pp_tp_dp_composition_matches_dense(self):
         """Full hybrid composition: pipe=2 x model=2 x data=2 (8 devices,
         TP layers inside pipe-sharded stages, vocab-sharded loss) tracks

@@ -54,8 +54,6 @@ def main():
         opt = paddle.optimizer.Adagrad(0.05, epsilon=1e-8,
                                        parameters=model.parameters())
         tr = ParallelTrainer(model, opt, bce)
-        if mode == "heter":
-            model.attach_trainer(tr)
 
         def step(ids, dense, y):
             if mode == "heter":
