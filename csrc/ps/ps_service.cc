@@ -8,12 +8,23 @@
 //
 // Protocol (little-endian, fixed header):
 //   request : u8 op | u8 flag | i64 n
-//             op=0 HELLO: no body;              response: i32 dim
+//             op=0 HELLO: no body;              response: i32 dim (v1)
+//             op=9 HELLO2: no body;             response: i32 dim, i32 feat_dim
 //             op=1 PULL : body n*i64 keys;      response: n*dim f32
 //                         flag=1 -> create missing rows
 //             op=2 PUSH : body n*i64 keys, n*dim f32 grads, f32 lr;
 //                                               response: u8 1
 //             op=3 SIZE : no body;              response: i64 nrows
+// Graph ops (the server may also host a graph-table shard — the
+// reference's common_graph_table.cc served by the same brpc PS server;
+// cross-server NODE partitioning happens above by key hash):
+//             op=4 GADD : body n*i64 src, n*i64 dst (+ n*f32 w if flag);
+//                                               response: u8 1
+//             op=5 GSAMP: body n*i64 keys, i32 k, u64 seed; flag=weighted
+//                                               response: n*k i64 + n i64
+//             op=6 GFEAT: body n*i64 keys;      response: n*feat_dim f32
+//             op=7 GSETF: body n*i64 keys, n*feat_dim f32; response: u8 1
+//             op=8 GNUM : no body;              response: i64 nnodes
 // A malformed/short frame closes the connection. The server serves ONE
 // sparse table (its key shard); clients keep one connection per server and
 // serialize requests on it.
@@ -40,11 +51,24 @@ void ps_sparse_pull(void* t, const int64_t* keys, int64_t n, float* out,
 void ps_sparse_push(void* t, const int64_t* keys, int64_t n,
                     const float* grads, float lr);
 int64_t ps_sparse_size(void* t);
+// from graph_table.cc
+void ps_graph_add_edges(void* g, const int64_t* src, const int64_t* dst,
+                        const float* w, int64_t n);
+void ps_graph_sample_neighbors(void* g, const int64_t* keys, int64_t n,
+                               int k, uint64_t seed, int64_t* out,
+                               int64_t* counts, int weighted);
+void ps_graph_get_feature(void* g, const int64_t* keys, float* out,
+                          int64_t n);
+void ps_graph_set_feature(void* g, const int64_t* keys, const float* feats,
+                          int64_t n);
+int64_t ps_graph_num_nodes(void* g);
 }
 
 namespace {
 
-constexpr uint8_t kHello = 0, kPull = 1, kPush = 2, kSize = 3;
+constexpr uint8_t kHello = 0, kPull = 1, kPush = 2, kSize = 3,
+                  kGAdd = 4, kGSamp = 5, kGFeat = 6, kGSetF = 7, kGNum = 8,
+                  kHello2 = 9;
 
 bool ReadFull(int fd, void* buf, size_t n) {
   char* p = static_cast<char*>(buf);
@@ -70,6 +94,8 @@ bool WriteFull(int fd, const void* buf, size_t n) {
 
 struct Server {
   void* table = nullptr;
+  void* graph = nullptr;   // optional graph-table shard
+  int feat_dim = 0;
   int dim = 0;
   int listen_fd = -1;
   int port = 0;
@@ -118,8 +144,67 @@ struct Server {
       if (!ReadFull(fd, hdr, 2) || !ReadFull(fd, &n, 8)) break;
       if (n < 0 || n > (int64_t(1) << 28)) break;  // sanity cap
       if (hdr[0] == kHello) {
+        // v1 handshake: 4-byte reply, kept exactly as-is so an OLD
+        // client against a NEW server still works during rolling
+        // upgrades of a multi-host deployment
         int32_t d = dim;
         if (!WriteFull(fd, &d, 4)) break;
+      } else if (hdr[0] == kHello2) {
+        // v2 handshake (adds feat_dim). A NEW client against an OLD
+        // server fails fast: the old server closes on the unknown op,
+        // so connect() errors instead of hanging on a short read.
+        int32_t d[2] = {dim, feat_dim};
+        if (!WriteFull(fd, d, 8)) break;
+      } else if (hdr[0] == kGAdd && graph) {
+        std::vector<int64_t> dst(static_cast<size_t>(n));
+        std::vector<float> w;
+        keys.resize(static_cast<size_t>(n));
+        if (!ReadFull(fd, keys.data(), sizeof(int64_t) * n) ||
+            !ReadFull(fd, dst.data(), sizeof(int64_t) * n))
+          break;
+        if (hdr[1]) {
+          w.resize(static_cast<size_t>(n));
+          if (!ReadFull(fd, w.data(), sizeof(float) * n)) break;
+        }
+        ps_graph_add_edges(graph, keys.data(), dst.data(),
+                           hdr[1] ? w.data() : nullptr, n);
+        uint8_t ok = 1;
+        if (!WriteFull(fd, &ok, 1)) break;
+      } else if (hdr[0] == kGSamp && graph) {
+        int32_t k = 0;
+        uint64_t seed = 0;
+        keys.resize(static_cast<size_t>(n));
+        if (!ReadFull(fd, keys.data(), sizeof(int64_t) * n) ||
+            !ReadFull(fd, &k, 4) || !ReadFull(fd, &seed, 8) || k < 0 ||
+            k > (1 << 20) || n * static_cast<int64_t>(k) > (int64_t(1) << 28))
+          break;  // cap the PRODUCT too: a bad_alloc would kill the process
+        std::vector<int64_t> nbrs(static_cast<size_t>(n) * k);
+        std::vector<int64_t> counts(static_cast<size_t>(n));
+        ps_graph_sample_neighbors(graph, keys.data(), n, k, seed,
+                                  nbrs.data(), counts.data(),
+                                  hdr[1] ? 1 : 0);
+        if (!WriteFull(fd, nbrs.data(), sizeof(int64_t) * n * k) ||
+            !WriteFull(fd, counts.data(), sizeof(int64_t) * n))
+          break;
+      } else if (hdr[0] == kGFeat && graph) {
+        keys.resize(static_cast<size_t>(n));
+        vals.resize(static_cast<size_t>(n) * feat_dim);
+        if (!ReadFull(fd, keys.data(), sizeof(int64_t) * n)) break;
+        ps_graph_get_feature(graph, keys.data(), vals.data(), n);
+        if (!WriteFull(fd, vals.data(), sizeof(float) * n * feat_dim))
+          break;
+      } else if (hdr[0] == kGSetF && graph) {
+        keys.resize(static_cast<size_t>(n));
+        vals.resize(static_cast<size_t>(n) * feat_dim);
+        if (!ReadFull(fd, keys.data(), sizeof(int64_t) * n) ||
+            !ReadFull(fd, vals.data(), sizeof(float) * n * feat_dim))
+          break;
+        ps_graph_set_feature(graph, keys.data(), vals.data(), n);
+        uint8_t ok = 1;
+        if (!WriteFull(fd, &ok, 1)) break;
+      } else if (hdr[0] == kGNum && graph) {
+        int64_t sz = ps_graph_num_nodes(graph);
+        if (!WriteFull(fd, &sz, 8)) break;
       } else if (hdr[0] == kPull) {
         keys.resize(static_cast<size_t>(n));
         vals.resize(static_cast<size_t>(n) * dim);
@@ -172,6 +257,7 @@ struct Server {
 struct Client {
   int fd = -1;
   int dim = 0;
+  int feat_dim = 0;
   std::mutex mu;  // serialize request/response pairs
 };
 
@@ -179,9 +265,11 @@ struct Client {
 
 extern "C" {
 
-// Start serving `sparse_table` (a ps_sparse_create handle) on `port`
-// (0 = ephemeral). Returns a server handle or null.
-void* ps_server_start(void* sparse_table, int dim, int port) {
+// Start serving `sparse_table` (a ps_sparse_create handle) — and
+// optionally `graph_table` (a ps_graph_create handle, null for none) —
+// on `port` (0 = ephemeral). Returns a server handle or null.
+void* ps_server_start2(void* sparse_table, int dim, void* graph_table,
+                       int feat_dim, int port) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return nullptr;
   int one = 1;
@@ -202,11 +290,17 @@ void* ps_server_start(void* sparse_table, int dim, int port) {
   ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
   auto* s = new Server();
   s->table = sparse_table;
+  s->graph = graph_table;
+  s->feat_dim = feat_dim;
   s->dim = dim;
   s->listen_fd = fd;
   s->port = ntohs(addr.sin_port);
   s->accept_thread = std::thread([s]() { s->AcceptLoop(); });
   return s;
+}
+
+void* ps_server_start(void* sparse_table, int dim, int port) {
+  return ps_server_start2(sparse_table, dim, nullptr, 0, port);
 }
 
 int ps_server_port(void* h) { return static_cast<Server*>(h)->port; }
@@ -242,21 +336,95 @@ void* ps_client_connect(const char* host, int port) {
   }
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  uint8_t hdr[2] = {kHello, 0};
+  uint8_t hdr[2] = {kHello2, 0};
   int64_t n = 0;
-  int32_t dim = 0;
+  int32_t dims[2] = {0, 0};
   if (!WriteFull(fd, hdr, 2) || !WriteFull(fd, &n, 8) ||
-      !ReadFull(fd, &dim, 4)) {
+      !ReadFull(fd, dims, 8)) {
     ::close(fd);
     return nullptr;
   }
   auto* c = new Client();
   c->fd = fd;
-  c->dim = dim;
+  c->dim = dims[0];
+  c->feat_dim = dims[1];
   return c;
 }
 
 int ps_client_dim(void* h) { return static_cast<Client*>(h)->dim; }
+
+int ps_client_feat_dim(void* h) {
+  return static_cast<Client*>(h)->feat_dim;
+}
+
+int ps_client_graph_add_edges(void* h, const int64_t* src,
+                              const int64_t* dst, const float* w,
+                              int64_t n) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint8_t hdr[2] = {kGAdd, static_cast<uint8_t>(w ? 1 : 0)};
+  uint8_t ok = 0;
+  if (!WriteFull(c->fd, hdr, 2) || !WriteFull(c->fd, &n, 8) ||
+      !WriteFull(c->fd, src, sizeof(int64_t) * n) ||
+      !WriteFull(c->fd, dst, sizeof(int64_t) * n) ||
+      (w && !WriteFull(c->fd, w, sizeof(float) * n)) ||
+      !ReadFull(c->fd, &ok, 1))
+    return 0;
+  return ok ? 1 : 0;
+}
+
+int ps_client_graph_sample(void* h, const int64_t* keys, int64_t n, int k,
+                           uint64_t seed, int64_t* out, int64_t* counts,
+                           int weighted) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint8_t hdr[2] = {kGSamp, static_cast<uint8_t>(weighted ? 1 : 0)};
+  int32_t k32 = k;
+  if (!WriteFull(c->fd, hdr, 2) || !WriteFull(c->fd, &n, 8) ||
+      !WriteFull(c->fd, keys, sizeof(int64_t) * n) ||
+      !WriteFull(c->fd, &k32, 4) || !WriteFull(c->fd, &seed, 8) ||
+      !ReadFull(c->fd, out, sizeof(int64_t) * n * k) ||
+      !ReadFull(c->fd, counts, sizeof(int64_t) * n))
+    return 0;
+  return 1;
+}
+
+int ps_client_graph_feature(void* h, const int64_t* keys, int64_t n,
+                            float* out) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint8_t hdr[2] = {kGFeat, 0};
+  if (!WriteFull(c->fd, hdr, 2) || !WriteFull(c->fd, &n, 8) ||
+      !WriteFull(c->fd, keys, sizeof(int64_t) * n) ||
+      !ReadFull(c->fd, out, sizeof(float) * n * c->feat_dim))
+    return 0;
+  return 1;
+}
+
+int ps_client_graph_set_feature(void* h, const int64_t* keys, int64_t n,
+                                const float* feats) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint8_t hdr[2] = {kGSetF, 0};
+  uint8_t ok = 0;
+  if (!WriteFull(c->fd, hdr, 2) || !WriteFull(c->fd, &n, 8) ||
+      !WriteFull(c->fd, keys, sizeof(int64_t) * n) ||
+      !WriteFull(c->fd, feats, sizeof(float) * n * c->feat_dim) ||
+      !ReadFull(c->fd, &ok, 1))
+    return 0;
+  return ok ? 1 : 0;
+}
+
+int64_t ps_client_graph_num_nodes(void* h) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint8_t hdr[2] = {kGNum, 0};
+  int64_t n = 0, sz = -1;
+  if (!WriteFull(c->fd, hdr, 2) || !WriteFull(c->fd, &n, 8) ||
+      !ReadFull(c->fd, &sz, 8))
+    return -1;
+  return sz;
+}
 
 int ps_client_pull(void* h, const int64_t* keys, int64_t n, float* out,
                    int create_missing) {

@@ -129,6 +129,10 @@ class PipelineParallel(Layer):
             from .parallel_layers.pp_layers import _escape
             sp = _extract(params, f"stack{gid}")
             sb = _extract(buffers, f"stack{gid}")
+            # rng folds with the GLOBAL member index (stage offset +
+            # local j): folding with the local index alone would hand
+            # every stage's j-th block the same dropout stream
+            j0 = a + lax.axis_index(PIPE_AXIS) * k
 
             def blk(h_c, xs):
                 pj, bj, j = xs
@@ -136,7 +140,7 @@ class PipelineParallel(Layer):
                 bj = {n: bj[_escape(n)] for n in stack.buffer_names}
                 out, _ = functional_call(
                     stack._template, pj, bj, h_c,
-                    rng=jax.random.fold_in(key, a + j))
+                    rng=jax.random.fold_in(key, j0 + j))
                 return out, None
 
             x, _ = lax.scan(jax.checkpoint(blk), x,

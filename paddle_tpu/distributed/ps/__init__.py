@@ -9,7 +9,8 @@ dataset. See each module's docstring for the reference capability map
 from .datafeed import InMemoryDataset, QueueDataset  # noqa: F401
 from .embedding import DistributedEmbedding, make_lookup  # noqa: F401
 from .heter import HeterEmbedding  # noqa: F401
-from .service import DistributedSparseTable, PsServer  # noqa: F401
+from .service import (DistributedGraphTable, DistributedSparseTable,  # noqa: F401
+                      PsServer)
 from .table import (DenseTable, GraphTable, SparseTable,  # noqa: F401
                     shard_keys)
 from .trainer import (Communicator, DownpourWorker,  # noqa: F401

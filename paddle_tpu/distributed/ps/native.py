@@ -96,6 +96,26 @@ def lib() -> ctypes.CDLL:
 
         dll.ps_server_start.restype = c.c_void_p
         dll.ps_server_start.argtypes = [c.c_void_p, c.c_int, c.c_int]
+        dll.ps_server_start2.restype = c.c_void_p
+        dll.ps_server_start2.argtypes = [c.c_void_p, c.c_int, c.c_void_p,
+                                         c.c_int, c.c_int]
+        dll.ps_client_feat_dim.restype = c.c_int
+        dll.ps_client_feat_dim.argtypes = [c.c_void_p]
+        dll.ps_client_graph_add_edges.restype = c.c_int
+        dll.ps_client_graph_add_edges.argtypes = [c.c_void_p, p_i64, p_i64,
+                                                  p_f32, i64]
+        dll.ps_client_graph_sample.restype = c.c_int
+        dll.ps_client_graph_sample.argtypes = [c.c_void_p, p_i64, i64,
+                                               c.c_int, c.c_uint64, p_i64,
+                                               p_i64, c.c_int]
+        dll.ps_client_graph_feature.restype = c.c_int
+        dll.ps_client_graph_feature.argtypes = [c.c_void_p, p_i64, i64,
+                                                p_f32]
+        dll.ps_client_graph_set_feature.restype = c.c_int
+        dll.ps_client_graph_set_feature.argtypes = [c.c_void_p, p_i64, i64,
+                                                    p_f32]
+        dll.ps_client_graph_num_nodes.restype = i64
+        dll.ps_client_graph_num_nodes.argtypes = [c.c_void_p]
         dll.ps_server_port.restype = c.c_int
         dll.ps_server_port.argtypes = [c.c_void_p]
         dll.ps_server_stop.argtypes = [c.c_void_p]

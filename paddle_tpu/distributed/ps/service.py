@@ -30,15 +30,24 @@ from .table import SparseTable, shard_keys, _as_f32, _as_i64, _fp, _ip
 
 
 class PsServer:
-    """Serves one key shard of a sparse table over TCP (reference:
-    brpc_ps_server.cc). Owns the table; keeps it accessible in-process
-    (e.g. for checkpointing via ``table.save``)."""
+    """Serves one key shard of a sparse table — and optionally one NODE
+    shard of a graph table (``graph_feat_dim``) — over TCP (reference:
+    brpc_ps_server.cc serving common_sparse_table + common_graph_table).
+    Owns the tables; keeps them accessible in-process (e.g. for
+    checkpointing via ``table.save``)."""
 
     def __init__(self, dim: int, optimizer: str = "adagrad", port: int = 0,
-                 host: str = "127.0.0.1", **table_kwargs):
+                 host: str = "127.0.0.1",
+                 graph_feat_dim: Optional[int] = None, **table_kwargs):
+        from .table import GraphTable
         self.table = SparseTable(dim, optimizer, **table_kwargs)
+        self.graph = (GraphTable(graph_feat_dim)
+                      if graph_feat_dim is not None else None)
         self._lib = lib()
-        self._h = self._lib.ps_server_start(self.table._h, dim, port)
+        self._h = self._lib.ps_server_start2(
+            self.table._h, dim,
+            self.graph._h if self.graph is not None else None,
+            graph_feat_dim or 0, port)
         if not self._h:
             raise OSError(f"failed to start PS server on port {port}")
         self.host = host
@@ -69,6 +78,40 @@ class _Conn:
             raise ConnectionError(f"cannot connect to PS at {endpoint}")
         self.dim = int(self._lib.ps_client_dim(self._h))
 
+    @property
+    def feat_dim(self) -> int:
+        return int(self._lib.ps_client_feat_dim(self._h))
+
+    def graph_add_edges(self, src, dst, w=None):
+        wp = _fp(w) if w is not None else None
+        if not self._lib.ps_client_graph_add_edges(self._h, _ip(src),
+                                                   _ip(dst), wp, src.size):
+            raise ConnectionError("PS graph add_edges RPC failed")
+
+    def graph_sample(self, keys, k, seed, weighted):
+        out = np.empty((keys.size, k), dtype=np.int64)
+        counts = np.empty((keys.size,), dtype=np.int64)
+        if not self._lib.ps_client_graph_sample(
+                self._h, _ip(keys), keys.size, int(k), int(seed), _ip(out),
+                _ip(counts), 1 if weighted else 0):
+            raise ConnectionError("PS graph sample RPC failed")
+        return out, counts
+
+    def graph_feature(self, keys, feat_dim):
+        out = np.empty((keys.size, feat_dim), dtype=np.float32)
+        if not self._lib.ps_client_graph_feature(self._h, _ip(keys),
+                                                 keys.size, _fp(out)):
+            raise ConnectionError("PS graph feature RPC failed")
+        return out
+
+    def graph_set_feature(self, keys, feats):
+        if not self._lib.ps_client_graph_set_feature(self._h, _ip(keys),
+                                                     keys.size, _fp(feats)):
+            raise ConnectionError("PS graph set_feature RPC failed")
+
+    def graph_num_nodes(self) -> int:
+        return int(self._lib.ps_client_graph_num_nodes(self._h))
+
     def pull(self, keys: np.ndarray, create: bool) -> np.ndarray:
         out = np.empty((keys.size, self.dim), dtype=np.float32)
         if not self._lib.ps_client_pull(self._h, _ip(keys), keys.size,
@@ -96,40 +139,18 @@ class _Conn:
             pass
 
 
-class DistributedSparseTable:
-    """Client view of a sparse table sharded across PS servers by key hash.
+class _ShardedClient:
+    """Shared key-hash routing + concurrent per-shard fan-out (each _Conn
+    has its own socket+lock — the reference brpc client's parallel
+    fan-out; sequential round trips would cost n_shards x RTT)."""
 
-    ``pull``/``push`` route each key to its owning server (reference:
-    brpc_ps_client pull_sparse/push_sparse fan-out). ``async_mode`` drains
-    pushes from a bounded queue on a background thread — the reference
-    Communicator's geo/async semantics (communicator.h:197): training does
-    not block on the push RPC, staleness is bounded by the queue depth.
-    """
-
-    def __init__(self, endpoints: Sequence[str], async_mode: bool = False,
-                 max_pending: int = 8):
+    def __init__(self, endpoints: Sequence[str]):
         assert endpoints, "need at least one PS endpoint"
         self.conns: List[_Conn] = [_Conn(e) for e in endpoints]
-        self.dim = self.conns[0].dim
-        for e, c in zip(endpoints, self.conns):
-            if c.dim != self.dim:
-                raise ValueError(
-                    f"PS dim mismatch: {endpoints[0]} serves dim "
-                    f"{self.dim} but {e} serves dim {c.dim}")
         self.n_shards = len(self.conns)
-        # per-shard RPCs fan out concurrently (each _Conn has its own
-        # socket+lock) — the reference brpc client's parallel fan-out;
-        # sequential round trips would cost n_shards x RTT per lookup
         self._pool = (ThreadPoolExecutor(max_workers=self.n_shards)
                       if self.n_shards > 1 else None)
-        self.async_mode = async_mode
-        self._err: Optional[BaseException] = None
-        if async_mode:
-            self._q: "queue.Queue" = queue.Queue(maxsize=max_pending)
-            self._worker = threading.Thread(target=self._drain, daemon=True)
-            self._worker.start()
 
-    # -- routing -------------------------------------------------------------
     def _route(self, keys: np.ndarray):
         assign = shard_keys(keys, self.n_shards)
         for s in range(self.n_shards):
@@ -145,6 +166,39 @@ class DistributedSparseTable:
         futs = [self._pool.submit(j) for j in jobs]
         for f in futs:
             f.result()  # re-raises ConnectionError from any shard
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+        for c in self.conns:
+            c.close()
+
+
+class DistributedSparseTable(_ShardedClient):
+    """Client view of a sparse table sharded across PS servers by key hash.
+
+    ``pull``/``push`` route each key to its owning server (reference:
+    brpc_ps_client pull_sparse/push_sparse fan-out). ``async_mode`` drains
+    pushes from a bounded queue on a background thread — the reference
+    Communicator's geo/async semantics (communicator.h:197): training does
+    not block on the push RPC, staleness is bounded by the queue depth.
+    """
+
+    def __init__(self, endpoints: Sequence[str], async_mode: bool = False,
+                 max_pending: int = 8):
+        super().__init__(endpoints)
+        self.dim = self.conns[0].dim
+        for e, c in zip(endpoints, self.conns):
+            if c.dim != self.dim:
+                raise ValueError(
+                    f"PS dim mismatch: {endpoints[0]} serves dim "
+                    f"{self.dim} but {e} serves dim {c.dim}")
+        self.async_mode = async_mode
+        self._err: Optional[BaseException] = None
+        if async_mode:
+            self._q: "queue.Queue" = queue.Queue(maxsize=max_pending)
+            self._worker = threading.Thread(target=self._drain, daemon=True)
+            self._worker.start()
 
     def pull(self, keys, create_missing: bool = True) -> np.ndarray:
         keys = _as_i64(keys)
@@ -209,7 +263,112 @@ class DistributedSparseTable:
             self._q.join()
             self._q.put(None)
             self._worker.join(timeout=5)
-        if self._pool is not None:
-            self._pool.shutdown(wait=True)
-        for c in self.conns:
-            c.close()
+        super().close()
+
+
+class DistributedGraphTable(_ShardedClient):
+    """Client view of a graph table NODE-partitioned across PS servers
+    (reference: common_graph_table.cc:1-596 served by brpc — each server
+    owns the adjacency + features of its hash shard of the node space).
+
+    Edges live with their SOURCE node's owner, so neighbor sampling for
+    a node is one RPC to its owner; sampled neighbor ids may belong to
+    ANY server — multi-hop sampling (``sample_hops``) re-routes each
+    hop's frontier to the owning servers, which is the cross-server
+    walk the reference's graph service performs.
+    """
+
+    def __init__(self, endpoints: Sequence[str]):
+        super().__init__(endpoints)
+        self.feat_dim = self.conns[0].feat_dim
+        for e, c in zip(endpoints, self.conns):
+            if c.feat_dim != self.feat_dim:
+                raise ValueError(f"graph feat_dim mismatch at {e}")
+        if self.feat_dim <= 0:
+            raise ValueError(
+                "endpoints serve no graph table (PsServer was built "
+                "without graph_feat_dim) — graph RPCs against them "
+                "would close the connection")
+
+    def add_edges(self, src, dst, weights=None):
+        src = _as_i64(src).reshape(-1)
+        dst = _as_i64(dst).reshape(-1)
+        w = _as_f32(weights).reshape(-1) if weights is not None else None
+
+        def job(s, idx):
+            def go():
+                self.conns[s].graph_add_edges(
+                    np.ascontiguousarray(src[idx]),
+                    np.ascontiguousarray(dst[idx]),
+                    np.ascontiguousarray(w[idx]) if w is not None
+                    else None)
+            return go
+
+        self._fan_out([job(s, i) for s, i in self._route(src)])
+
+    def sample_neighbors(self, keys, k: int, seed: int = 0,
+                         weighted: bool = False):
+        """(neighbors (N, k) padded with -1, counts (N,)): each key's
+        sample comes from its owning server's adjacency shard."""
+        keys = _as_i64(keys).reshape(-1)
+        out = np.full((keys.size, k), -1, dtype=np.int64)
+        counts = np.zeros((keys.size,), dtype=np.int64)
+
+        def job(s, idx):
+            def go():
+                o, c = self.conns[s].graph_sample(
+                    np.ascontiguousarray(keys[idx]), k, seed, weighted)
+                out[idx] = o
+                counts[idx] = c
+            return go
+
+        self._fan_out([job(s, i) for s, i in self._route(keys)])
+        return out, counts
+
+    def sample_hops(self, keys, fanouts: Sequence[int], seed: int = 0,
+                    weighted: bool = False):
+        """Multi-hop neighborhood sampling: hop h samples ``fanouts[h]``
+        neighbors of the previous frontier, re-routing every hop to the
+        owners of its (possibly remote) nodes. Returns a list of
+        (src (F,), neighbors (F, k), counts (F,)) per hop."""
+        frontier = np.unique(_as_i64(keys).reshape(-1))
+        out = []
+        for h, k in enumerate(fanouts):
+            nbrs, counts = self.sample_neighbors(frontier, k,
+                                                 seed=seed + h,
+                                                 weighted=weighted)
+            out.append((frontier, nbrs, counts))
+            nxt = nbrs[nbrs >= 0]
+            if nxt.size == 0:
+                break
+            frontier = np.unique(nxt)
+        return out
+
+    def node_feature(self, keys) -> np.ndarray:
+        keys = _as_i64(keys).reshape(-1)
+        out = np.zeros((keys.size, self.feat_dim), dtype=np.float32)
+
+        def job(s, idx):
+            def go():
+                out[idx] = self.conns[s].graph_feature(
+                    np.ascontiguousarray(keys[idx]), self.feat_dim)
+            return go
+
+        self._fan_out([job(s, i) for s, i in self._route(keys)])
+        return out
+
+    def set_node_feature(self, keys, feats):
+        keys = _as_i64(keys).reshape(-1)
+        feats = _as_f32(feats).reshape(keys.size, self.feat_dim)
+
+        def job(s, idx):
+            def go():
+                self.conns[s].graph_set_feature(
+                    np.ascontiguousarray(keys[idx]),
+                    np.ascontiguousarray(feats[idx]))
+            return go
+
+        self._fan_out([job(s, i) for s, i in self._route(keys)])
+
+    def num_nodes(self) -> int:
+        return sum(c.graph_num_nodes() for c in self.conns)
