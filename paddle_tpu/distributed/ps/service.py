@@ -110,7 +110,10 @@ class _Conn:
             raise ConnectionError("PS graph set_feature RPC failed")
 
     def graph_num_nodes(self) -> int:
-        return int(self._lib.ps_client_graph_num_nodes(self._h))
+        n = int(self._lib.ps_client_graph_num_nodes(self._h))
+        if n < 0:
+            raise ConnectionError("PS graph num_nodes RPC failed")
+        return n
 
     def pull(self, keys: np.ndarray, create: bool) -> np.ndarray:
         out = np.empty((keys.size, self.dim), dtype=np.float32)
@@ -187,12 +190,16 @@ class DistributedSparseTable(_ShardedClient):
     def __init__(self, endpoints: Sequence[str], async_mode: bool = False,
                  max_pending: int = 8):
         super().__init__(endpoints)
-        self.dim = self.conns[0].dim
-        for e, c in zip(endpoints, self.conns):
-            if c.dim != self.dim:
-                raise ValueError(
-                    f"PS dim mismatch: {endpoints[0]} serves dim "
-                    f"{self.dim} but {e} serves dim {c.dim}")
+        try:
+            self.dim = self.conns[0].dim
+            for e, c in zip(endpoints, self.conns):
+                if c.dim != self.dim:
+                    raise ValueError(
+                        f"PS dim mismatch: {endpoints[0]} serves dim "
+                        f"{self.dim} but {e} serves dim {c.dim}")
+        except Exception:
+            super().close()  # don't leak sockets/pool on a failed build
+            raise
         self.async_mode = async_mode
         self._err: Optional[BaseException] = None
         if async_mode:
@@ -280,15 +287,19 @@ class DistributedGraphTable(_ShardedClient):
 
     def __init__(self, endpoints: Sequence[str]):
         super().__init__(endpoints)
-        self.feat_dim = self.conns[0].feat_dim
-        for e, c in zip(endpoints, self.conns):
-            if c.feat_dim != self.feat_dim:
-                raise ValueError(f"graph feat_dim mismatch at {e}")
-        if self.feat_dim <= 0:
-            raise ValueError(
-                "endpoints serve no graph table (PsServer was built "
-                "without graph_feat_dim) — graph RPCs against them "
-                "would close the connection")
+        try:
+            self.feat_dim = self.conns[0].feat_dim
+            for e, c in zip(endpoints, self.conns):
+                if c.feat_dim != self.feat_dim:
+                    raise ValueError(f"graph feat_dim mismatch at {e}")
+            if self.feat_dim <= 0:
+                raise ValueError(
+                    "endpoints serve no graph table (PsServer was built "
+                    "without graph_feat_dim) — graph RPCs against them "
+                    "would close the connection")
+        except Exception:
+            super().close()  # don't leak sockets/pool on a failed build
+            raise
 
     def add_edges(self, src, dst, weights=None):
         src = _as_i64(src).reshape(-1)

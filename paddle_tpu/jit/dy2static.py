@@ -122,10 +122,26 @@ def _is_arrayish(v):
         hasattr(v, "dtype") and hasattr(v, "shape"))
 
 
+def _copy_containers(v):
+    """Fresh list/dict/set shells (leaves by reference): branch bodies
+    may MUTATE containers, and both branches of a tensor `if` are traced
+    — without per-branch copies the second branch would see the first
+    branch's mutations."""
+    if isinstance(v, list):
+        return [_copy_containers(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _copy_containers(x) for k, x in v.items()}
+    if isinstance(v, set):
+        return set(v)
+    if isinstance(v, tuple):
+        return tuple(_copy_containers(x) for x in v)
+    return v
+
+
 def _select_branches(cond, true_fn, false_fn, init, names, filename,
                      lineno, orig_err):
-    outs_t = true_fn(*init)
-    outs_f = false_fn(*init)
+    outs_t = true_fn(*_copy_containers(tuple(init)))
+    outs_f = false_fn(*_copy_containers(tuple(init)))
     res = []
     for n, a, b in zip(names, outs_t, outs_f):
         if a is b:
@@ -166,13 +182,9 @@ def convert_while(cond_fn, body_fn, init, names, filename="<dy2static>",
         while cond_fn(*vars_):
             vars_ = tuple(body_fn(*vars_))
         return vars_
-    for n, v in zip(names, init):
-        if v is UNDEFINED:
-            raise Dy2StaticError(
-                f"{_loc(filename, lineno)}: loop variable {n!r} is not "
-                "defined before this tensor-dependent loop; lax.while_loop "
-                "needs an initial value for every variable assigned in "
-                "the body")
+    if any(v is UNDEFINED for v in init):
+        init = _seed_loop_locals(cond_fn, body_fn, init, names, filename,
+                                 lineno)
     init = tuple(jnp.asarray(v) if isinstance(v, (int, float, bool))
                  else v for v in init)
     try:
@@ -185,6 +197,51 @@ def convert_while(cond_fn, body_fn, init, names, filename="<dy2static>",
             f"{_loc(filename, lineno)}: tensor-dependent `while` body must "
             f"keep every loop variable {list(names)} at a fixed "
             f"shape/dtype across iterations: {e}") from e
+
+
+def _seed_loop_locals(cond_fn, body_fn, init, names, filename, lineno):
+    """Loop variables first bound INSIDE the body (loop-locals — e.g. the
+    induction var of a nested converted loop) have no pre-loop value.
+    Probe the body once under jax.eval_shape with UNDEFINED
+    placeholders: a variable that is genuinely assigned-before-read
+    comes back with a shape/dtype that seeds a zero initial carry (the
+    first iteration overwrites it); a variable that is READ first trips
+    on the placeholder and gets the diagnostic. eval_shape performs
+    abstract evaluation — the probe's effects (debug prints, assert
+    callbacks) are discarded with the inner trace, and containers are
+    copied so body mutations cannot touch the real pre-loop objects.
+
+    Known semantic edge (documented, matches neither Python nor a
+    silent crash): after a loop whose runtime trip count is ZERO, a
+    seeded loop-local reads as zeros where plain Python would raise
+    NameError."""
+    def fail(n, cause=None):
+        err = Dy2StaticError(
+            f"{_loc(filename, lineno)}: loop variable {n!r} is not "
+            "defined before this tensor-dependent loop and is read "
+            "before assignment in the body; lax.while_loop needs an "
+            "initial value for every variable assigned in the body")
+        raise err from cause
+
+    try:
+        probe = jax.eval_shape(
+            lambda: body_fn(*_copy_containers(tuple(init))))
+    except Dy2StaticError:
+        raise
+    except Exception as e:
+        undef = [n for n, v in zip(names, init) if v is UNDEFINED]
+        fail(undef[0] if undef else "?", e)
+    out = list(init)
+    for i, (n, v) in enumerate(zip(names, init)):
+        if v is not UNDEFINED:
+            continue
+        p = probe[i]
+        if p is UNDEFINED:
+            fail(n)
+        if not (hasattr(p, "shape") and hasattr(p, "dtype")):
+            fail(n)
+        out[i] = jnp.zeros(p.shape, p.dtype)
+    return tuple(out)
 
 
 def init_loop_var(cur, fallback):
@@ -265,6 +322,85 @@ def convert_print(*args, **kwargs):
     return print(*args, **kwargs)
 
 
+def convert_assert(test, msg_fn, filename="<dy2static>", lineno=0):
+    """`assert` conversion (reference: convert_operators.convert_assert
+    -> Assert op). A tensor condition becomes a host callback that
+    raises when violated — checked at RUN time like the reference's
+    graph Assert, not silently dropped at trace time. ``msg_fn`` is a
+    thunk: Python only evaluates an assert message on FAILURE (the
+    message expression may be invalid on the passing path)."""
+    if not _is_tracer(test):
+        if not test:
+            msg = msg_fn() if msg_fn is not None else None
+            raise AssertionError(msg if msg is not None else
+                                 f"{_loc(filename, lineno)}: assertion "
+                                 f"failed")
+        return
+    # the message must be evaluated NOW if it is ever to appear (the
+    # callback outlives the trace), but only cheaply-formattable values
+    # survive; failures inside the thunk fall back to the bare location
+    try:
+        msg = msg_fn() if msg_fn is not None else None
+    except Exception:
+        msg = None
+
+    def check(ok):
+        if not bool(ok):
+            raise AssertionError(
+                f"{_loc(filename, lineno)}: traced assertion failed"
+                + (f": {msg}" if msg is not None else ""))
+
+    jax.debug.callback(check, jnp.all(test))
+
+
+_CALL_SKIP_MODULES = ("builtins", "jax", "numpy", "paddle_tpu", "functools",
+                      "itertools", "operator", "math", "typing", "abc",
+                      "collections", "copy", "warnings")
+# bounded LRU: a nested `def` creates a fresh function object per call
+# of its parent, so an unbounded cache would pin every instance (plus
+# its closure snapshot — weak keys don't work either: the converted
+# function's __wrapped__ back-reference would keep the key alive)
+from collections import OrderedDict as _OrderedDict  # noqa: E402
+
+_converted_cache: "_OrderedDict" = _OrderedDict()
+_CACHE_CAP = 256
+
+
+def convert_call(fn):
+    """Recursive conversion of user callees (reference:
+    convert_call_func.py convert_call): plain user functions/methods get
+    the same AST pass (cached), library/builtin callables pass through
+    untouched, so control flow inside helpers called from a converted
+    function stages too."""
+    if not callable(fn) or isinstance(fn, type):
+        return fn
+    inner = fn.__func__ if inspect.ismethod(fn) else fn
+    if not inspect.isfunction(inner):
+        return fn  # builtins, callables, layers: leave as-is
+    if getattr(inner, "_dy2s_converted", False) or \
+            getattr(inner, "_dy2s_is_conversion", False):
+        return fn
+    module = getattr(inner, "__module__", "") or ""
+    if module.split(".")[0] in _CALL_SKIP_MODULES:
+        return fn
+    key = id(inner)
+    cached = _converted_cache.get(key)
+    if cached is not None and cached[0] is inner:
+        _converted_cache.move_to_end(key)
+        conv = cached[1]
+    else:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # quiet fallback for callees
+            conv = convert_function(inner)
+        _converted_cache[key] = (inner, conv)
+        _converted_cache.move_to_end(key)
+        while len(_converted_cache) > _CACHE_CAP:
+            _converted_cache.popitem(last=False)
+    if inspect.ismethod(fn):
+        return functools.partial(conv, fn.__self__)
+    return conv
+
+
 def assert_python_value(value, construct, filename="<dy2static>", lineno=0):
     """Guard for statements left in Python form because they contain
     constructs lax cannot stage (return/break/continue, or a for-loop that
@@ -330,6 +466,67 @@ def _assigned_names(stmts):
             if isinstance(node.ctx, ast.Store) and \
                     not node.id.startswith("__dy2s_"):
                 add(node.id)
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return names
+
+
+_MUTATOR_METHODS = {"append", "extend", "insert", "pop", "popitem",
+                    "remove", "clear", "update", "setdefault", "add",
+                    "discard", "sort", "reverse"}
+
+
+def _mutated_names(stmts):
+    """Names whose CONTENTS a statement list may mutate in place —
+    container method calls (x.append(...)) and subscript stores
+    (x[i] = ..., del x[i]). These carry no ast.Store, but a tensor-`if`
+    branch mutating them must thread them through convert_ifelse like
+    any assigned name, or the mutation leaks branch-local tracers."""
+    names = []
+
+    def add(n):
+        if n not in names and not n.startswith("__dy2s_"):
+            names.append(n)
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            pass  # own scope
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_ClassDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+        def visit_Call(self, node):
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.attr in _MUTATOR_METHODS:
+                add(f.value.id)
+            self.generic_visit(node)
+
+        def _sub_target(self, t):
+            if isinstance(t, ast.Subscript) and \
+                    isinstance(t.value, ast.Name):
+                add(t.value.id)
+
+        def visit_Assign(self, node):
+            for t in node.targets:
+                self._sub_target(t)
+                if isinstance(t, ast.Tuple):
+                    for e in t.elts:
+                        self._sub_target(e)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            self._sub_target(node.target)
+            self.generic_visit(node)
+
+        def visit_Delete(self, node):
+            for t in node.targets:
+                self._sub_target(t)
+            self.generic_visit(node)
 
     v = V()
     for s in stmts:
@@ -502,16 +699,55 @@ class _Transformer(ast.NodeTransformer):
                 _call("convert_logical_not", [node.operand]), node)
         return node
 
+    # calls whose semantics depend on the calling frame or that the
+    # converters/builtins already handle — never rerouted
+    _CALL_SKIP_NAMES = {"locals", "globals", "vars", "super", "eval",
+                        "exec", "print", "range", "enumerate", "zip",
+                        "len", "isinstance", "issubclass", "getattr",
+                        "setattr", "hasattr", "type", "id", "iter",
+                        "next", "min", "max", "abs", "sum", "sorted",
+                        "list", "tuple", "dict", "set", "int", "float",
+                        "bool", "str", "repr", "format", "breakpoint"}
+
     def visit_Call(self, node):
         self.generic_visit(node)
         if isinstance(node.func, ast.Name) and node.func.id == "print" \
                 and not node.keywords:
             return ast.copy_location(
                 _call("convert_print", node.args), node)
+        # recursive callee conversion (reference convert_call): wrap the
+        # callable so user helpers with control flow stage too
+        f = node.func
+        skip = (isinstance(f, ast.Name)
+                and (f.id in self._CALL_SKIP_NAMES
+                     or f.id.startswith(("__dy2s_", "_dy2s_")))) or \
+            (isinstance(f, ast.Attribute)
+             and isinstance(f.value, ast.Name)
+             and f.value.id == _JST)
+        if not skip:
+            node.func = ast.copy_location(
+                _call("convert_call", [f]), f)
         return node
+
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        # message as a thunk: Python evaluates it only on failure
+        msg = (_const(None) if node.msg is None else ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=node.msg))
+        return ast.copy_location(ast.Expr(value=_call(
+            "convert_assert",
+            [node.test, msg, _const(self.filename),
+             _const(node.lineno)])), node)
 
     # -- if / while / for ---------------------------------------------------
     def visit_If(self, node):
+        # mutation patterns (x.append / x[i]=) must be read off the RAW
+        # body: generic_visit reroutes calls through convert_call and
+        # hides them
+        mutated = (_mutated_names(node.body) +
+                   _mutated_names(node.orelse))
         self.generic_visit(node)
         exits = _has_exits(node.body) + _has_exits(node.orelse)
         if exits:
@@ -523,7 +759,7 @@ class _Transformer(ast.NodeTransformer):
                        _const(node.lineno)]), node.test)
             return node
         names = sorted(set(_assigned_names(node.body) +
-                           _assigned_names(node.orelse)))
+                           _assigned_names(node.orelse) + mutated))
         tf, ff = self._n("true_fn"), self._n("false_fn")
         ret = ast.Return(value=_tuple_of(names))
         stmts = [_undef_guard(n) for n in names]
@@ -606,6 +842,7 @@ class _Transformer(ast.NodeTransformer):
         if node.orelse:
             self.generic_visit(node)
             return node  # while/else: Python-only construct, leave as-is
+        mutated = _mutated_names(node.body)  # raw body (see visit_If)
         setup = []
         lowered = self._lower_loop_exits(node)
         if lowered is not None:
@@ -621,7 +858,8 @@ class _Transformer(ast.NodeTransformer):
                       [node.test, _const("while"), _const(self.filename),
                        _const(node.lineno)]), node.test)
             return setup + [node] if setup else node
-        return setup + self._while_form(node, node.test, node.body)
+        return setup + self._while_form(node, node.test, node.body,
+                                        extra_loop_names=tuple(mutated))
 
     def _rewrite_tensor_loop(self, node, targets, sources, index=None,
                              mode="iter"):
@@ -708,6 +946,7 @@ class _Transformer(ast.NodeTransformer):
         return out
 
     def visit_For(self, node):
+        mutated = _mutated_names(node.body)  # raw body (see visit_If)
         setup_exits = []
         test_wrap = None
         is_range_call = (isinstance(node.iter, ast.Call)
@@ -830,7 +1069,7 @@ class _Transformer(ast.NodeTransformer):
                             value=_name(step_n))
         return setup_exits + setup + self._while_form(
             node, test, [set_t] + list(node.body) + [inc],
-            extra_loop_names=(it_n, t))
+            extra_loop_names=(it_n, t) + tuple(mutated))
 
 
 class _GlobalsProxy(dict):
@@ -916,4 +1155,5 @@ def convert_function(fn):
     new_fn.__kwdefaults__ = fn.__kwdefaults__
     functools.update_wrapper(new_fn, fn, updated=[])
     new_fn.__wrapped__ = fn
+    new_fn._dy2s_converted = True  # convert_call must not re-convert
     return new_fn
