@@ -82,13 +82,23 @@ def _is_structure_error(e: TypeError) -> bool:
 
 
 def convert_ifelse(cond, true_fn, false_fn, init, names,
-                   filename="<dy2static>", lineno=0):
+                   filename="<dy2static>", lineno=0, attr_muts=()):
     """Branch fns take the CURRENT values of every assigned name as
     arguments (a branch that reads-then-writes a name would otherwise see
     it as an unbound local — the reference passes branch inputs the same
     way)."""
     if not _is_tracer(cond):
         return (true_fn if cond else false_fn)(*init)
+    if attr_muts:
+        # e.g. `self.cache.append(x)`: the container lives on an OBJECT
+        # attribute — it cannot be threaded through lax.cond like a local
+        # name, and tracing both branches would mutate it unconditionally
+        raise Dy2StaticError(
+            f"{_loc(filename, lineno)}: branch of a tensor-dependent "
+            f"`if` mutates attribute container(s) "
+            f"{sorted(set(attr_muts))} in place; bind the container to "
+            f"a local variable before the `if` (locals thread through "
+            f"the staged branches, attributes cannot)")
     # UNDEFINED placeholders are not JAX types: route them through the
     # closure, pass only real values as lax.cond operands (a branch that
     # assigns them returns arrays; a branch that doesn't returns UNDEFINED
@@ -164,7 +174,11 @@ def _select_branches(cond, true_fn, false_fn, init, names, filename,
                     f"{_loc(filename, lineno)}: variable {n!r} has "
                     f"incompatible shape/dtype across tensor-dependent "
                     f"`if` branches") from orig_err
-        if type(a) is type(b) and a == b:
+        try:
+            same = type(a) is type(b) and bool(a == b)
+        except Exception:  # tracer-holding containers: == concretizes
+            same = False
+        if same:
             res.append(a)
             continue
         raise Dy2StaticError(
@@ -534,6 +548,63 @@ def _mutated_names(stmts):
     return names
 
 
+def _attr_mutations(stmts):
+    """Dotted display names of ATTRIBUTE-held containers a statement
+    list mutates in place (obj.attr.append(...), obj.attr[i] = ...).
+    These cannot be threaded through staged branches like local names —
+    convert_ifelse raises a located diagnostic when the condition is a
+    tensor (they would otherwise mutate unconditionally at trace time)."""
+    out = []
+
+    def add(node):
+        try:
+            s = ast.unparse(node)
+        except Exception:  # pragma: no cover
+            s = "<attribute>"
+        if s not in out:
+            out.append(s)
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_ClassDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+        def visit_Call(self, node):
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Attribute) and \
+                    f.attr in _MUTATOR_METHODS:
+                add(f.value)
+            self.generic_visit(node)
+
+        def _sub_target(self, t):
+            if isinstance(t, ast.Subscript) and \
+                    isinstance(t.value, ast.Attribute):
+                add(t.value)
+
+        def visit_Assign(self, node):
+            for t in node.targets:
+                self._sub_target(t)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            self._sub_target(node.target)
+            self.generic_visit(node)
+
+        def visit_Delete(self, node):
+            for t in node.targets:
+                self._sub_target(t)
+            self.generic_visit(node)
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return out
+
+
 def _loaded_names(node):
     out = set()
     for n in ast.walk(node):
@@ -748,6 +819,8 @@ class _Transformer(ast.NodeTransformer):
         # hides them
         mutated = (_mutated_names(node.body) +
                    _mutated_names(node.orelse))
+        attr_muts = (_attr_mutations(node.body) +
+                     _attr_mutations(node.orelse))
         self.generic_visit(node)
         exits = _has_exits(node.body) + _has_exits(node.orelse)
         if exits:
@@ -773,7 +846,9 @@ class _Transformer(ast.NodeTransformer):
                         [node.test, _name(tf), _name(ff), _tuple_of(names),
                          ast.Tuple(elts=[_const(n) for n in names],
                                    ctx=ast.Load()),
-                         _const(self.filename), _const(node.lineno)]))
+                         _const(self.filename), _const(node.lineno),
+                         ast.Tuple(elts=[_const(a) for a in attr_muts],
+                                   ctx=ast.Load())]))
         stmts.append(assign)
         return [ast.copy_location(ast.fix_missing_locations(s), node)
                 for s in stmts]

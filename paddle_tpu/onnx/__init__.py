@@ -27,24 +27,50 @@ def export(layer, path: str, input_spec=None, opset_version: int = 9,
     # conversion). Falls back to the StableHLO artifact with a warning for
     # structures the converter does not cover.
     import warnings
-    try:
-        from ._writer import export_layer_to_onnx
-        if opset_version < 13:
+
+    def _promote_opset():
+        # warned only when a writer actually emits ONNX — on the
+        # StableHLO-only path the message would describe a writer that
+        # never ran
+        if requested_opset < 13:
             warnings.warn(
-                f"opset_version={opset_version} promoted to 13: the "
+                f"opset_version={requested_opset} promoted to 13: the "
                 "wire-format writer emits opset-13 ops (Gemm/Conv/"
                 "BatchNormalization attribute forms)")
-            opset_version = 13
-        onnx_path = prefix + ".onnx"
+
+    requested_opset = opset_version
+    opset_version = max(13, opset_version)
+    onnx_path = prefix + ".onnx"
+    try:
+        # layer-walk writer first: Sequential models get idiomatic
+        # Gemm/Conv graphs with a dynamic batch dim
+        from ._writer import export_layer_to_onnx
         export_layer_to_onnx(layer, onnx_path, input_spec=input_spec,
                              opset_version=opset_version)
+        _promote_opset()
+        return onnx_path
+    except NotImplementedError:
+        pass  # fall through to the trace-based converter
+    except Exception as e:  # converter defects must never break export:
+        warnings.warn(       # the StableHLO artifact is already written
+            f"ONNX conversion failed ({type(e).__name__}: {e}); trying "
+            "the trace-based converter.")
+    try:
+        # trace-based (jaxpr -> ONNX): covers residual CNNs (ResNet) and
+        # transformer blocks the layer walker refuses
+        from ._trace_writer import export_traced_layer
+        if input_spec is None:
+            raise NotImplementedError("onnx export requires input_spec")
+        export_traced_layer(layer, onnx_path, input_spec,
+                            opset_version=opset_version)
+        _promote_opset()
         return onnx_path
     except NotImplementedError as e:
         warnings.warn(
             f"ONNX conversion not available for this model ({e}); the "
             f"StableHLO artifact at {prefix!r} is the exported format.")
-    except Exception as e:  # converter defects must never break export:
-        warnings.warn(       # the StableHLO artifact is already written
+    except Exception as e:
+        warnings.warn(
             f"ONNX conversion failed ({type(e).__name__}: {e}); the "
             f"StableHLO artifact at {prefix!r} is the exported format.")
     return prefix

@@ -1,0 +1,617 @@
+"""Trace-based Layer -> ONNX conversion: jaxpr equations -> ONNX nodes.
+
+The reference exports arbitrary models through paddle2onnx's per-op
+conversion of a traced Program (python/paddle/onnx/export.py). The TPU
+analogue traces the layer to a jaxpr (the real data-flow graph, skip
+connections and all — no layer-walk heuristics) and maps each primitive
+to ONNX ops, which covers ResNet-style residual CNNs and transformer
+blocks that the Sequential walker (_writer.py) refuses.
+
+Design:
+- parameters/buffers are closed over at trace time -> jaxpr consts ->
+  ONNX initializers;
+- any equation whose operands are all input-INDEPENDENT is evaluated at
+  conversion time and baked as an initializer (constant folding) — this
+  absorbs iota/causal-mask/position-id subgraphs wholesale;
+- pjit/jit/custom_jvp/custom_vjp/remat equations are inlined
+  recursively;
+- anything unmapped raises NotImplementedError("primitive ...") and the
+  caller falls back to the StableHLO artifact.
+
+Wire format via _pb (dependency-free); onnx.checker validation is
+applied by callers when the onnx package is importable.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import _pb
+from ._writer import (_GraphBuilder, _model, _node, _tensor,  # noqa: F401
+                      _value_info, FLOAT, INT64)
+
+_FOLD_CAP = 4_000_000  # elements; larger constants abort folding
+
+
+def _onnx_dt(dtype) -> int:
+    d = np.dtype(dtype) if not str(dtype).startswith("bfloat16") else None
+    if d is None or str(dtype) == "bfloat16":
+        return 1
+    if d in (np.dtype(np.float32), np.dtype(np.float64),
+             np.dtype(np.float16)):
+        return 1
+    if d in (np.dtype(np.int64), np.dtype(np.int32), np.dtype(np.int16),
+             np.dtype(np.int8), np.dtype(np.uint8), np.dtype(np.uint32)):
+        return 7
+    if d == np.dtype(np.bool_):
+        return 9
+    raise NotImplementedError(f"dtype {dtype} in ONNX conversion")
+
+
+def _to_init_arr(arr: np.ndarray) -> np.ndarray:
+    """Initializer storage dtype (f32 / i64 / bool)."""
+    if str(arr.dtype) == "bfloat16" or arr.dtype.kind == "f":
+        return arr.astype(np.float32)
+    if arr.dtype.kind in "iu":
+        return arr.astype(np.int64)
+    if arr.dtype == np.bool_:
+        return arr
+    raise NotImplementedError(f"initializer dtype {arr.dtype}")
+
+
+def _bool_tensor(name: str, arr: np.ndarray) -> bytes:
+    body = b"".join(_pb.f_varint(1, int(d)) for d in arr.shape)
+    body += _pb.f_varint(2, 9)  # BOOL
+    body += _pb.f_str(8, name)
+    body += _pb.f_bytes(9, np.ascontiguousarray(
+        arr.astype(np.uint8)).tobytes())
+    return body
+
+
+class _Converter:
+    def __init__(self):
+        self.g = _GraphBuilder()
+        self.env: Dict = {}        # jax Var -> onnx name (str)
+        self.const: Dict = {}      # jax Var -> np.ndarray (foldable)
+        self._lit_cache: Dict = {}
+
+    # -- helpers ------------------------------------------------------------
+    def add_const(self, arr, hint="const") -> str:
+        arr = np.asarray(arr)
+        if arr.dtype == np.bool_:
+            name = self.g.fresh(hint)
+            self.g.initializers.append(_bool_tensor(name, arr))
+            return name
+        return self.g.add_init(hint, _to_init_arr(arr))
+
+    def name_of(self, atom) -> str:
+        """ONNX name for a jaxpr atom, materializing constants."""
+        from jax.extend.core import Literal
+        if isinstance(atom, Literal):
+            key = (id(atom.val),)
+            if key not in self._lit_cache:
+                self._lit_cache[key] = self.add_const(
+                    np.asarray(atom.val), "lit")
+            return self._lit_cache[key]
+        if atom in self.const:
+            v = self.const.pop(atom)  # materialize once
+            name = self.add_const(v, "folded")
+            self.env[atom] = name
+            return name
+        return self.env[atom]
+
+    def val_of(self, atom):
+        """Concrete value if the atom is input-independent, else None."""
+        from jax.extend.core import Literal
+        if isinstance(atom, Literal):
+            return np.asarray(atom.val)
+        return self.const.get(atom)
+
+    def is_const(self, atom) -> bool:
+        from jax.extend.core import Literal
+        return isinstance(atom, Literal) or (
+            atom in self.const and atom not in self.env)
+
+    def _in_env(self, atom) -> bool:
+        from jax.extend.core import Literal
+        return (not isinstance(atom, Literal)) and atom in self.env
+
+    def node(self, op, ins, n_out=1, attrs=None, hint=None):
+        outs = [self.g.fresh(hint or op.lower()) for _ in range(n_out)]
+        self.g.add_node(op, ins, outs, attrs)
+        return outs if n_out != 1 else outs[0]
+
+    # -- equation walk ------------------------------------------------------
+    def convert(self, jaxpr):
+        for eq in jaxpr.eqns:
+            self.eqn(eq)
+
+    def _try_fold(self, eq) -> bool:
+        if not all(self.is_const(a) for a in eq.invars):
+            return False
+        if eq.primitive.name in ("jit", "pjit", "custom_jvp_call",
+                                 "custom_vjp_call", "remat",
+                                 "checkpoint", "custom_vjp_call_jaxpr"):
+            return False  # recurse instead; folding inner calls is rarer
+        try:
+            vals = [jnp.asarray(self.val_of(a)) for a in eq.invars]
+            out = eq.primitive.bind(*vals, **eq.params)
+        except Exception:
+            return False
+        outs = [np.asarray(o) for o in
+                (out if eq.primitive.multiple_results else [out])]
+        if any(o.size > _FOLD_CAP for o in outs):
+            return False  # nothing stored: all-or-nothing fold
+        for var, o in zip(eq.outvars, outs):
+            self.const[var] = o
+        return True
+
+    def eqn(self, eq):
+        prim = eq.primitive.name
+        if prim in ("jit", "pjit", "closed_call", "remat", "checkpoint"):
+            inner = eq.params.get("jaxpr") or eq.params.get("call_jaxpr")
+            return self._inline(eq, inner)
+        if prim in ("custom_jvp_call", "custom_vjp_call",
+                    "custom_vjp_call_jaxpr", "custom_jvp_call_jaxpr"):
+            inner = (eq.params.get("call_jaxpr")
+                     or eq.params.get("fun_jaxpr"))
+            return self._inline(eq, inner)
+        if prim == "stop_gradient":
+            self._alias(eq)
+            return
+        if self._try_fold(eq):
+            return
+        fn = getattr(self, f"p_{prim}", None)
+        if fn is None:
+            raise NotImplementedError(
+                f"primitive {prim!r} has no ONNX mapping")
+        fn(eq)
+
+    def _inline(self, eq, inner):
+        jaxpr = inner.jaxpr if hasattr(inner, "jaxpr") else inner
+        consts = list(getattr(inner, "consts", []))
+        for cv, cval in zip(jaxpr.constvars, consts):
+            self.const[cv] = np.asarray(cval)
+        for iv, atom in zip(jaxpr.invars, eq.invars):
+            v = self.val_of(atom)
+            if v is not None and not self._in_env(atom):
+                self.const[iv] = v
+            else:
+                self.env[iv] = self.name_of(atom)
+        self.convert(jaxpr)
+        for ov, inner_ov in zip(eq.outvars, jaxpr.outvars):
+            v = self.val_of(inner_ov)
+            if v is not None and not self._in_env(inner_ov):
+                self.const[ov] = v
+            else:
+                self.env[ov] = self.name_of(inner_ov)
+
+    def _alias(self, eq):
+        a = eq.invars[0]
+        v = self.val_of(a)
+        if v is not None and not self._in_env(a):
+            self.const[eq.outvars[0]] = v
+        else:
+            self.env[eq.outvars[0]] = self.name_of(a)
+
+    # -- elementwise --------------------------------------------------------
+    def _binop(self, eq, op):
+        out = self.node(op, [self.name_of(eq.invars[0]),
+                             self.name_of(eq.invars[1])])
+        self.env[eq.outvars[0]] = out
+
+    def p_add(self, eq):
+        self._binop(eq, "Add")
+
+    def p_sub(self, eq):
+        self._binop(eq, "Sub")
+
+    def p_mul(self, eq):
+        self._binop(eq, "Mul")
+
+    def p_div(self, eq):
+        self._binop(eq, "Div")
+
+    def p_max(self, eq):
+        self._binop(eq, "Max")
+
+    def p_min(self, eq):
+        self._binop(eq, "Min")
+
+    def p_pow(self, eq):
+        self._binop(eq, "Pow")
+
+    def _unop(self, eq, op):
+        self.env[eq.outvars[0]] = self.node(
+            op, [self.name_of(eq.invars[0])])
+
+    def p_neg(self, eq):
+        self._unop(eq, "Neg")
+
+    def p_exp(self, eq):
+        self._unop(eq, "Exp")
+
+    def p_log(self, eq):
+        self._unop(eq, "Log")
+
+    def p_tanh(self, eq):
+        self._unop(eq, "Tanh")
+
+    def p_erf(self, eq):
+        self._unop(eq, "Erf")
+
+    def p_sqrt(self, eq):
+        self._unop(eq, "Sqrt")
+
+    def p_abs(self, eq):
+        self._unop(eq, "Abs")
+
+    def p_sign(self, eq):
+        self._unop(eq, "Sign")
+
+    def p_floor(self, eq):
+        self._unop(eq, "Floor")
+
+    def p_logistic(self, eq):
+        self._unop(eq, "Sigmoid")
+
+    def p_rsqrt(self, eq):
+        s = self.node("Sqrt", [self.name_of(eq.invars[0])])
+        self.env[eq.outvars[0]] = self.node("Reciprocal", [s])
+
+    def p_square(self, eq):
+        a = self.name_of(eq.invars[0])
+        self.env[eq.outvars[0]] = self.node("Mul", [a, a])
+
+    def p_integer_pow(self, eq):
+        y = int(eq.params["y"])
+        a = self.name_of(eq.invars[0])
+        if y == 2:
+            self.env[eq.outvars[0]] = self.node("Mul", [a, a])
+            return
+        p = self.add_const(np.float32(y), "pow")
+        self.env[eq.outvars[0]] = self.node("Pow", [a, p])
+
+    def _cmp(self, eq, op, swap=False):
+        a, b = (self.name_of(eq.invars[0]), self.name_of(eq.invars[1]))
+        if swap:
+            a, b = b, a
+        self.env[eq.outvars[0]] = self.node(op, [a, b])
+
+    def p_lt(self, eq):
+        self._cmp(eq, "Less")
+
+    def p_le(self, eq):
+        self._cmp(eq, "LessOrEqual")
+
+    def p_gt(self, eq):
+        self._cmp(eq, "Greater")
+
+    def p_ge(self, eq):
+        self._cmp(eq, "GreaterOrEqual")
+
+    def p_eq(self, eq):
+        self._cmp(eq, "Equal")
+
+    def p_ne(self, eq):
+        e = self.node("Equal", [self.name_of(eq.invars[0]),
+                                self.name_of(eq.invars[1])])
+        self.env[eq.outvars[0]] = self.node("Not", [e])
+
+    def p_and(self, eq):
+        self._binop(eq, "And")
+
+    def p_or(self, eq):
+        self._binop(eq, "Or")
+
+    def p_not(self, eq):
+        self._unop(eq, "Not")
+
+    def p_select_n(self, eq):
+        if len(eq.invars) != 3:
+            raise NotImplementedError("select_n with >2 cases")
+        pred, a, b = eq.invars  # index 0 -> a, 1 -> b
+        self.env[eq.outvars[0]] = self.node(
+            "Where", [self.name_of(pred), self.name_of(b),
+                      self.name_of(a)])
+
+    def p_convert_element_type(self, eq):
+        dt = _onnx_dt(eq.params["new_dtype"])
+        self.env[eq.outvars[0]] = self.node(
+            "Cast", [self.name_of(eq.invars[0])], attrs={"to": dt})
+
+    # -- shape ops ----------------------------------------------------------
+    def p_reshape(self, eq):
+        shape = self.add_const(
+            np.asarray(eq.outvars[0].aval.shape, np.int64), "shape")
+        self.env[eq.outvars[0]] = self.node(
+            "Reshape", [self.name_of(eq.invars[0]), shape])
+
+    def p_squeeze(self, eq):
+        self.p_reshape(eq)
+
+    def p_expand_dims(self, eq):
+        self.p_reshape(eq)
+
+    def p_transpose(self, eq):
+        perm = [int(p) for p in eq.params["permutation"]]
+        self.env[eq.outvars[0]] = self.node(
+            "Transpose", [self.name_of(eq.invars[0])],
+            attrs={"perm": perm})
+
+    def p_broadcast_in_dim(self, eq):
+        out_shape = [int(d) for d in eq.params["shape"]]
+        bdims = [int(d) for d in eq.params["broadcast_dimensions"]]
+        in_aval = eq.invars[0].aval
+        cur = self.name_of(eq.invars[0])
+        # step 1: reshape so kept dims land in their target positions
+        # with 1s elsewhere; step 2: Expand broadcasts the 1s
+        mid = [1] * len(out_shape)
+        for src, dst in enumerate(bdims):
+            mid[dst] = int(in_aval.shape[src])
+        if tuple(mid) != tuple(in_aval.shape) or len(mid) != in_aval.ndim:
+            shape_c = self.add_const(np.asarray(mid, np.int64), "shape")
+            cur = self.node("Reshape", [cur, shape_c])
+        if tuple(mid) != tuple(out_shape):
+            tgt = self.add_const(np.asarray(out_shape, np.int64), "shape")
+            cur = self.node("Expand", [cur, tgt])
+        self.env[eq.outvars[0]] = cur
+
+    def p_concatenate(self, eq):
+        self.env[eq.outvars[0]] = self.node(
+            "Concat", [self.name_of(v) for v in eq.invars],
+            attrs={"axis": int(eq.params["dimension"])})
+
+    def p_split(self, eq):
+        sizes = [int(s) for s in eq.params["sizes"]]
+        axis = int(eq.params["axis"])
+        split_c = self.add_const(np.asarray(sizes, np.int64), "split")
+        outs = self.node("Split", [self.name_of(eq.invars[0]), split_c],
+                         n_out=len(sizes), attrs={"axis": axis})
+        for v, o in zip(eq.outvars, outs):
+            self.env[v] = o
+
+    def p_slice(self, eq):
+        starts = [int(s) for s in eq.params["start_indices"]]
+        ends = [int(s) for s in eq.params["limit_indices"]]
+        strides = eq.params.get("strides")
+        strides = ([int(s) for s in strides] if strides is not None
+                   else [1] * len(starts))
+        axes = list(range(len(starts)))
+        ins = [self.name_of(eq.invars[0]),
+               self.add_const(np.asarray(starts, np.int64), "starts"),
+               self.add_const(np.asarray(ends, np.int64), "ends"),
+               self.add_const(np.asarray(axes, np.int64), "axes"),
+               self.add_const(np.asarray(strides, np.int64), "steps")]
+        self.env[eq.outvars[0]] = self.node("Slice", ins)
+
+    def p_pad(self, eq):
+        cfg = eq.params["padding_config"]
+        if any(int(i) != 0 for _, _, i in cfg):
+            raise NotImplementedError("interior padding")
+        lo = [int(l) for l, _, _ in cfg]
+        hi = [int(h) for _, h, _ in cfg]
+        if any(v < 0 for v in lo + hi):
+            raise NotImplementedError("negative padding")
+        pads = self.add_const(np.asarray(lo + hi, np.int64), "pads")
+        pv = self.val_of(eq.invars[1])
+        if pv is None:
+            raise NotImplementedError("non-constant pad value")
+        cval = self.add_const(np.asarray(pv), "padval")
+        self.env[eq.outvars[0]] = self.node(
+            "Pad", [self.name_of(eq.invars[0]), pads, cval])
+
+    # -- reductions ---------------------------------------------------------
+    def _reduce(self, eq, op):
+        axes = self.add_const(
+            np.asarray(sorted(int(a) for a in eq.params["axes"]),
+                       np.int64), "axes")
+        self.env[eq.outvars[0]] = self.node(
+            op, [self.name_of(eq.invars[0]), axes],
+            attrs={"keepdims": 0})
+
+    def p_reduce_sum(self, eq):
+        self._reduce(eq, "ReduceSum")
+
+    def p_reduce_max(self, eq):
+        self._reduce(eq, "ReduceMax")
+
+    def p_reduce_min(self, eq):
+        self._reduce(eq, "ReduceMin")
+
+    def p_argmax(self, eq):
+        axes = eq.params["axes"]
+        if len(axes) != 1:
+            raise NotImplementedError("argmax over multiple axes")
+        out = self.node("ArgMax", [self.name_of(eq.invars[0])],
+                        attrs={"axis": int(axes[0]), "keepdims": 0})
+        self.env[eq.outvars[0]] = out
+
+    # -- matmul / conv / pool ----------------------------------------------
+    def p_dot_general(self, eq):
+        (lc, rc), (lb, rb) = eq.params["dimension_numbers"]
+        lhs, rhs = eq.invars
+        la, ra = lhs.aval, rhs.aval
+        lname, rname = self.name_of(lhs), self.name_of(rhs)
+
+        def canon(name, aval, batch, contract, contract_last):
+            free = [d for d in range(aval.ndim)
+                    if d not in batch and d not in contract]
+            perm = (list(batch) + free + list(contract)
+                    if contract_last else
+                    list(batch) + list(contract) + free)
+            if perm != list(range(aval.ndim)):
+                name = self.node("Transpose", [name],
+                                 attrs={"perm": perm})
+            bshape = [aval.shape[d] for d in batch]
+            fshape = [aval.shape[d] for d in free]
+            cshape = [aval.shape[d] for d in contract]
+            return name, bshape, fshape, cshape, free
+
+        ln, lbs, lfs, lcs, lfree = canon(lname, la, lb, lc, True)
+        rn, rbs, rfs, rcs, rfree = canon(rname, ra, rb, rc, False)
+        B = int(np.prod(lbs)) if lbs else 1
+        M = int(np.prod(lfs)) if lfs else 1
+        K = int(np.prod(lcs)) if lcs else 1
+        N = int(np.prod(rfs)) if rfs else 1
+        s_l = self.add_const(np.asarray([B, M, K], np.int64), "shape")
+        s_r = self.add_const(np.asarray([B, K, N], np.int64), "shape")
+        ln = self.node("Reshape", [ln, s_l])
+        rn = self.node("Reshape", [rn, s_r])
+        mm = self.node("MatMul", [ln, rn])
+        out_shape = [int(d) for d in eq.outvars[0].aval.shape]
+        s_o = self.add_const(np.asarray(out_shape, np.int64), "shape")
+        self.env[eq.outvars[0]] = self.node("Reshape", [mm, s_o])
+
+    def p_conv_general_dilated(self, eq):
+        dn = eq.params["dimension_numbers"]
+        if (dn.lhs_spec[0], dn.lhs_spec[1]) != (0, 1) or \
+                (dn.rhs_spec[0], dn.rhs_spec[1]) != (0, 1) or \
+                (dn.out_spec[0], dn.out_spec[1]) != (0, 1):
+            raise NotImplementedError(
+                "conv layouts other than NCHW/OIHW")
+        if any(int(d) != 1 for d in eq.params["lhs_dilation"]):
+            raise NotImplementedError("transposed/dilated-input conv")
+        pads_lo = [int(l) for l, _ in eq.params["padding"]]
+        pads_hi = [int(h) for _, h in eq.params["padding"]]
+        attrs = {
+            "strides": [int(s) for s in eq.params["window_strides"]],
+            "pads": pads_lo + pads_hi,
+            "dilations": [int(d) for d in eq.params["rhs_dilation"]],
+            "group": int(eq.params["feature_group_count"]),
+        }
+        self.env[eq.outvars[0]] = self.node(
+            "Conv", [self.name_of(eq.invars[0]),
+                     self.name_of(eq.invars[1])], attrs=attrs)
+
+    def _window_attrs(self, eq):
+        wd = [int(d) for d in eq.params["window_dimensions"]]
+        ws = [int(s) for s in eq.params["window_strides"]]
+        pad = eq.params["padding"]
+        if wd[0] != 1 or wd[1] != 1 or ws[0] != 1 or ws[1] != 1:
+            raise NotImplementedError("pooling over batch/channel dims")
+        if any(int(d) != 1 for d in eq.params.get(
+                "window_dilation", (1,) * len(wd))) or \
+           any(int(d) != 1 for d in eq.params.get(
+                "base_dilation", (1,) * len(wd))):
+            raise NotImplementedError("dilated pooling")
+        lo = [int(l) for l, _ in pad[2:]]
+        hi = [int(h) for _, h in pad[2:]]
+        return {"kernel_shape": wd[2:], "strides": ws[2:],
+                "pads": lo + hi}, wd
+
+    def p_reduce_window_max(self, eq):
+        attrs, _ = self._window_attrs(eq)
+        self.env[eq.outvars[0]] = self.node(
+            "MaxPool", [self.name_of(eq.invars[0])], attrs=attrs)
+
+    def p_reduce_window_sum(self, eq):
+        attrs, wd = self._window_attrs(eq)
+        attrs["count_include_pad"] = 1
+        ap = self.node("AveragePool", [self.name_of(eq.invars[0])],
+                       attrs=attrs)
+        scale = self.add_const(
+            np.float32(float(np.prod(attrs["kernel_shape"]))), "winsz")
+        self.env[eq.outvars[0]] = self.node("Mul", [ap, scale])
+
+    def p_gather(self, eq):
+        # simple take-along-leading-axis (embedding lookup): indices map
+        # to axis 0, one collapsed dim, full slices elsewhere
+        d = eq.params["dimension_numbers"]
+        operand, indices = eq.invars
+        slice_sizes = [int(s) for s in eq.params["slice_sizes"]]
+        op_shape = [int(s) for s in operand.aval.shape]
+        if (tuple(d.start_index_map) == (0,)
+                and tuple(d.collapsed_slice_dims) == (0,)
+                and slice_sizes[0] == 1
+                and slice_sizes[1:] == op_shape[1:]):
+            idx = self.name_of(indices)
+            # jax appends an index-vector dim of size 1; strip it
+            ishape = [int(s) for s in indices.aval.shape]
+            if ishape and ishape[-1] == 1:
+                sq = self.add_const(
+                    np.asarray(ishape[:-1], np.int64), "shape")
+                idx = self.node("Reshape", [idx, sq])
+            self.env[eq.outvars[0]] = self.node(
+                "Gather", [self.name_of(operand), idx],
+                attrs={"axis": 0})
+            return
+        raise NotImplementedError("general lax.gather pattern")
+
+    def p_iota(self, eq):  # pragma: no cover — folding handles iota
+        dt = eq.params["dtype"]
+        shape = [int(s) for s in eq.params["shape"]]
+        dim = int(eq.params["dimension"])
+        base = np.arange(shape[dim])
+        expand = np.broadcast_to(
+            base.reshape([-1 if i == dim else 1
+                          for i in range(len(shape))]), shape)
+        self.const[eq.outvars[0]] = expand.astype(dt)
+
+
+def trace_to_onnx(fn, example_args, path: str, opset_version: int = 13,
+                  input_names=None) -> str:
+    """Trace fn(*example_args) and write an ONNX model. Array-valued
+    constants (closed-over parameters) become initializers."""
+    closed = jax.make_jaxpr(fn)(*example_args)
+    conv = _Converter()
+    jaxpr = closed.jaxpr
+    for cv, cval in zip(jaxpr.constvars, closed.consts):
+        conv.const[cv] = np.asarray(cval)
+    input_names = input_names or [f"input_{i}"
+                                  for i in range(len(jaxpr.invars))]
+    graph_inputs = []
+    for name, iv in zip(input_names, jaxpr.invars):
+        conv.env[iv] = name
+        graph_inputs.append(_value_info(
+            name, list(iv.aval.shape), _onnx_dt(iv.aval.dtype)))
+    conv.convert(jaxpr)
+    out_infos, out_renames = [], []
+    for i, ov in enumerate(jaxpr.outvars):
+        oname = f"output_{i}"
+        conv.g.add_node("Identity", [conv.name_of(ov)], [oname])
+        out_infos.append(_value_info(
+            oname, [int(s) for s in ov.aval.shape],
+            _onnx_dt(ov.aval.dtype)))
+        out_renames.append(oname)
+    g = conv.g
+    graph = b"".join(_pb.f_bytes(1, n) for n in g.nodes)
+    graph += _pb.f_str(2, "paddle_tpu_traced")
+    graph += b"".join(_pb.f_bytes(5, t) for t in g.initializers)
+    graph += b"".join(_pb.f_bytes(11, vi) for vi in graph_inputs)
+    graph += b"".join(_pb.f_bytes(12, vi) for vi in out_infos)
+    model = _model(graph, opset_version)
+    with open(path, "wb") as f:
+        f.write(model)
+    return path
+
+
+def export_traced_layer(layer, path: str, input_spec,
+                        opset_version: int = 13) -> str:
+    """Layer -> ONNX via jaxpr tracing (eval-mode, params as consts)."""
+    from ..jit.functionalization import functional_call, state_of
+    was_training = getattr(layer, "training", False)
+    layer.eval()
+    try:
+        params, buffers = state_of(layer)
+        specs = input_spec if isinstance(input_spec, (list, tuple)) \
+            else [input_spec]
+        args = []
+        for s in specs:
+            shape = [1 if (d is None or (isinstance(d, int) and d < 0))
+                     else int(d) for d in getattr(s, "shape", s)]
+            dtype = getattr(s, "dtype", None) or jnp.float32
+            args.append(jnp.zeros(shape, dtype))
+
+        def fn(*xs):
+            out, _ = functional_call(layer, params, buffers, *xs)
+            return out
+
+        return trace_to_onnx(fn, args, path, opset_version=opset_version)
+    finally:
+        if was_training:
+            layer.train()

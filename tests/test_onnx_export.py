@@ -68,7 +68,9 @@ def test_onnx_export_lenet(tmp_path):
     assert vi_in[1][0] == b"input"
 
 
-def test_onnx_export_fallback_warns(tmp_path):
+def test_onnx_export_residual_via_trace(tmp_path):
+    """Skip connections defeat the layer walker; the trace-based
+    converter (jaxpr -> ONNX) handles them (round-4 verdict item 7)."""
     class Residual(nn.Layer):
         def __init__(self):
             super().__init__()
@@ -78,9 +80,67 @@ def test_onnx_export_fallback_warns(tmp_path):
             return x + self.fc(x)
 
     m = Residual()
+    m.eval()
+    out = paddle.onnx.export(m, str(tmp_path / "res.onnx"),
+                             input_spec=[InputSpec([2, 4], "float32")])
+    assert out.endswith(".onnx")
+    _, graph, nodes, inits = _decode_model(out)
+    ops = _op_types(nodes)
+    assert "MatMul" in ops and "Add" in ops
+    # the weight made it into the initializers bit-exactly
+    w = np.asarray(m.fc.weight.value, np.float32)
+    blobs = [np.frombuffer(t[9][0], dtype=np.float32) for t in inits
+             if t.get(9)]
+    assert any(b.size == w.size and np.array_equal(b.reshape(w.shape), w)
+               for b in blobs)
+
+
+def test_onnx_export_resnet50_via_trace(tmp_path):
+    """ResNet-50 (the model someone would actually export) round-trips
+    through the trace converter with all weights as initializers."""
+    from paddle_tpu.vision.models import resnet50
+    paddle.seed(0)
+    m = resnet50()
+    m.eval()
+    out = paddle.onnx.export(m, str(tmp_path / "r50.onnx"),
+                             input_spec=[InputSpec([1, 3, 64, 64],
+                                                   "float32")])
+    assert out.endswith(".onnx")
+    _, graph, nodes, inits = _decode_model(out)
+    ops = _op_types(nodes)
+    assert ops.count("Conv") == 53      # 53 convs in resnet50
+    assert "MaxPool" in ops and "MatMul" in ops
+    assert os.path.getsize(out) > 90e6  # ~25.6M params as f32
+
+
+def test_onnx_export_gpt_block_via_trace(tmp_path):
+    """A GPT trunk (embedding + attention block + LN) exports: the
+    causal mask/iota subgraphs constant-fold into initializers."""
+    from paddle_tpu.text.models import GPTModel
+    paddle.seed(0)
+    m = GPTModel(tensor_parallel=False, vocab_size=128, hidden_size=32,
+                 num_layers=1, num_heads=2, max_position_embeddings=16,
+                 attn_dropout=0.0, hidden_dropout=0.0)
+    m.eval()
+    out = paddle.onnx.export(m, str(tmp_path / "gpt.onnx"),
+                             input_spec=[InputSpec([1, 16], "int32")])
+    assert out.endswith(".onnx")
+    _, graph, nodes, _ = _decode_model(out)
+    ops = _op_types(nodes)
+    assert "MatMul" in ops and "Gather" in ops and "Where" in ops
+    assert "Tanh" in ops  # gelu tanh form inside the block
+
+
+def test_onnx_export_fallback_warns(tmp_path):
+    class Sorty(nn.Layer):
+        def forward(self, x):
+            import jax.numpy as jnp
+            return jnp.sort(x, axis=-1)  # 'sort' has no ONNX mapping
+
+    m = Sorty()
     with pytest.warns(UserWarning, match="ONNX conversion not available"):
         prefix = paddle.onnx.export(
-            m, str(tmp_path / "res.onnx"),
+            m, str(tmp_path / "srt.onnx"),
             input_spec=[InputSpec([2, 4], "float32")])
     assert not prefix.endswith(".onnx")
     assert os.path.exists(prefix + ".stablehlo")
