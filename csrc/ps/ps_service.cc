@@ -142,7 +142,22 @@ struct Server {
       uint8_t hdr[2];
       int64_t n = 0;
       if (!ReadFull(fd, hdr, 2) || !ReadFull(fd, &n, 8)) break;
-      if (n < 0 || n > (int64_t(1) << 28)) break;  // sanity cap
+      // Bound what one frame can make the server allocate, so a bad or
+      // malicious frame closes the connection instead of bad_alloc-ing
+      // the process: n caps the 8-byte key/dst arrays (kGAdd resizes
+      // three of them), the n*width product caps the float payloads
+      // (kPull/kPush n*dim, kGFeat/kGSetF n*feat_dim, kGSamp n*k).
+      // 2^24 keys = 128MB/array, 2^27 floats = 512MB — far above any
+      // real batch, far below an OOM kill.
+      const int64_t kKeyCap = int64_t(1) << 24;
+      const int64_t kElemCap = int64_t(1) << 27;
+      if (n < 0 || n > kKeyCap) break;
+      if ((hdr[0] == kPull || hdr[0] == kPush) &&
+          n * static_cast<int64_t>(dim) > kElemCap)
+        break;
+      if ((hdr[0] == kGFeat || hdr[0] == kGSetF) &&
+          n * static_cast<int64_t>(feat_dim) > kElemCap)
+        break;
       if (hdr[0] == kHello) {
         // v1 handshake: 4-byte reply, kept exactly as-is so an OLD
         // client against a NEW server still works during rolling
@@ -176,7 +191,7 @@ struct Server {
         keys.resize(static_cast<size_t>(n));
         if (!ReadFull(fd, keys.data(), sizeof(int64_t) * n) ||
             !ReadFull(fd, &k, 4) || !ReadFull(fd, &seed, 8) || k < 0 ||
-            k > (1 << 20) || n * static_cast<int64_t>(k) > (int64_t(1) << 28))
+            k > (1 << 20) || n * static_cast<int64_t>(k) > kElemCap)
           break;  // cap the PRODUCT too: a bad_alloc would kill the process
         std::vector<int64_t> nbrs(static_cast<size_t>(n) * k);
         std::vector<int64_t> counts(static_cast<size_t>(n));

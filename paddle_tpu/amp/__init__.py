@@ -142,16 +142,22 @@ class GradScaler:
             return loss
         return loss * self._scale
 
+    @staticmethod
+    def _unscale_dict(grads, inv):
+        """Shared by unscale_ and unscale_and_update: multiply every grad
+        by ``inv`` and report whether any was non-finite (traced bool)."""
+        flat = [jnp.all(jnp.isfinite(g)) for g in grads.values()
+                if g is not None]
+        finite = jnp.all(jnp.stack(flat)) if flat else jnp.asarray(True)
+        inv = jnp.asarray(inv, jnp.float32)
+        return ({k: None if g is None else g * inv.astype(g.dtype)
+                 for k, g in grads.items()}, ~finite)
+
     def unscale_(self, grads_or_optimizer):
         """Unscale grads; detect non-finite. Accepts a dict of grads (returns
         (unscaled, found_inf)) or an optimizer (unscales Parameter.grad)."""
         if isinstance(grads_or_optimizer, dict):
-            grads = grads_or_optimizer
-            inv = 1.0 / self._scale
-            flat = [jnp.all(jnp.isfinite(g)) for g in grads.values() if g is not None]
-            finite = jnp.all(jnp.stack(flat)) if flat else jnp.asarray(True)
-            return ({k: None if g is None else g * inv for k, g in grads.items()},
-                    ~finite)
+            return self._unscale_dict(grads_or_optimizer, 1.0 / self._scale)
         opt = grads_or_optimizer
         if self._already_unscaled:
             return self._found_inf
@@ -185,6 +191,48 @@ class GradScaler:
                 self._good_steps = 0
         self._found_inf = False
         self._already_unscaled = False
+
+    # -- pure functional path (jitted steps) --------------------------------
+    # The imperative update() above mutates Python floats and therefore
+    # cannot move under jit (a traced step bakes self._scale at trace time
+    # — the reference avoids this by putting update_loss_scaling INTO the
+    # graph, fluid/contrib/mixed_precision/decorator.py:446 +
+    # operators/amp/update_loss_scaling_op). These methods are the traced
+    # equivalent: the scale and good/bad counters live in a state pytree
+    # that the caller threads through the jitted step.
+
+    def init_scale_state(self):
+        """Loss-scale state pytree: {"scale", "good", "bad"} arrays."""
+        return {"scale": jnp.asarray(self._scale, jnp.float32),
+                "good": jnp.zeros((), jnp.int32),
+                "bad": jnp.zeros((), jnp.int32)}
+
+    def scale_loss(self, loss, scale_state):
+        """Scale a loss by the live (traced) scale from the state pytree."""
+        return loss * scale_state["scale"].astype(loss.dtype)
+
+    def unscale_and_update(self, grads, scale_state):
+        """Pure: (grads dict, scale_state) → (unscaled, found_inf, new_state).
+
+        All-traced: found_inf is a 0-d bool array, and the returned state
+        applies the same incr/decr policy as update() with jnp.where so the
+        scale actually moves across jitted steps.
+        """
+        scale = scale_state["scale"]
+        unscaled, found = self._unscale_dict(grads, 1.0 / scale)
+        if not (self._enable and self._dynamic):  # same gate as update()
+            return unscaled, found, scale_state
+        bad = jnp.where(found, scale_state["bad"] + 1, 0)
+        good = jnp.where(found, 0, scale_state["good"] + 1)
+        decr = bad >= self._decr_every
+        incr = good >= self._incr_every
+        new_scale = jnp.where(
+            decr, jnp.maximum(scale * self._decr_ratio, 1.0),
+            jnp.where(incr, scale * self._incr_ratio, scale))
+        new_state = {"scale": new_scale,
+                     "good": jnp.where(incr, 0, good),
+                     "bad": jnp.where(decr, 0, bad)}
+        return unscaled, found, new_state
 
     def step(self, optimizer):
         found = self.unscale_(optimizer)

@@ -153,7 +153,12 @@ class Fleet:
         if port is None:
             eps = [e for e in os.environ.get(
                 "PADDLE_PSERVER_ENDPOINTS", "").split(",") if e.strip()]
-            idx = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+            # this rank's index among the SERVERS — PADDLE_PSERVER_ID
+            # (launch.py sets it for the server role); PADDLE_TRAINER_ID
+            # numbers a different role and only coincides by accident
+            idx = int(os.environ.get(
+                "PADDLE_PSERVER_ID", os.environ.get("PADDLE_TRAINER_ID",
+                                                    "0")))
             port = int(eps[idx].rsplit(":", 1)[1]) if idx < len(eps) else 0
         self._ps_server = PsServer(dim, optimizer, port=port, **table_kwargs)
         self._ps_stop = threading.Event()
@@ -241,6 +246,11 @@ class Fleet:
                              main_program=None, export_for_deployment=True,
                              model=None, input_spec=None):
         """reference fleet_base.py:697 (deprecated alias of save)."""
+        if model is not None and not input_spec:
+            raise ValueError(
+                "fleet.save_inference_model needs input_spec=[InputSpec...] "
+                "to trace the model (an empty spec would export a 0-input "
+                "graph)")
         return self.save(dirname, feed=feeded_var_names or ("x",),
                          fetch=target_vars or ("out",), model=model,
                          input_spec=input_spec)

@@ -48,7 +48,12 @@ def make_lookup(table: SparseTable):
             return emb.reshape(ids_np.shape + (dim,))
 
         out = jax.ShapeDtypeStruct(tuple(ids.shape) + (dim,), jnp.float32)
-        return jax.pure_callback(host_pull, out, ids)
+        # io_callback, NOT pure_callback: pull() is effectful on the table
+        # (row creation, entry-admission counts, LRU stats) — a pure
+        # callback may be elided or re-executed, double-counting admission;
+        # ordered keeps pulls sequenced against the ordered grad pushes
+        return jax.experimental.io_callback(host_pull, out, ids,
+                                            ordered=True)
 
     @jax.custom_vjp
     def lookup(ids, lr, hook):

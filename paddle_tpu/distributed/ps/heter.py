@@ -55,6 +55,8 @@ __all__ = ["HeterEmbedding"]
 _SLOT_COLUMNS = {"sgd": (), "adagrad": ("moment",), "adam": ("m", "v")}
 
 
+
+
 class HeterEmbedding(Layer):
     """Two-tier embedding: HBM hot rows + host PS cold store.
 
@@ -87,10 +89,14 @@ class HeterEmbedding(Layer):
         # IS the device-side optimizer of the hot tier
         self.hot = self.create_parameter((self.capacity, dim),
                                          initializer=Constant(0.0))
+        self._shard_axis = shard_axis
         if shard_axis:
             from jax.sharding import PartitionSpec as P
+            # an indivisible capacity would only surface later as an opaque
+            # GSPMD sharding error — name the numbers here instead
+            from ..mesh import get_mesh
+            self._check_shard_capacity(get_mesh())
             self.hot.pspec = P(shard_axis, None)
-        self._shard_axis = shard_axis
         # host-side hash map mirror
         self._key2slot: dict = {}
         self._slot2key = np.full(self.capacity, -1, np.int64)
@@ -99,6 +105,15 @@ class HeterEmbedding(Layer):
         self._trainer = None
         self._pname = None
         self.stats = {"lookups": 0, "hits": 0, "misses": 0, "evicts": 0}
+
+    def _check_shard_capacity(self, mesh):
+        if (self._shard_axis and mesh is not None
+                and self._shard_axis in mesh.shape
+                and self.capacity % mesh.shape[self._shard_axis]):
+            raise ValueError(
+                f"HeterEmbedding capacity ({self.capacity}) must be "
+                f"divisible by mesh axis {self._shard_axis!r} size "
+                f"({mesh.shape[self._shard_axis]}) to shard the hot tier")
 
     # -- live-state plumbing ------------------------------------------------
     def attach(self, trainer):
@@ -110,6 +125,7 @@ class HeterEmbedding(Layer):
         if name is None:
             raise ValueError("this HeterEmbedding's hot parameter is not "
                              "part of the trainer's model")
+        self._check_shard_capacity(getattr(trainer, "mesh", None))
         self._trainer = trainer
         self._pname = name
         return self

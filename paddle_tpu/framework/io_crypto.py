@@ -1,17 +1,18 @@
 """Model encryption for save/load.
 
 Reference: paddle/fluid/framework/io/crypto/ (C35 in SURVEY.md §2) —
-``CipherFactory``/``AESCipher`` encrypting serialized programs/params so
-models at rest are unreadable without the key.
+``CipherFactory``/``AESCipher`` (AES-GCM, cipher.cc) encrypting serialized
+programs/params so models at rest are unreadable without the key.
 
-TPU translation: pure-stdlib authenticated stream cipher (SHAKE-256
-keystream, HMAC-SHA256 tag, encrypt-then-MAC). No external crypto
-dependency is baked into the image, so AES-NI is traded for a stdlib
-construction with the same API shape and at-rest-confidentiality purpose.
-The keystream is generated per 64MB chunk (SHAKE-256 over
-key||nonce||chunk_offset — offset domain separation) and XORed via numpy,
-bounding peak memory to ~one chunk above the output while staying at
-C speed. Format: ``magic || nonce(16) || ciphertext || tag(32)``.
+Primary construction: **AES-256-GCM** via the ``cryptography`` package when
+importable (it is in this image) — same cipher family as the reference's
+AESCipher. Fallback when ``cryptography`` is absent: a pure-stdlib
+authenticated stream cipher (SHAKE-256 keystream, HMAC-SHA256 tag,
+encrypt-then-MAC), keystream per 64MB chunk (SHAKE-256 over
+key||nonce||chunk_offset — offset domain separation) XORed via numpy.
+Formats (self-describing by magic; decrypt reads both):
+``PTPUENC3 || nonce(12) || ct+tag`` (AES-GCM) and
+``PTPUENC2 || nonce(16) || ciphertext || tag(32)`` (SHAKE fallback).
 """
 from __future__ import annotations
 
@@ -22,10 +23,21 @@ import os
 __all__ = ["Cipher", "CipherFactory", "encrypt_bytes", "decrypt_bytes",
            "encrypt_file", "decrypt_file"]
 
-_MAGIC = b"PTPUENC2"  # v2: chunked offset-keyed keystream
+_MAGIC_GCM = b"PTPUENC3"  # v3: AES-256-GCM (reference-parity cipher)
+_MAGIC = b"PTPUENC2"  # v2: chunked offset-keyed SHAKE keystream (fallback)
 _MAGIC_V1 = b"PTPUENC1"  # pre-release whole-buffer keystream (unsupported)
 _NONCE = 16
+_GCM_NONCE = 12
 _TAG = 32
+
+
+def _aesgcm():
+    """AESGCM class when ``cryptography`` is importable, else None."""
+    try:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        return AESGCM
+    except ImportError:
+        return None
 
 
 _CHUNK = 64 * 1024 * 1024
@@ -53,6 +65,11 @@ def _derive(key: bytes, purpose: bytes) -> bytes:
 
 
 def encrypt_bytes(data: bytes, key: bytes) -> bytes:
+    AESGCM = _aesgcm()
+    if AESGCM is not None:
+        nonce = os.urandom(_GCM_NONCE)
+        ct = AESGCM(_derive(key, b"aes")).encrypt(nonce, data, _MAGIC_GCM)
+        return _MAGIC_GCM + nonce + ct
     nonce = os.urandom(_NONCE)
     enc_key = _derive(key, b"enc")
     mac_key = _derive(key, b"mac")
@@ -62,6 +79,20 @@ def encrypt_bytes(data: bytes, key: bytes) -> bytes:
 
 
 def decrypt_bytes(blob: bytes, key: bytes) -> bytes:
+    if blob.startswith(_MAGIC_GCM):
+        AESGCM = _aesgcm()
+        if AESGCM is None:
+            raise ValueError(
+                "blob is AES-GCM encrypted but the 'cryptography' package "
+                "is not importable in this environment")
+        nonce = blob[len(_MAGIC_GCM):len(_MAGIC_GCM) + _GCM_NONCE]
+        ct = blob[len(_MAGIC_GCM) + _GCM_NONCE:]
+        try:
+            return AESGCM(_derive(key, b"aes")).decrypt(nonce, ct,
+                                                        _MAGIC_GCM)
+        except Exception:
+            raise ValueError(
+                "decryption failed: wrong key or corrupted data") from None
     if blob.startswith(_MAGIC_V1):
         # v1 used a different keystream derivation; XORing with the v2
         # stream would return garbage that still passes the (ciphertext)

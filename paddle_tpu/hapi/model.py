@@ -130,10 +130,18 @@ class Model:
                     out, new_buffers = functional_call(
                         net, merged, buffers, *inputs, rng=key)
                 loss = self._compute_loss(out, labels)
-                return loss, (out, new_buffers)
+                # dynamic loss scaling (static.amp.decorate): grads are of
+                # the SCALED loss; apply_gradients unscales with the same
+                # traced scale from opt_state and advances it in-graph
+                # always via scale_loss when present: it reads the traced
+                # scale from opt_state OR the host float for legacy states
+                # — matching whichever branch apply_gradients unscales in
+                scaled = (opt.scale_loss(loss, opt_state)
+                          if hasattr(opt, "scale_loss") else loss)
+                return scaled, (loss, out, new_buffers)
 
             tparams = {k: v for k, v in params.items() if trainable[k]}
-            (loss, (out, new_buffers)), grads = jax.value_and_grad(
+            (_, (loss, out, new_buffers)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(tparams)
             new_t, new_opt = opt.apply_gradients(tparams, grads, opt_state,
                                                  lr=lr, lr_scales=lr_scales)

@@ -67,21 +67,38 @@ class Config:
     def enable_profile(self):
         self._enable_profile = True
 
-    # accepted-but-inert reference toggles (XLA owns these optimizations)
+    # accepted-but-inert reference toggles (XLA owns these optimizations).
+    # Each warns once per process so callers porting reference configs are
+    # told their knob does nothing here instead of silently ignored.
+    _warned_toggles: set = set()
+
+    def _inert(self, name: str, owner: str):
+        if name not in Config._warned_toggles:
+            Config._warned_toggles.add(name)
+            import warnings
+            warnings.warn(
+                f"Config.{name} is accepted for source compatibility but "
+                f"has no effect on TPU: {owner}", stacklevel=3)
+
     def switch_ir_optim(self, flag: bool = True):
-        pass
+        self._inert("switch_ir_optim",
+                    "XLA applies its own graph optimizations under jit")
 
     def enable_memory_optim(self):
-        pass
+        self._inert("enable_memory_optim",
+                    "XLA's buffer assignment owns memory reuse")
 
     def enable_tensorrt_engine(self, *a, **kw):
-        pass
+        self._inert("enable_tensorrt_engine",
+                    "there is no TensorRT on TPU; XLA compiles the graph")
 
     def enable_mkldnn(self):
-        pass
+        self._inert("enable_mkldnn",
+                    "there is no oneDNN path; XLA:CPU/TPU compiles the graph")
 
     def set_cpu_math_library_num_threads(self, n: int):
-        pass
+        self._inert("set_cpu_math_library_num_threads",
+                    "XLA:CPU owns its thread pool")
 
 
 class Tensor:

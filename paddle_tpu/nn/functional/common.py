@@ -234,7 +234,19 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     (num_samples,). Labels whose class was not sampled (only possible
     when positives > num_samples) map to -1. Deterministic under
     paddle.seed via the framework RNG."""
+    if num_samples > num_classes:
+        raise ValueError(
+            f"class_center_sample: num_samples ({num_samples}) must be "
+            f"<= num_classes ({num_classes})")
     label = jnp.asarray(label).reshape(-1)
+    # out-of-range labels would silently clamp under XLA scatter; check
+    # when concrete (eager path — traced callers own their preconditions)
+    if not isinstance(label, jax.core.Tracer):
+        lab = np.asarray(label)
+        if lab.size and (lab.min() < 0 or lab.max() >= num_classes):
+            raise ValueError(
+                f"class_center_sample: labels must be in [0, {num_classes})"
+                f", got range [{lab.min()}, {lab.max()}]")
     present = jnp.zeros((num_classes,), bool).at[label].set(True)
     rand = jax.random.uniform(get_rng_key(), (num_classes,))
     # positives sort below every negative; negatives shuffle uniformly

@@ -88,31 +88,72 @@ class OptimizerWithMixedPrecision:
                  no_grad_set=None):
         return self.step()
 
+    def init_state(self, params):
+        """Inner optimizer state + a "loss_scale" sub-pytree so the dynamic
+        scale moves UNDER JIT (reference decorator.py:446 puts
+        update_loss_scaling into the graph; here the state is threaded
+        through the traced step instead of mutated on the host)."""
+        state = self._optimizer.init_state(params)
+        if self._scaler is not None and isinstance(state, dict):
+            state = dict(state)
+            state["loss_scale"] = self._scaler.init_scale_state()
+        return state
+
+    def scale_loss(self, loss, state=None):
+        """Scale a loss by the live scale. With a state pytree from
+        init_state this is traced (jit-safe); without, the host float."""
+        if self._scaler is None:
+            return loss
+        if isinstance(state, dict) and "loss_scale" in state:
+            return self._scaler.scale_loss(loss, state["loss_scale"])
+        return self._scaler.scale(loss)
+
     def apply_gradients(self, params, grads, state, lr=None,
                         lr_scales=None):
-        """Functional path (jitted steps): unscale + finite-gate here."""
+        """Functional path (jitted steps): unscale + finite-gate here.
+
+        When ``state`` came from this wrapper's init_state it carries a
+        "loss_scale" pytree: the unscale uses the TRACED scale and the
+        incr/decr counters advance inside the graph, so persistent overflow
+        actually backs the scale off under jit. Legacy states without the
+        key fall back to the trace-time host float (scale never moves —
+        callers owning their state should migrate to init_state)."""
         if self._scaler is None:
             return self._optimizer.apply_gradients(params, grads, state,
                                                    lr=lr,
                                                    lr_scales=lr_scales)
+        import jax
         import jax.numpy as jnp
 
-        grads, found_inf = self._scaler.unscale_(dict(grads))
+        carried = isinstance(state, dict) and "loss_scale" in state
+        if carried:
+            inner_state = {k: v for k, v in state.items()
+                           if k != "loss_scale"}
+            grads, found_inf, new_ls = self._scaler.unscale_and_update(
+                dict(grads), state["loss_scale"])
+        else:
+            inner_state = state
+            grads, found_inf = self._scaler.unscale_(dict(grads))
         new_p, new_s = self._optimizer.apply_gradients(
-            params, grads, state, lr=lr, lr_scales=lr_scales)
+            params, grads, inner_state, lr=lr, lr_scales=lr_scales)
         # non-finite step: keep old params AND optimizer state (inf grads
         # would otherwise poison the moments) — traced-safe select
         keep = jnp.asarray(found_inf)
-        import jax
         new_p = jax.tree.map(lambda n, o: jnp.where(keep, o, n), new_p,
                              dict(params))
         new_s = jax.tree.map(lambda n, o: jnp.where(keep, o, n), new_s,
-                             state)
+                             inner_state)
+        if carried:
+            new_s = dict(new_s)
+            new_s["loss_scale"] = new_ls  # advances even on skipped steps
         return new_p, new_s
 
-    def get_loss_scaling(self):
-        return (float(self._scaler._scale) if self._scaler is not None
-                else 1.0)
+    def get_loss_scaling(self, state=None):
+        if self._scaler is None:
+            return 1.0
+        if isinstance(state, dict) and "loss_scale" in state:
+            return float(state["loss_scale"]["scale"])
+        return float(self._scaler._scale)
 
 
 def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
@@ -124,6 +165,9 @@ def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
     return OptimizerWithMixedPrecision(
         optimizer, amp_lists=amp_lists,
         init_loss_scaling=init_loss_scaling,
+        incr_every_n_steps=incr_every_n_steps,
+        decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+        incr_ratio=incr_ratio, decr_ratio=decr_ratio,
         use_dynamic_loss_scaling=use_dynamic_loss_scaling,
         use_pure_fp16=use_pure_fp16)
 

@@ -158,3 +158,30 @@ class TestFleetSave:
         import pytest as _pytest
         with _pytest.raises(ValueError, match="model"):
             f.save(str(tmp_path / "bad"), feed=["x"], fetch=["out"])
+        # save_inference_model without input_spec: an empty spec would
+        # export a 0-input graph — must be named, not silently exported
+        with _pytest.raises(ValueError, match="input_spec"):
+            f.save_inference_model(dirname=str(tmp_path / "bad2"),
+                                   model=net)
+
+    def test_init_server_port_uses_pserver_id(self, monkeypatch):
+        """The server's slot in PADDLE_PSERVER_ENDPOINTS is indexed by
+        PADDLE_PSERVER_ID (the server role's own index), not the trainer
+        id (ADVICE r3: a trainer id that happens to be in range would
+        silently bind another server's port)."""
+        from paddle_tpu.distributed import fleet as fleet_mod
+
+        f = fleet_mod.Fleet()
+        monkeypatch.setenv("PADDLE_PSERVER_ENDPOINTS",
+                           "127.0.0.1:0,127.0.0.1:1")
+        monkeypatch.setenv("PADDLE_PSERVER_ID", "0")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")  # would pick :1
+        srv = f.init_server(dim=4, optimizer="sgd")
+        try:
+            # PSERVER_ID=0 selects endpoint :0 (ephemeral bind), proving
+            # the trainer id was ignored; picking :1 would either bind
+            # port 1 (EACCES) or error
+            assert srv.endpoint.rsplit(":", 1)[1] not in ("1",)
+        finally:
+            f.stop_server()
+            srv.stop()

@@ -67,6 +67,32 @@ def test_crypto_roundtrip_and_tamper():
         decrypt_bytes(b"garbage", key)
 
 
+def test_crypto_uses_aes_gcm_when_available():
+    """Primary construction is AES-256-GCM via `cryptography` (the
+    reference's AESCipher family, io/crypto/cipher.cc); the SHAKE stream
+    construction is the documented fallback and old blobs still decrypt."""
+    from paddle_tpu.framework import io_crypto
+
+    key = CipherFactory.generate_key()
+    data = b"model-weights" * 100
+    AESGCM = io_crypto._aesgcm()
+    assert AESGCM is not None, "cryptography IS importable in this image"
+    blob = encrypt_bytes(data, key)
+    assert blob.startswith(b"PTPUENC3")
+    assert decrypt_bytes(blob, key) == data
+    with pytest.raises(ValueError):
+        decrypt_bytes(blob, CipherFactory.generate_key())
+    with pytest.raises(ValueError):  # GCM tag catches tampering
+        decrypt_bytes(blob[:-1] + bytes([blob[-1] ^ 1]), key)
+
+    # a v2 (fallback-format) blob from an older writer still decrypts
+    import unittest.mock as mock
+    with mock.patch.object(io_crypto, "_aesgcm", lambda: None):
+        v2 = encrypt_bytes(data, key)
+    assert v2.startswith(b"PTPUENC2")
+    assert decrypt_bytes(v2, key) == data
+
+
 def test_cipher_file_roundtrip(tmp_path):
     c = Cipher()
     path = str(tmp_path / "model.enc")

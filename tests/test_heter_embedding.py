@@ -180,6 +180,18 @@ class TestShardedHotTier:
         np.testing.assert_allclose(ref, shd, rtol=2e-4)
 
 
+class TestShardCapacityValidation:
+    def test_indivisible_capacity_raises_with_named_numbers(self):
+        """An indivisible hot-tier capacity must fail with the numbers
+        named, not as an opaque GSPMD sharding error later (ADVICE r4)."""
+        from paddle_tpu.distributed.ps.heter import HeterEmbedding
+        mesh = build_mesh({"data": 2, "model": 4})
+        with mesh:
+            with pytest.raises(ValueError, match=r"66.*divisible.*'model'"):
+                HeterEmbedding(8, capacity=66, shard_axis="model")
+            HeterEmbedding(8, capacity=64, shard_axis="model")  # ok
+
+
 class TestWideDeepHeter:
     def test_e2e_trains_and_matches_host_path(self):
         from paddle_tpu.rec import WideDeep

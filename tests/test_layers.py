@@ -239,3 +239,53 @@ class TestAmp:
         np.testing.assert_allclose(np.asarray(unscaled["w"]), 1.0)
         scaler.update(True)  # nan step → halve
         assert scaler.get_loss_scaling() == 4.0
+
+    def test_functional_scaler_disabled_is_inert(self):
+        """enable=False must no-op through the functional path exactly as
+        update() does imperatively — the scale stays 1.0 forever."""
+        scaler = paddle.amp.GradScaler(enable=False, init_loss_scaling=8.0,
+                                       incr_every_n_steps=1)
+        st = scaler.init_scale_state()
+        assert float(st["scale"]) == 1.0
+        _, found, st = scaler.unscale_and_update({"w": jnp.ones(2)}, st)
+        assert not bool(found)
+        assert float(st["scale"]) == 1.0
+
+    def test_jitted_dynamic_loss_scale_moves(self):
+        """static.amp.decorate: overflow → scale halves; recovery → scale
+        regrows — UNDER JIT, via the loss-scale state pytree (reference
+        puts update_loss_scaling into the graph, decorator.py:446)."""
+        import jax
+        from paddle_tpu import static
+        lin = nn.Linear(1, 1, bias_attr=False)
+        opt = static.amp.decorate(
+            paddle.optimizer.SGD(0.5, parameters=lin.parameters()),
+            init_loss_scaling=16.0, incr_every_n_steps=3,
+            decr_every_n_nan_or_inf=2, incr_ratio=2.0, decr_ratio=0.5)
+
+        params = {"w": jnp.asarray([1.0])}
+        state = opt.init_state(params)
+        assert opt.get_loss_scaling(state) == 16.0
+
+        @jax.jit
+        def step(params, state, grads):
+            return opt.apply_gradients(params, grads, state, lr=0.1)
+
+        # scale_loss is traced from the state, not baked from the host float
+        assert float(opt.scale_loss(jnp.asarray(1.0), state)) == 16.0
+
+        inf_g = {"w": jnp.asarray([jnp.inf])}
+        # 1st overflow: update skipped, counter advances, scale unchanged
+        params, state = step(params, state, inf_g)
+        assert float(params["w"][0]) == 1.0
+        assert opt.get_loss_scaling(state) == 16.0
+        # 2nd consecutive overflow: scale halves (decr_every=2)
+        params, state = step(params, state, inf_g)
+        assert float(params["w"][0]) == 1.0
+        assert opt.get_loss_scaling(state) == 8.0
+        # 3 good steps: scale regrows 2x (incr_every=3); params move
+        good = {"w": jnp.asarray([8.0])}   # scaled grad, true grad 1.0
+        for _ in range(3):
+            params, state = step(params, state, good)
+        assert opt.get_loss_scaling(state) == 16.0
+        assert float(params["w"][0]) == pytest.approx(1.0 - 3 * 0.1)

@@ -230,3 +230,15 @@ class TestClassCenterSample:
 
         _, s3 = f(label, key)
         assert len(set(np.asarray(s3).tolist())) == 8
+
+    def test_validation(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.nn import functional as F
+        paddle.seed(0)
+        with pytest.raises(ValueError, match="num_samples"):
+            F.class_center_sample(jnp.asarray([1], jnp.int32), 10, 11)
+        # out-of-range labels would silently clamp under XLA scatter
+        with pytest.raises(ValueError, match="labels must be in"):
+            F.class_center_sample(jnp.asarray([100], jnp.int32), 100, 8)
+        with pytest.raises(ValueError, match="labels must be in"):
+            F.class_center_sample(jnp.asarray([-1], jnp.int32), 100, 8)

@@ -86,6 +86,32 @@ class TestPsService:
         finally:
             srv.stop()
 
+    def test_oversized_frame_products_rejected(self):
+        """A frame whose n passes the raw cap but whose n*dim product is
+        ~GBs must close the connection, not bad_alloc the server (same
+        exposure kGSamp's n*k cap closed; ps_service.cc product caps)."""
+        import struct
+        srv = PsServer(16, optimizer="sgd")  # dim 16
+        try:
+            host, port = srv.endpoint.rsplit(":", 1)
+            # (op, n): kGAdd tripping the raw key cap (its frame resizes
+            # three 8-byte arrays), kPull/kPush tripping the n*dim product
+            # cap with an n that PASSES the key cap
+            for op, n in ((4, (1 << 24) + 1),
+                          (1, (1 << 23) + 1), (2, (1 << 23) + 1)):
+                s = socket.create_connection((host, int(port)), timeout=10)
+                s.sendall(bytes([op, 0]) + struct.pack("<q", n))
+                s.settimeout(10)
+                assert s.recv(1) == b""  # server closed on the bad frame
+                s.close()
+            # the server survived and still serves normal clients
+            dist = DistributedSparseTable([srv.endpoint])
+            out = dist.pull(np.arange(4, dtype=np.int64))
+            assert out.shape == (4, 16)
+            dist.close()
+        finally:
+            srv.stop()
+
     def test_async_push_error_surfaces(self):
         srv = PsServer(4, optimizer="sgd")
         dist = DistributedSparseTable([srv.endpoint], async_mode=True)
