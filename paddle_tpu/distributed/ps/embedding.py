@@ -48,12 +48,19 @@ def make_lookup(table: SparseTable):
             return emb.reshape(ids_np.shape + (dim,))
 
         out = jax.ShapeDtypeStruct(tuple(ids.shape) + (dim,), jnp.float32)
-        # io_callback, NOT pure_callback: pull() is effectful on the table
-        # (row creation, entry-admission counts, LRU stats) — a pure
-        # callback may be elided or re-executed, double-counting admission;
-        # ordered keeps pulls sequenced against the ordered grad pushes
-        return jax.experimental.io_callback(host_pull, out, ids,
-                                            ordered=True)
+        # pure_callback — deliberately, although pull() is effectful on
+        # the table (row creation, admission counts, LRU stats): an
+        # ordered io_callback here is a side-effecting HLO that the SPMD
+        # partitioner refuses to shard (RET_CHECK: "side-effect HLO
+        # cannot have replicated sharding"), crashing any data-parallel
+        # lookup at compile time. pure_callback partitions per-shard
+        # (each device pulls its own ids — exactly the PS fan-out we
+        # want); the cost is that a re-traced/rematted pull may bump
+        # admission counts twice — a stats-accuracy wobble, not a value
+        # error, since pull() returns the same rows either way. The grad
+        # push below stays an ORDERED io_callback: updates must neither
+        # dedupe nor reorder.
+        return jax.pure_callback(host_pull, out, ids)
 
     @jax.custom_vjp
     def lookup(ids, lr, hook):

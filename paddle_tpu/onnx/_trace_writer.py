@@ -38,29 +38,25 @@ _FOLD_CAP = 4_000_000  # elements; larger constants abort folding
 
 
 def _onnx_dt(dtype) -> int:
-    d = np.dtype(dtype) if not str(dtype).startswith("bfloat16") else None
-    if d is None or str(dtype) == "bfloat16":
+    """Exact-dtype policy (see _writer._NP_TO_ONNX): integer widths and
+    f16/f64 are preserved in the exported graph signature — a model
+    traced with int32 ids demands int32 inputs, not silently-widened
+    int64 (round-4 ADVICE) — and bf16 maps to FLOAT (documented:
+    exactly-representable, and runtime BFLOAT16 coverage is patchy)."""
+    from ._writer import _NP_TO_ONNX
+    if str(dtype) == "bfloat16":
         return 1
-    if d in (np.dtype(np.float32), np.dtype(np.float64),
-             np.dtype(np.float16)):
-        return 1
-    if d in (np.dtype(np.int64), np.dtype(np.int32), np.dtype(np.int16),
-             np.dtype(np.int8), np.dtype(np.uint8), np.dtype(np.uint32)):
-        return 7
-    if d == np.dtype(np.bool_):
-        return 9
-    raise NotImplementedError(f"dtype {dtype} in ONNX conversion")
+    dt = _NP_TO_ONNX.get(np.dtype(dtype))
+    if dt is None:
+        raise NotImplementedError(f"dtype {dtype} in ONNX conversion")
+    return dt
 
 
 def _to_init_arr(arr: np.ndarray) -> np.ndarray:
-    """Initializer storage dtype (f32 / i64 / bool)."""
-    if str(arr.dtype) == "bfloat16" or arr.dtype.kind == "f":
+    """Initializer storage under the exact-dtype policy (bf16 -> f32)."""
+    if str(arr.dtype) == "bfloat16":
         return arr.astype(np.float32)
-    if arr.dtype.kind in "iu":
-        return arr.astype(np.int64)
-    if arr.dtype == np.bool_:
-        return arr
-    raise NotImplementedError(f"initializer dtype {arr.dtype}")
+    return arr
 
 
 def _bool_tensor(name: str, arr: np.ndarray) -> bytes:
@@ -73,15 +69,52 @@ def _bool_tensor(name: str, arr: np.ndarray) -> bytes:
 
 
 class _Converter:
-    def __init__(self):
+    def __init__(self, dyn_batch: int | None = None):
         self.g = _GraphBuilder()
         self.env: Dict = {}        # jax Var -> onnx name (str)
         self.const: Dict = {}      # jax Var -> np.ndarray (foldable)
         self._lit_cache: Dict = {}
+        # dynamic batch: the sentinel batch size the trace ran at. Shape
+        # consts with a leading sentinel become 0 (ONNX Reshape "copy
+        # input dim"); any OTHER appearance of the sentinel — a folded
+        # constant with a batch-sized dim, a batch-dependent slice bound,
+        # a flattened (batch*heads) matmul reshape — cannot be made
+        # batch-polymorphic and raises, so the caller can fall back to a
+        # static-batch export instead of emitting a silently-wrong graph.
+        self.dyn_batch = dyn_batch
 
     # -- helpers ------------------------------------------------------------
     def add_const(self, arr, hint="const") -> str:
         arr = np.asarray(arr)
+        b = self.dyn_batch
+        if b is not None:
+            # batch-bake detection. Callers that can PROVE a leading
+            # sentinel is the batch (p_reshape checks the reshape
+            # input's dim 0) rewrite it to 0 BEFORE calling add_const;
+            # any sentinel remaining here is a bake and the export
+            # falls back to a static batch. Heuristics (a 0-d scalar ==
+            # sentinel or ~= 1/sentinel catches mean-over-batch
+            # rescales) can false-positive on coincidental values —
+            # the cost is a conservative static export, never a wrong
+            # dynamic graph.
+            if hint == "shape" and arr.ndim == 1:
+                if b in arr:
+                    raise NotImplementedError(
+                        f"dynamic batch: shape constant {arr.tolist()} "
+                        "bakes the batch size")
+            elif b in arr.shape or (arr.ndim == 1 and arr.size <= 8
+                                    and arr.dtype.kind == 'i'
+                                    and b in arr):
+                raise NotImplementedError(
+                    "dynamic batch: a constant bakes the traced batch "
+                    f"size (shape {arr.shape})")
+            elif arr.ndim == 0 and arr.dtype.kind in "iuf" and (
+                    float(arr) == float(b)
+                    or abs(float(arr) - 1.0 / b) < 1e-9):
+                raise NotImplementedError(
+                    "dynamic batch: a scalar constant equals the traced "
+                    "batch size (or its reciprocal) — likely a "
+                    "batch-derived value")
         if arr.dtype == np.bool_:
             name = self.g.fresh(hint)
             self.g.initializers.append(_bool_tensor(name, arr))
@@ -326,8 +359,18 @@ class _Converter:
 
     # -- shape ops ----------------------------------------------------------
     def p_reshape(self, eq):
-        shape = self.add_const(
-            np.asarray(eq.outvars[0].aval.shape, np.int64), "shape")
+        target = np.asarray(eq.outvars[0].aval.shape, np.int64)
+        b = self.dyn_batch
+        if (b is not None and target.size and target[0] == b
+                and eq.invars[0].aval.shape
+                and eq.invars[0].aval.shape[0] == b):
+            # the INPUT's dim 0 is the batch too, so ONNX Reshape's
+            # 0 ("copy input dim 0") is batch-polymorphic; a leading
+            # sentinel without that property falls through to
+            # add_const's bake detection (raise -> static fallback)
+            target = target.copy()
+            target[0] = 0
+        shape = self.add_const(target, "shape")
         self.env[eq.outvars[0]] = self.node(
             "Reshape", [self.name_of(eq.invars[0]), shape])
 
@@ -348,17 +391,31 @@ class _Converter:
         bdims = [int(d) for d in eq.params["broadcast_dimensions"]]
         in_aval = eq.invars[0].aval
         cur = self.name_of(eq.invars[0])
-        # step 1: reshape so kept dims land in their target positions
-        # with 1s elsewhere; step 2: Expand broadcasts the 1s
+        # step 1: Unsqueeze inserts the new size-1 axes (bdims is
+        # monotonically increasing, so kept dims keep their order; no
+        # shape constant — stays batch-polymorphic under dyn_batch);
+        # step 2: Expand broadcasts the 1s
         mid = [1] * len(out_shape)
         for src, dst in enumerate(bdims):
             mid[dst] = int(in_aval.shape[src])
-        if tuple(mid) != tuple(in_aval.shape) or len(mid) != in_aval.ndim:
-            shape_c = self.add_const(np.asarray(mid, np.int64), "shape")
-            cur = self.node("Reshape", [cur, shape_c])
+        new_axes = [i for i in range(len(out_shape)) if i not in bdims]
+        if new_axes:
+            ax_c = self.add_const(np.asarray(new_axes, np.int64), "axes")
+            cur = self.node("Unsqueeze", [cur, ax_c])
         if tuple(mid) != tuple(out_shape):
-            tgt = self.add_const(np.asarray(out_shape, np.int64), "shape")
-            cur = self.node("Expand", [cur, tgt])
+            tgt = list(out_shape)
+            if self.dyn_batch is not None:
+                for i, (m, o) in enumerate(zip(mid, out_shape)):
+                    if o == self.dyn_batch:
+                        if m != o:
+                            raise NotImplementedError(
+                                "dynamic batch: broadcast ALONG the "
+                                "batch dim bakes the batch size")
+                        # Expand target 1 means "keep the input dim"
+                        # (numpy broadcast) — batch-polymorphic
+                        tgt[i] = 1
+            tgt_c = self.add_const(np.asarray(tgt, np.int64), "shape")
+            cur = self.node("Expand", [cur, tgt_c])
         self.env[eq.outvars[0]] = cur
 
     def p_concatenate(self, eq):
@@ -454,6 +511,14 @@ class _Converter:
 
         ln, lbs, lfs, lcs, lfree = canon(lname, la, lb, lc, True)
         rn, rbs, rfs, rcs, rfree = canon(rname, ra, rb, rc, False)
+        if len(lfs) == 1 and len(rfs) == 1 and len(lcs) == 1 \
+                and lbs == rbs:
+            # operands are already (batch..., M, K) x (batch..., K, N):
+            # ONNX MatMul is natively N-D batched — no flattening
+            # reshapes (and none of the baked shape constants that break
+            # dynamic-batch export)
+            self.env[eq.outvars[0]] = self.node("MatMul", [ln, rn])
+            return
         B = int(np.prod(lbs)) if lbs else 1
         M = int(np.prod(lfs)) if lfs else 1
         K = int(np.prod(lcs)) if lcs else 1
@@ -533,8 +598,13 @@ class _Converter:
             # jax appends an index-vector dim of size 1; strip it
             ishape = [int(s) for s in indices.aval.shape]
             if ishape and ishape[-1] == 1:
-                sq = self.add_const(
-                    np.asarray(ishape[:-1], np.int64), "shape")
+                tgt = np.asarray(ishape[:-1], np.int64)
+                if (self.dyn_batch is not None and tgt.size
+                        and tgt[0] == self.dyn_batch
+                        and ishape[0] == self.dyn_batch):
+                    tgt = tgt.copy()
+                    tgt[0] = 0  # strip-trailing-1 keeps dim 0 = batch
+                sq = self.add_const(tgt, "shape")
                 idx = self.node("Reshape", [idx, sq])
             self.env[eq.outvars[0]] = self.node(
                 "Gather", [self.name_of(operand), idx],
@@ -554,29 +624,39 @@ class _Converter:
 
 
 def trace_to_onnx(fn, example_args, path: str, opset_version: int = 13,
-                  input_names=None) -> str:
+                  input_names=None, dyn_batch: int | None = None) -> str:
     """Trace fn(*example_args) and write an ONNX model. Array-valued
-    constants (closed-over parameters) become initializers."""
+    constants (closed-over parameters) become initializers. With
+    ``dyn_batch`` (the sentinel batch the example args carry), leading
+    dims equal to it are declared as the dynamic "N" dim_param and shape
+    constants are rewritten batch-polymorphically (or the conversion
+    raises NotImplementedError for graphs that bake the batch — callers
+    retry statically)."""
     closed = jax.make_jaxpr(fn)(*example_args)
-    conv = _Converter()
+    conv = _Converter(dyn_batch=dyn_batch)
     jaxpr = closed.jaxpr
     for cv, cval in zip(jaxpr.constvars, closed.consts):
         conv.const[cv] = np.asarray(cval)
     input_names = input_names or [f"input_{i}"
                                   for i in range(len(jaxpr.invars))]
+
+    def _dims(shape):
+        return [None if (dyn_batch is not None and i == 0
+                         and d == dyn_batch) else int(d)
+                for i, d in enumerate(shape)]
+
     graph_inputs = []
     for name, iv in zip(input_names, jaxpr.invars):
         conv.env[iv] = name
         graph_inputs.append(_value_info(
-            name, list(iv.aval.shape), _onnx_dt(iv.aval.dtype)))
+            name, _dims(iv.aval.shape), _onnx_dt(iv.aval.dtype)))
     conv.convert(jaxpr)
     out_infos, out_renames = [], []
     for i, ov in enumerate(jaxpr.outvars):
         oname = f"output_{i}"
         conv.g.add_node("Identity", [conv.name_of(ov)], [oname])
         out_infos.append(_value_info(
-            oname, [int(s) for s in ov.aval.shape],
-            _onnx_dt(ov.aval.dtype)))
+            oname, _dims(ov.aval.shape), _onnx_dt(ov.aval.dtype)))
         out_renames.append(oname)
     g = conv.g
     graph = b"".join(_pb.f_bytes(1, n) for n in g.nodes)
@@ -590,9 +670,19 @@ def trace_to_onnx(fn, example_args, path: str, opset_version: int = 13,
     return path
 
 
+_DYN_SENTINEL = 13  # trace batch for dynamic-dim specs: a prime rare as
+#                     a real model dim, so "== sentinel" identifies batch
+
+
 def export_traced_layer(layer, path: str, input_spec,
                         opset_version: int = 13) -> str:
-    """Layer -> ONNX via jaxpr tracing (eval-mode, params as consts)."""
+    """Layer -> ONNX via jaxpr tracing (eval-mode, params as consts).
+
+    A leading ``None``/-1 dim in the input spec exports a dynamic batch
+    dim (dim_param "N") when the traced graph is batch-polymorphic;
+    graphs that bake the batch (folded batch-shaped constants,
+    flattened-batch matmul reshapes) fall back to a static batch of 1
+    with a warning."""
     from ..jit.functionalization import functional_call, state_of
     was_training = getattr(layer, "training", False)
     layer.eval()
@@ -600,18 +690,40 @@ def export_traced_layer(layer, path: str, input_spec,
         params, buffers = state_of(layer)
         specs = input_spec if isinstance(input_spec, (list, tuple)) \
             else [input_spec]
-        args = []
-        for s in specs:
-            shape = [1 if (d is None or (isinstance(d, int) and d < 0))
-                     else int(d) for d in getattr(s, "shape", s)]
-            dtype = getattr(s, "dtype", None) or jnp.float32
-            args.append(jnp.zeros(shape, dtype))
+
+        def _args(batch):
+            out = []
+            for s in specs:
+                shape = [batch if (d is None
+                                   or (isinstance(d, int) and d < 0))
+                         else int(d) for d in getattr(s, "shape", s)]
+                dtype = getattr(s, "dtype", None) or jnp.float32
+                out.append(jnp.zeros(shape, dtype))
+            return out
 
         def fn(*xs):
             out, _ = functional_call(layer, params, buffers, *xs)
             return out
 
-        return trace_to_onnx(fn, args, path, opset_version=opset_version)
+        dynamic = any(
+            (lambda sh: len(sh) > 0 and (sh[0] is None or (
+                isinstance(sh[0], int) and sh[0] < 0)))(
+                list(getattr(s, "shape", s)))
+            for s in specs)
+        if dynamic:
+            try:
+                return trace_to_onnx(fn, _args(_DYN_SENTINEL), path,
+                                     opset_version=opset_version,
+                                     dyn_batch=_DYN_SENTINEL)
+            except NotImplementedError as e:
+                if "dynamic batch" not in str(e):
+                    raise
+                import warnings
+                warnings.warn(
+                    f"ONNX dynamic batch not expressible for this graph "
+                    f"({e}); exporting with a static batch of 1")
+        return trace_to_onnx(fn, _args(1), path,
+                             opset_version=opset_version)
     finally:
         if was_training:
             layer.train()

@@ -54,20 +54,39 @@ def _node(op_type: str, inputs: List[str], outputs: List[str],
     return body
 
 
+# ONNX TensorProto.DataType enums for the exact-dtype policy: integer
+# widths are preserved (an int32-ids model must load with int32 inputs —
+# widening to i64 broke consumers), floats keep their width, and bf16 is
+# exported as FLOAT (documented: every bf16 value is exactly
+# representable in f32, and runtime BFLOAT16 kernel coverage is patchy).
+_NP_TO_ONNX = {
+    np.dtype(np.float32): 1, np.dtype(np.uint8): 2, np.dtype(np.int8): 3,
+    np.dtype(np.uint16): 4, np.dtype(np.int16): 5, np.dtype(np.int32): 6,
+    np.dtype(np.int64): 7, np.dtype(np.bool_): 9,
+    np.dtype(np.float16): 10, np.dtype(np.float64): 11,
+    np.dtype(np.uint32): 12, np.dtype(np.uint64): 13,
+}
+
+
+def _np_onnx_dtype(arr: np.ndarray):
+    """(storage array, onnx enum) under the exact-dtype policy."""
+    if str(arr.dtype) == "bfloat16":
+        return arr.astype(np.float32), 1
+    dt = _NP_TO_ONNX.get(arr.dtype)
+    if dt is None:
+        raise NotImplementedError(f"dtype {arr.dtype} in ONNX export")
+    return arr, dt
+
+
 def _tensor(name: str, arr: np.ndarray) -> bytes:
-    arr = np.asarray(arr)
-    if arr.dtype in (np.float32, np.float64, np.float16):
-        arr = arr.astype(np.float32)
-        dt = FLOAT
-    elif arr.dtype in (np.int64, np.int32):
-        arr = arr.astype(np.int64)
-        dt = INT64
-    else:
-        raise NotImplementedError(f"dtype {arr.dtype} for initializer {name}")
+    arr, dt = _np_onnx_dtype(np.asarray(arr))
     body = b"".join(_pb.f_varint(1, int(d)) for d in arr.shape)
     body += _pb.f_varint(2, dt)
     body += _pb.f_str(8, name)
-    body += _pb.f_bytes(9, np.ascontiguousarray(arr).tobytes())
+    data = np.ascontiguousarray(arr)
+    if dt == 9:  # BOOL raw_data is one byte per element
+        data = data.astype(np.uint8)
+    body += _pb.f_bytes(9, data.tobytes())
     return body
 
 

@@ -164,6 +164,27 @@ class TestDistributedEmbedding:
         # the hook itself never moves
         np.testing.assert_allclose(np.asarray(g["grad_hook"]), 0.0)
 
+    def test_lookup_partitions_under_sharded_jit(self):
+        """The pull callback must be SPMD-partitionable: a lookup on ids
+        sharded over 'data' compiles and each shard pulls its own ids.
+        Regression guard for the round-5 io_callback experiment — an
+        ordered io_callback pull is a side-effecting HLO the partitioner
+        refuses ('side-effect HLO cannot have replicated sharding'),
+        crashing every data-parallel lookup at compile time."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from paddle_tpu.distributed.mesh import build_mesh
+
+        mesh = build_mesh({"data": 8})
+        emb = DistributedEmbedding(4, lr=0.1, init_range=0.1)
+        ids = jnp.asarray(np.arange(16, dtype=np.int32).reshape(16, 1))
+        ids = jax.device_put(ids, NamedSharding(mesh, P("data", None)))
+        out = jax.jit(lambda i: emb._lookup(
+            i, jnp.asarray(0.1), jnp.zeros(())))(ids)
+        assert out.shape == (16, 1, 4)
+        np.testing.assert_allclose(
+            np.asarray(out).reshape(16, 4),
+            emb.table.pull(np.arange(16)), rtol=1e-5)
+
     def test_training_loss_decreases_wide_deep(self):
         """Wide&Deep CTR fixture (reference: dist_fleet_ctr.py model) —
         sparse PS embeddings + dense jax tower trained together."""

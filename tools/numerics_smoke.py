@@ -1,0 +1,179 @@
+"""On-chip numerics smoke: validate the hand-written kernels' arithmetic
+on the LIVE backend against dense jnp references.
+
+Purpose (round-3 verdict item 10 / round-4 item 1b): every Pallas kernel
+is trajectory-tested on the CPU interpreter, but TPU hardware rounds
+differently (bf16 MXU accumulation, pltpu PRNG, revectorized reductions).
+This script runs the hot kernels — flash attention fwd/bwd (causal,
+kv-masked), chunked LM cross-entropy fwd/bwd, bf16 matmul — on whatever
+backend is live and checks errors against fp32 dense references with
+bf16-appropriate tolerances.
+
+Usage: ``python tools/numerics_smoke.py`` — prints one JSON line per
+check plus a final summary line ``{"numerics_ok": bool, ...}``; exit 0
+iff every check passed. On CPU the Pallas kernels run under
+``interpret=True`` (the script is backend-agnostic so the suite smokes
+it without a chip; the point of running it ON the chip is the
+interpret=False path).
+
+Reference intent anchor: the reference validates fused CUDA kernels
+against unfused graphs the same way
+(fluid/operators/fused/multihead_matmul_op.cu + its unittest).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+# runnable from anywhere: the repo root (paddle_tpu's parent) on sys.path
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _ref_attention(q, k, v, causal, kv_lens, sm_scale):
+    import jax.numpy as jnp
+
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * sm_scale
+    b, _, sq, sk = logits.shape
+    mask = jnp.ones((b, 1, sq, sk), bool)
+    if causal:
+        mask &= jnp.tril(jnp.ones((sq, sk), bool))[None, None]
+    if kv_lens is not None:
+        mask &= (jnp.arange(sk)[None, :] < kv_lens[:, None])[:, None, None]
+    logits = jnp.where(mask, logits, -1e30)
+    p = jnp.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, vf)
+
+
+def check_flash_attention(interpret):
+    import math
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    rs = np.random.RandomState(0)
+    b, s, h, d = 2, 256, 4, 64
+    q, k, v = (jnp.asarray(rs.randn(b, s, h, d), jnp.bfloat16)
+               for _ in range(3))
+    sm_scale = 1.0 / math.sqrt(d)
+    results = []
+    for name, kw in (("plain", {}), ("causal", dict(causal=True)),
+                     ("kv_mask", dict(kv_lens=jnp.asarray([s, s // 2],
+                                                          jnp.int32)))):
+        out = flash_attention(q, k, v, interpret=interpret, **kw)
+        ref = _ref_attention(q, k, v, kw.get("causal", False),
+                             kw.get("kv_lens"), sm_scale)
+        err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+        # bf16 mantissa is 8 bits: |v|~O(1) rows give abs err ~1e-2
+        results.append({"check": f"flash_fwd_{name}", "max_abs_err": err,
+                        "tol": 5e-2, "ok": err < 5e-2})
+
+    def f(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       interpret=interpret)
+                       .astype(jnp.float32) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_ref_attention(q, k, v, True, None, sm_scale) ** 2)
+
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    gerr = max(float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                     - b_.astype(jnp.float32))))
+               for a, b_ in zip(g, gr))
+    # backward accumulates over seq: looser than fwd
+    results.append({"check": "flash_bwd_causal", "max_abs_err": gerr,
+                    "tol": 0.5, "ok": gerr < 0.5})
+    return results
+
+
+def check_chunked_ce():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.chunked_ce import chunked_lm_ce
+
+    rs = np.random.RandomState(1)
+    n, h, vocab, chunk = 512, 128, 1024, 256
+    hidden = jnp.asarray(rs.randn(n, h) * 0.1, jnp.bfloat16)
+    weight = jnp.asarray(rs.randn(h, vocab) * 0.1, jnp.bfloat16)
+    labels = jnp.asarray(rs.randint(0, vocab, n), jnp.int32)
+    labels = labels.at[::7].set(-100)  # exercise ignore_index
+
+    def dense(hid, w):
+        logits = (hid.astype(jnp.float32) @ w.astype(jnp.float32))
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gather = jnp.take_along_axis(
+            logits, jnp.maximum(labels, 0)[:, None], axis=1)[:, 0]
+        valid = labels >= 0
+        per = jnp.where(valid, lse - gather, 0.0)
+        return per.sum() / jnp.maximum(valid.sum(), 1)
+
+    loss_c = chunked_lm_ce(hidden, weight, labels, chunk=chunk)
+    loss_d = dense(hidden, weight)
+    lerr = abs(float(loss_c) - float(loss_d))
+    out = [{"check": "chunked_ce_fwd", "max_abs_err": lerr, "tol": 2e-2,
+            "ok": lerr < 2e-2}]
+    gc = jax.grad(lambda a, b: chunked_lm_ce(a, b, labels, chunk=chunk),
+                  argnums=(0, 1))(hidden, weight)
+    gd = jax.grad(dense, argnums=(0, 1))(hidden, weight)
+    gerr = max(float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                     - b.astype(jnp.float32))))
+               for a, b in zip(gc, gd))
+    out.append({"check": "chunked_ce_bwd", "max_abs_err": gerr,
+                "tol": 2e-2, "ok": gerr < 2e-2})
+    return out
+
+
+def check_bf16_matmul():
+    import numpy as np
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(2)
+    a32 = rs.randn(512, 512).astype(np.float32)
+    b32 = rs.randn(512, 512).astype(np.float32)
+    prod = jnp.asarray(a32, jnp.bfloat16) @ jnp.asarray(b32, jnp.bfloat16)
+    ref = np.asarray(a32 @ b32)
+    # MXU accumulates in fp32: error comes from input rounding only —
+    # relative to the row norms (~sqrt(512)*sigma), not the entries
+    rel = float(np.max(np.abs(np.asarray(prod, np.float32) - ref))
+                / np.abs(ref).max())
+    return [{"check": "bf16_matmul", "max_rel_err": rel, "tol": 2e-2,
+             "ok": rel < 2e-2}]
+
+
+def main():
+    import jax
+
+    # the axon TPU plugin ignores the JAX_PLATFORMS env var; only the
+    # config knob reliably forces CPU (same contract as bench.py children)
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    backend = jax.default_backend()
+    interpret = backend != "tpu"
+    checks = []
+    for fn in (lambda: check_flash_attention(interpret), check_chunked_ce,
+               check_bf16_matmul):
+        try:
+            checks.extend(fn())
+        except Exception as e:  # a crash is a failed check, not a crash
+            checks.append({"check": getattr(fn, "__name__", "lambda"),
+                           "ok": False,
+                           "error": f"{type(e).__name__}: {e}"})
+    for c in checks:
+        print(json.dumps(c))
+    ok = all(c.get("ok") for c in checks)
+    print(json.dumps({"numerics_ok": ok, "backend": backend,
+                      "interpret": interpret, "n_checks": len(checks)}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
