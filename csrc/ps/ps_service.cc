@@ -273,7 +273,15 @@ struct Client {
   int fd = -1;
   int dim = 0;
   int feat_dim = 0;
-  std::mutex mu;  // serialize request/response pairs
+  std::mutex mu;  // serialize request/response pairs (blocking API)
+  // pipelined API: sends and recvs lock independently so requests can
+  // be written while earlier responses are still being read — the
+  // server handles one connection's frames sequentially and TCP
+  // preserves order, so replies match requests FIFO (no sequence
+  // numbers needed on an ordered byte stream; the reference brpc
+  // client multiplexes by call id because brpc responds out of order)
+  std::mutex send_mu;
+  std::mutex recv_mu;
 };
 
 }  // namespace
@@ -465,6 +473,73 @@ int ps_client_push(void* h, const int64_t* keys, int64_t n,
       !WriteFull(c->fd, &lr, 4) || !ReadFull(c->fd, &ok, 1))
     return 0;
   return ok ? 1 : 0;
+}
+
+// -- pipelined halves (brpc_ps_client.cc:120-210 async-stub parity) ------
+// A caller keeps several requests in flight on one connection: issue
+// *_send k times, then *_recv k times (FIFO). The blocking calls above
+// take c->mu only, so do NOT interleave blocking and pipelined calls on
+// one connection (the Python layer never does).
+
+int ps_client_pull_send(void* h, const int64_t* keys, int64_t n,
+                        int create_missing) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->send_mu);
+  uint8_t hdr[2] = {kPull, static_cast<uint8_t>(create_missing ? 1 : 0)};
+  if (!WriteFull(c->fd, hdr, 2) || !WriteFull(c->fd, &n, 8) ||
+      !WriteFull(c->fd, keys, sizeof(int64_t) * n))
+    return 0;
+  return 1;
+}
+
+int ps_client_pull_recv(void* h, float* out, int64_t n) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->recv_mu);
+  return ReadFull(c->fd, out, sizeof(float) * n * c->dim) ? 1 : 0;
+}
+
+int ps_client_push_send(void* h, const int64_t* keys, int64_t n,
+                        const float* grads, float lr) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->send_mu);
+  uint8_t hdr[2] = {kPush, 0};
+  if (!WriteFull(c->fd, hdr, 2) || !WriteFull(c->fd, &n, 8) ||
+      !WriteFull(c->fd, keys, sizeof(int64_t) * n) ||
+      !WriteFull(c->fd, grads, sizeof(float) * n * c->dim) ||
+      !WriteFull(c->fd, &lr, 4))
+    return 0;
+  return 1;
+}
+
+int ps_client_push_recv(void* h) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->recv_mu);
+  uint8_t ok = 0;
+  if (!ReadFull(c->fd, &ok, 1)) return 0;
+  return ok ? 1 : 0;
+}
+
+int ps_client_graph_sample_send(void* h, const int64_t* keys, int64_t n,
+                                int k, uint64_t seed, int weighted) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->send_mu);
+  uint8_t hdr[2] = {kGSamp, static_cast<uint8_t>(weighted ? 1 : 0)};
+  int32_t k32 = k;
+  if (!WriteFull(c->fd, hdr, 2) || !WriteFull(c->fd, &n, 8) ||
+      !WriteFull(c->fd, keys, sizeof(int64_t) * n) ||
+      !WriteFull(c->fd, &k32, 4) || !WriteFull(c->fd, &seed, 8))
+    return 0;
+  return 1;
+}
+
+int ps_client_graph_sample_recv(void* h, int64_t n, int k, int64_t* out,
+                                int64_t* counts) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->recv_mu);
+  if (!ReadFull(c->fd, out, sizeof(int64_t) * n * k) ||
+      !ReadFull(c->fd, counts, sizeof(int64_t) * n))
+    return 0;
+  return 1;
 }
 
 int64_t ps_client_size(void* h) {

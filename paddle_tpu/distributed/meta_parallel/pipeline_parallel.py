@@ -275,6 +275,20 @@ class PipelineParallel(Layer):
         (only valid for collective-free stage bodies — engine.py refuses
         switch under a 'sep' mesh)."""
         if self.dispatch == "switch":
+            # the engine's sep guard checks only the no-decomposition
+            # fallback (engine.py:202); an EXPLICIT switch override must
+            # enforce the same collective-safety rule itself
+            from ..mesh import get_mesh
+            mesh = get_mesh()
+            if mesh is not None and (mesh.shape.get("sep", 1) > 1
+                                     or mesh.shape.get("model", 1) > 1):
+                raise ValueError(
+                    "pipeline_configs dispatch='switch' is unsafe on this "
+                    f"mesh (model={mesh.shape.get('model', 1)}, "
+                    f"sep={mesh.shape.get('sep', 1)}): stage bodies issue "
+                    "collectives, and collectives under per-device "
+                    "lax.switch branches deadlock or silently mispair "
+                    "(round-4 finding) — use dispatch='auto'")
             return None
         uniform = self._uniform_fns()
         if uniform is None and self.dispatch == "uniform":

@@ -131,6 +131,23 @@ def lib() -> ctypes.CDLL:
         dll.ps_client_size.restype = i64
         dll.ps_client_size.argtypes = [c.c_void_p]
         dll.ps_client_close.argtypes = [c.c_void_p]
+        # pipelined halves: many requests in flight per connection
+        dll.ps_client_pull_send.restype = c.c_int
+        dll.ps_client_pull_send.argtypes = [c.c_void_p, p_i64, i64, c.c_int]
+        dll.ps_client_pull_recv.restype = c.c_int
+        dll.ps_client_pull_recv.argtypes = [c.c_void_p, p_f32, i64]
+        dll.ps_client_push_send.restype = c.c_int
+        dll.ps_client_push_send.argtypes = [c.c_void_p, p_i64, i64, p_f32,
+                                            f32]
+        dll.ps_client_push_recv.restype = c.c_int
+        dll.ps_client_push_recv.argtypes = [c.c_void_p]
+        dll.ps_client_graph_sample_send.restype = c.c_int
+        dll.ps_client_graph_sample_send.argtypes = [c.c_void_p, p_i64, i64,
+                                                    c.c_int, c.c_uint64,
+                                                    c.c_int]
+        dll.ps_client_graph_sample_recv.restype = c.c_int
+        dll.ps_client_graph_sample_recv.argtypes = [c.c_void_p, i64,
+                                                    c.c_int, p_i64, p_i64]
 
         dll.ps_graph_create.restype = c.c_void_p
         dll.ps_graph_create.argtypes = [c.c_int, c.c_uint64]

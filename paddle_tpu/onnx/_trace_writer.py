@@ -624,7 +624,8 @@ class _Converter:
 
 
 def trace_to_onnx(fn, example_args, path: str, opset_version: int = 13,
-                  input_names=None, dyn_batch: int | None = None) -> str:
+                  input_names=None, dyn_batch: int | None = None,
+                  dynamic_inputs=None) -> str:
     """Trace fn(*example_args) and write an ONNX model. Array-valued
     constants (closed-over parameters) become initializers. With
     ``dyn_batch`` (the sentinel batch the example args carry), leading
@@ -640,16 +641,21 @@ def trace_to_onnx(fn, example_args, path: str, opset_version: int = 13,
     input_names = input_names or [f"input_{i}"
                                   for i in range(len(jaxpr.invars))]
 
-    def _dims(shape):
-        return [None if (dyn_batch is not None and i == 0
+    def _dims(shape, dynamic=True):
+        # `dynamic` gates per input: a spec whose batch dim was STATIC
+        # must keep its literal size even if it coincides with the
+        # sentinel (outputs are always batch-carrying when any input is)
+        return [None if (dynamic and dyn_batch is not None and i == 0
                          and d == dyn_batch) else int(d)
                 for i, d in enumerate(shape)]
 
+    dyn_flags = dynamic_inputs if dynamic_inputs is not None else \
+        [True] * len(jaxpr.invars)
     graph_inputs = []
-    for name, iv in zip(input_names, jaxpr.invars):
+    for name, iv, dyn in zip(input_names, jaxpr.invars, dyn_flags):
         conv.env[iv] = name
         graph_inputs.append(_value_info(
-            name, _dims(iv.aval.shape), _onnx_dt(iv.aval.dtype)))
+            name, _dims(iv.aval.shape, dyn), _onnx_dt(iv.aval.dtype)))
     conv.convert(jaxpr)
     out_infos, out_renames = [], []
     for i, ov in enumerate(jaxpr.outvars):
@@ -705,16 +711,18 @@ def export_traced_layer(layer, path: str, input_spec,
             out, _ = functional_call(layer, params, buffers, *xs)
             return out
 
-        dynamic = any(
-            (lambda sh: len(sh) > 0 and (sh[0] is None or (
-                isinstance(sh[0], int) and sh[0] < 0)))(
-                list(getattr(s, "shape", s)))
-            for s in specs)
-        if dynamic:
+        def _spec_dynamic(s):
+            sh = list(getattr(s, "shape", s))
+            return len(sh) > 0 and (sh[0] is None or (
+                isinstance(sh[0], int) and sh[0] < 0))
+
+        dyn_flags = [_spec_dynamic(s) for s in specs]
+        if any(dyn_flags):
             try:
                 return trace_to_onnx(fn, _args(_DYN_SENTINEL), path,
                                      opset_version=opset_version,
-                                     dyn_batch=_DYN_SENTINEL)
+                                     dyn_batch=_DYN_SENTINEL,
+                                     dynamic_inputs=dyn_flags)
             except NotImplementedError as e:
                 if "dynamic batch" not in str(e):
                     raise

@@ -84,6 +84,15 @@ class _RecBase(nn.Layer):
         folded = np.where(ids < 0, -1, ids + self._np_offsets[None, :])
         return self.ctr_table.prepare(folded)
 
+    def prepare_batch_async(self, ids):
+        """prepare_batch on the hot tier's background worker (returns a
+        Future): overlap batch k+1's host hash-map + PS traffic with the
+        device executing step k (HeterEmbedding.prepare_async)."""
+        assert self.sparse == "heter", "prepare_batch is heter-mode only"
+        ids = np.asarray(ids)
+        folded = np.where(ids < 0, -1, ids + self._np_offsets[None, :])
+        return self.ctr_table.prepare_async(folded)
+
     def attach_trainer(self, trainer):
         """Heter mode: bind the hot tier to a hand-rolled trainer-style
         state holder. ParallelTrainer binds automatically at

@@ -222,6 +222,27 @@ class TestWideDeepHeter:
                 losses.append(float(tr.train_step((ids, dense), y)))
             return losses, m
 
+        def run_overlapped():
+            build_mesh({"data": 1})
+            paddle.seed(0)
+            m = WideDeep(fields, dense_dim=5, embedding_dim=4,
+                         hidden_sizes=(16,), sparse="heter",
+                         heter_capacity=64)
+            opt = paddle.optimizer.Adagrad(
+                0.05, epsilon=1e-8, parameters=m.parameters())
+            tr = ParallelTrainer(m, opt, bce)
+            # double-buffered: prepare(k+1) on the tier's worker thread
+            # while the device executes step k; submit AFTER dispatch
+            # (donated buffers) — same pattern as the bench tool
+            losses = []
+            fut = m.prepare_batch_async(batches[0][0])
+            for i, (ids, dense, y) in enumerate(batches):
+                slots = fut.result()
+                losses.append(float(tr.train_step((slots, dense), y)))
+                if i + 1 < len(batches):
+                    fut = m.prepare_batch_async(batches[i + 1][0])
+            return losses, m
+
         host, _ = run(True)
         het, m = run("heter")
         assert het[-1] < het[0]          # it trains
@@ -231,6 +252,12 @@ class TestWideDeepHeter:
         # random batches repeat ids within a field), so compare loosely.
         np.testing.assert_allclose(host, het, rtol=0.15)
         assert m.ctr_table.stats["evicts"] > 0
+        assert m.ctr_table.stats["prepare_s"] > 0.0  # latency tracked
+        # overlapped (prepare_async) trajectory is EXACTLY the serial
+        # heter trajectory — overlap changes timing, never the math
+        ovl, m2 = run_overlapped()
+        np.testing.assert_allclose(het, ovl, rtol=1e-6)
+        assert m2.ctr_table.stats["evicts"] == m.ctr_table.stats["evicts"]
 
 
 class TestAlltoallLookup:

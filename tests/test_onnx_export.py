@@ -143,6 +143,30 @@ def test_onnx_export_resnet50_via_trace(tmp_path):
     assert any(s.size >= 2 and s[0] == 0 for s in shape_inits)
 
 
+def test_onnx_mixed_static_dynamic_specs(tmp_path):
+    """Only inputs whose spec declared a dynamic leading dim become
+    dim_param — a second input with a STATIC leading size (even one that
+    collides with the trace sentinel) keeps its literal shape."""
+    import jax.numpy as jnp
+
+    class TwoIn(nn.Layer):
+        def forward(self, x, y):
+            return x + jnp.sum(y, axis=0)
+
+    m = TwoIn()
+    m.eval()
+    out = paddle.onnx.export(
+        m, str(tmp_path / "two.onnx"),
+        input_spec=[InputSpec([None, 4], "float32"),
+                    InputSpec([13, 4], "float32")])  # 13 == sentinel
+    assert out.endswith(".onnx")
+    _, graph, _, _ = _decode_model(out)
+    ins, outs = _io_elem_types(graph)
+    assert isinstance(ins[0][2][0], str)   # dynamic batch
+    assert ins[1][2] == [13, 4]            # static spec stays literal
+    assert isinstance(outs[0][2][0], str)
+
+
 def test_onnx_export_dtype_fidelity(tmp_path):
     """Exact-dtype policy (round-4 ADVICE: int32 inputs were silently
     widened to int64): int32 graph inputs stay INT32=6, and int32

@@ -9,12 +9,20 @@ chip. Only a host scalar fetch is a trustworthy sync through the device
 tunnel — see bench.py `_timed_steps`.
 """
 import os
+import sys
 import time
 
 import numpy as np
 
+# runnable from anywhere: repo root (paddle_tpu's parent) on sys.path
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def main():
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:  # the axon plugin ignores the JAX_PLATFORMS env var
+        import jax
+        jax.config.update("jax_platforms", plat)
     import paddle_tpu as paddle
     from paddle_tpu.distributed.engine import ParallelTrainer
     from paddle_tpu.distributed.mesh import build_mesh
@@ -45,7 +53,7 @@ def main():
                         + jnp.log1p(jnp.exp(-jnp.abs(logit))))
 
     results = {}
-    for mode in ("heter", True):
+    for mode, overlap in (("heter", False), ("heter", True), (True, False)):
         paddle.seed(0)
         build_mesh({"data": 1})
         model = WideDeep(fields, dense_dim=13, embedding_dim=16,
@@ -55,28 +63,57 @@ def main():
                                        parameters=model.parameters())
         tr = ParallelTrainer(model, opt, bce)
 
-        def step(ids, dense, y):
-            if mode == "heter":
-                ids = model.prepare_batch(ids)
-            return tr.train_step((ids, dense), y)
+        def run(run_batches):
+            if mode == "heter" and overlap:
+                # double-buffered: prepare(k+1) runs on the tier's
+                # worker thread while the device executes step k — the
+                # reference's heter client/server overlap
+                # (heter_client.cc), TPU-shaped
+                # ORDER MATTERS: submit prepare(k+1) only after
+                # train_step(k) has DISPATCHED (it returns while the
+                # device still computes) — the step donates the old
+                # state buffers, so a prepare submitted before dispatch
+                # can read donated arrays; after dispatch it reads the
+                # step's (async) output arrays, overlapping cleanly
+                fut = model.prepare_batch_async(run_batches[0][0])
+                loss = None
+                for i, (ids, dense, y) in enumerate(run_batches):
+                    slots = fut.result()
+                    loss = tr.train_step((slots, dense), y)
+                    if i + 1 < len(run_batches):
+                        fut = model.prepare_batch_async(
+                            run_batches[i + 1][0])
+                return loss
+            for ids, dense, y in run_batches:
+                if mode == "heter":
+                    ids = model.prepare_batch(ids)
+                loss = tr.train_step((ids, dense), y)
+            return loss
 
-        for ids, dense, y in batches[:warmup]:
-            loss = step(ids, dense, y)
-        float(loss)
+        float(run(batches[:warmup]))
+        if mode == "heter":
+            model.ctr_table.stats["prepare_s"] = 0.0
+            model.ctr_table.stats["tier_exchange_s"] = 0.0
         t0 = time.perf_counter()
-        for ids, dense, y in batches[warmup:]:
-            loss = step(ids, dense, y)
-        float(loss)
+        float(run(batches[warmup:]))
         dt = time.perf_counter() - t0
-        name = "heter_device_tier" if mode == "heter" else "host_ps_tier"
+        name = ("host_ps_tier" if mode is True else
+                "heter_overlapped" if overlap else "heter_device_tier")
         results[name] = batch * steps / dt
         line = f"{name:18s}: {results[name]:12,.1f} samples/sec"
         if mode == "heter":
+            prep = model.ctr_table.stats["prepare_s"]
+            tx = model.ctr_table.stats["tier_exchange_s"]
             line += (f"  (hot hit rate {model.ctr_table.hit_rate:.3f}, "
-                     f"evicts {model.ctr_table.stats['evicts']})")
+                     f"evicts {model.ctr_table.stats['evicts']}, "
+                     f"prepare {prep:.3f}s [{tx:.3f}s tier-exchange] = "
+                     f"{prep / dt:.0%} of wall"
+                     f"{' — overlapped' if overlap else ''})")
         print(line)
     print(f"device/host speedup: "
-          f"{results['heter_device_tier'] / results['host_ps_tier']:.1f}x")
+          f"{results['heter_device_tier'] / results['host_ps_tier']:.1f}x"
+          f" (overlapped: "
+          f"{results['heter_overlapped'] / results['host_ps_tier']:.1f}x)")
 
 
 if __name__ == "__main__":
