@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import contextlib
 import functools
-from collections import OrderedDict
 
 import jax
 
@@ -32,13 +31,20 @@ def backward(layer, forward_closure, retain_graph=False):
     closure reads the layer's current parameters; store grads on ``p.grad``
     (accumulating, like the reference's gradient accumulator).
     """
+    from ..jit.functionalization import _swapped_state
     params, buffers = state_of(layer)
     trainable = {n: p for n, p in layer.named_parameters() if p.trainable}
 
     def pure(train_params):
         merged = dict(params)
         merged.update(train_params)
-        with _swap(layer, merged):
+        # _swapped_state restores params AND buffers on exit: buffers
+        # mutated inside the traced closure (BatchNorm running stats)
+        # would otherwise store TRACERS on the layer, poisoning every
+        # later eager call. The stat updates belong to the EAGER forward
+        # (which the caller runs for the loss value); the grad-trace
+        # re-run's side effects are discarded.
+        with _swapped_state(layer, merged, None):
             loss = forward_closure()
         return loss
 
@@ -46,20 +52,6 @@ def backward(layer, forward_closure, retain_graph=False):
     for n, p in trainable.items():
         g = grads[n]
         p.grad = g if p.grad is None else p.grad + g
-
-
-@contextlib.contextmanager
-def _swap(layer, params):
-    boxes = OrderedDict(layer.named_parameters())
-    saved = {n: b.value for n, b in boxes.items()}
-    try:
-        for n, v in params.items():
-            if n in boxes:
-                boxes[n].value = v
-        yield
-    finally:
-        for n, v in saved.items():
-            boxes[n].value = v
 
 
 def grad(outputs=None, inputs=None, grad_outputs=None, retain_graph=None,

@@ -188,6 +188,25 @@ def _select_branches(cond, true_fn, false_fn, init, names, filename,
     return tuple(res)
 
 
+_UNROLL_CAP = 512
+
+
+def _carry_compatible(a, b):
+    """Can a lax.while_loop carry go from `a` to `b`? Same pytree
+    structure AND same per-leaf shape/dtype."""
+    import jax.tree_util as jtu
+    if jtu.tree_structure(a) != jtu.tree_structure(b):
+        return False
+    for la, lb in zip(jtu.tree_leaves(a), jtu.tree_leaves(b)):
+        sa = jnp.shape(la) if _is_arrayish(la) else None
+        sb = jnp.shape(lb) if _is_arrayish(lb) else None
+        if sa != sb:
+            return False
+        if sa is not None and jnp.result_type(la) != jnp.result_type(lb):
+            return False
+    return True
+
+
 def convert_while(cond_fn, body_fn, init, names, filename="<dy2static>",
                   lineno=0):
     first = cond_fn(*init)
@@ -196,21 +215,67 @@ def convert_while(cond_fn, body_fn, init, names, filename="<dy2static>",
         while cond_fn(*vars_):
             vars_ = tuple(body_fn(*vars_))
         return vars_
-    if any(v is UNDEFINED for v in init):
-        init = _seed_loop_locals(cond_fn, body_fn, init, names, filename,
-                                 lineno)
-    init = tuple(jnp.asarray(v) if isinstance(v, (int, float, bool))
-                 else v for v in init)
-    try:
-        return jax.lax.while_loop(lambda t: cond_fn(*t),
-                                  lambda t: tuple(body_fn(*t)), init)
-    except TypeError as e:
-        if not _is_structure_error(e):
-            raise
-        raise Dy2StaticError(
-            f"{_loc(filename, lineno)}: tensor-dependent `while` body must "
-            f"keep every loop variable {list(names)} at a fixed "
-            f"shape/dtype across iterations: {e}") from e
+    if _is_tracer(first):
+        # tensor-dependent trip count: only the staged form exists
+        if any(v is UNDEFINED for v in init):
+            init = _seed_loop_locals(cond_fn, body_fn, init, names,
+                                     filename, lineno)
+        staged = tuple(jnp.asarray(v) if isinstance(v, (int, float, bool))
+                       else v for v in init)
+        try:
+            return jax.lax.while_loop(lambda t: cond_fn(*t),
+                                      lambda t: tuple(body_fn(*t)), staged)
+        except TypeError as e:
+            if not _is_structure_error(e):
+                raise
+            raise Dy2StaticError(
+                f"{_loc(filename, lineno)}: tensor-dependent `while` body "
+                f"must keep every loop variable {list(names)} at a fixed "
+                f"shape/dtype across iterations: {e}") from e
+    # STATIC condition with traced carries. PEEL the first iteration —
+    # running the body exactly once decides staged-vs-unrolled without a
+    # throwaway trace (an aborted lax.while_loop attempt would already
+    # have executed the body once, replaying its Python-side effects —
+    # RNG counter draws, buffer writes — in whichever path ran next).
+    if not first:
+        return tuple(init)
+    vars_ = tuple(body_fn(*init))
+    if _carry_compatible(vars_, tuple(init)):
+        # structure-stable: stage the REMAINING iterations compactly
+        staged = tuple(jnp.asarray(v) if isinstance(v, (int, float, bool))
+                       else v for v in vars_)
+        try:
+            return jax.lax.while_loop(lambda t: cond_fn(*t),
+                                      lambda t: tuple(body_fn(*t)), staged)
+        except TypeError as e:  # e.g. dtype promotion inside the body
+            if not _is_structure_error(e):
+                raise
+            # fall through to unrolling from vars_ (iteration 1 done)
+    # shape/structure-evolving carries with a static trip count (e.g. a
+    # decoder appending per-step logits — the reference stages these via
+    # TensorArray, test_seq2seq.py): unroll under the trace.
+    n = 1
+    cond = cond_fn(*vars_)
+    while cond:
+        if _is_tracer(cond):
+            raise Dy2StaticError(
+                f"{_loc(filename, lineno)}: `while` condition became "
+                f"tensor-dependent mid-loop while the body mutates "
+                f"loop-variable structure — neither staged nor unrolled "
+                f"form exists")
+        n += 1
+        if n > _UNROLL_CAP:
+            raise Dy2StaticError(
+                f"{_loc(filename, lineno)}: static-trip-count `while` "
+                f"with structure-evolving loop variables exceeded the "
+                f"{_UNROLL_CAP}-iteration unroll cap — the traced graph "
+                f"would contain one copy of the body per iteration. "
+                f"Keep loop variables at fixed shapes (preallocate and "
+                f"index-update instead of appending) so the loop can "
+                f"stage as one lax.while_loop")
+        vars_ = tuple(body_fn(*vars_))
+        cond = cond_fn(*vars_)
+    return vars_
 
 
 def _seed_loop_locals(cond_fn, body_fn, init, names, filename, lineno):

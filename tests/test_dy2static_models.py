@@ -1,0 +1,431 @@
+"""Dy2static FULL-MODEL tier, ported from the reference's
+dygraph_to_static suite (round-5 verdict item 7): the conversion
+acceptance models — BERT, Transformer, seq2seq, ResNet — plus the model
+zoo scenarios around them. Each test names its reference file.
+
+The acceptance contract (reference test_bert.py/test_resnet.py et al.):
+training ONE STEP through the converted model produces the same losses
+and parameters as eager execution. Conversion must actually happen —
+to_static's stage-the-original fallback warning is promoted to an error
+inside ``_convert``.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import autograd
+from paddle_tpu.jit.dy2static import convert_function
+
+RS = np.random.RandomState(7)
+
+
+def _convert(m):
+    """to_static with the conversion-failure fallback made fatal; train
+    through the underlying layer whose forward is now the converted
+    function (TracedLayer snapshots params, which a training loop must
+    not use)."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        tl = paddle.jit.to_static(m)
+    return tl.layer
+
+
+def _train_parity(build, loss_of, steps=2, lr=0.05, rtol=1e-4,
+                  atol=1e-5, opt_of=None):
+    """losses + a parameter after `steps` of eager vs converted."""
+    def run(convert):
+        paddle.seed(0)
+        m = build()
+        if convert:
+            m = _convert(m)
+        opt = (opt_of(m) if opt_of is not None
+               else paddle.optimizer.SGD(lr, parameters=m.parameters()))
+        losses = []
+        for _ in range(steps):
+            opt.clear_grad()
+            # eager forward for the recorded loss (the backward closure
+            # re-runs under the grad trace — its value is a tracer)
+            losses.append(float(loss_of(m)))
+            autograd.backward(m, lambda: loss_of(m))
+            opt.step()
+        p0 = next(iter(m.parameters()))
+        return losses, np.asarray(p0.value, np.float32)
+
+    el, ep = run(False)
+    cl, cp = run(True)
+    np.testing.assert_allclose(el, cl, rtol=rtol, atol=atol)
+    np.testing.assert_allclose(ep, cp, rtol=rtol, atol=atol)
+    assert np.isfinite(el).all()
+
+
+def _ce(logits, labels):
+    logits = logits.reshape(-1, logits.shape[-1])
+    return jnp.mean(nn.functional.cross_entropy(
+        logits, labels.reshape(-1).astype("int64")))
+
+
+# -- test_resnet.py / test_resnet_v2.py --------------------------------------
+class TestResNet:
+    def test_resnet18_forward_parity_under_jit(self):
+        # ref: test_resnet.py ResNet conversion (full zoo model)
+        from paddle_tpu.vision.models import resnet18
+        x = jnp.asarray(RS.randn(2, 3, 32, 32), jnp.float32)
+        paddle.seed(0)
+        e = resnet18()
+        e.eval()
+        paddle.seed(0)
+        c = resnet18()
+        c.eval()
+        c = _convert(c)
+        np.testing.assert_allclose(
+            np.asarray(e(x)), np.asarray(jax.jit(lambda z: c(z))(x)),
+            rtol=1e-4, atol=1e-5)
+
+    def test_resnet18_train_one_step(self):
+        # ref: test_resnet.py train_one_step static == dygraph
+        from paddle_tpu.vision.models import resnet18
+        x = jnp.asarray(RS.randn(2, 3, 32, 32), jnp.float32)
+        y = jnp.asarray(RS.randint(0, 10, (2,)), jnp.int32)
+        _train_parity(
+            lambda: resnet18(num_classes=10),
+            lambda m: _ce(m(x), y), steps=2, lr=0.01,
+            rtol=5e-4, atol=5e-5)
+
+
+# -- test_bert.py ------------------------------------------------------------
+class TestBert:
+    CFG = dict(tensor_parallel=False, vocab_size=128, hidden_size=32,
+               num_layers=2, num_heads=2, max_position_embeddings=16,
+               attn_dropout=0.0, hidden_dropout=0.0)
+
+    def test_bert_pretraining_train_one_step(self):
+        # ref: test_bert.py train_static == train_dygraph (MLM+NSP)
+        from paddle_tpu.text.models import BertForPretraining
+        ids = jnp.asarray(RS.randint(0, 128, (2, 16)), jnp.int32)
+        mlm = np.full((2, 16), -100, "int32")
+        mlm[:, ::4] = RS.randint(0, 128, (2, 4))
+        mlm = jnp.asarray(mlm)
+        nsp = jnp.asarray(RS.randint(0, 2, (2,)), jnp.int32)
+
+        def loss_of(m):
+            mlm_logits, nsp_logits = m(ids)
+            return m.loss(mlm_logits, nsp_logits, mlm, nsp)
+
+        _train_parity(
+            lambda: BertForPretraining(**self.CFG), loss_of,
+            steps=2, lr=1e-3, rtol=5e-4, atol=5e-5,
+            opt_of=lambda m: paddle.optimizer.AdamW(
+                1e-3, parameters=m.parameters()))
+
+
+# -- test_transformer.py -----------------------------------------------------
+class TestTransformer:
+    def test_mt_transformer_train_one_step(self):
+        # ref: test_transformer.py train_static_vs_dygraph
+        from paddle_tpu.text.models import TransformerModel
+        src = jnp.asarray(RS.randint(2, 64, (2, 8)), jnp.int32)
+        trg = jnp.asarray(RS.randint(2, 64, (2, 8)), jnp.int32)
+        lbl = jnp.asarray(RS.randint(2, 64, (2, 8)), jnp.int32)
+
+        def build():
+            return TransformerModel(
+                src_vocab_size=64, trg_vocab_size=64, max_length=16,
+                num_encoder_layers=2, num_decoder_layers=2, n_head=2,
+                d_model=32, d_inner_hid=64, dropout=0.0)
+
+        _train_parity(build, lambda m: _ce(m(src, trg), lbl),
+                      steps=2, lr=0.01, rtol=5e-4, atol=5e-5)
+
+
+# -- test_seq2seq.py (seq2seq_dygraph_model.py BaseModel) --------------------
+class Seq2Seq(nn.Layer):
+    """LSTM encoder-decoder with teacher forcing — the reference
+    BaseModel's shape (seq2seq_dygraph_model.py:66), decoder unrolled
+    with a Python loop the converter stages."""
+
+    def __init__(self, vocab=64, hidden=32):
+        super().__init__()
+        self.src_emb = nn.Embedding(vocab, hidden)
+        self.trg_emb = nn.Embedding(vocab, hidden)
+        self.enc = nn.LSTM(hidden, hidden)
+        self.dec_cell = nn.LSTMCell(hidden, hidden)
+        self.head = nn.Linear(hidden, vocab)
+
+    def forward(self, src, trg):
+        enc_out, (h, c) = self.enc(self.src_emb(src))
+        h, c = h[0], c[0]
+        logits = []
+        for t in range(trg.shape[1]):     # teacher-forced decode loop
+            step_in = self.trg_emb(trg[:, t])
+            _, (h, c) = self.dec_cell(step_in, (h, c))
+            logits.append(self.head(h))
+        return jnp.stack(logits, axis=1)
+
+
+class TestSeq2Seq:
+    def test_seq2seq_train_one_step(self):
+        # ref: test_seq2seq.py train_dygraph == train_static
+        src = jnp.asarray(RS.randint(2, 64, (2, 6)), jnp.int32)
+        trg = jnp.asarray(RS.randint(2, 64, (2, 5)), jnp.int32)
+        lbl = jnp.asarray(RS.randint(2, 64, (2, 5)), jnp.int32)
+        _train_parity(Seq2Seq, lambda m: _ce(m(src, trg), lbl),
+                      steps=2, lr=0.05, rtol=5e-4, atol=5e-5)
+
+
+# -- test_ptb_lm.py / test_ptb_lm_v2.py --------------------------------------
+class TestPtbLm:
+    def test_lstm_lm_train_one_step(self):
+        # ref: test_ptb_lm.py PtbModel train parity
+        class PtbLm(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(64, 32)
+                self.lstm = nn.LSTM(32, 32, num_layers=2)
+                self.head = nn.Linear(32, 64)
+
+            def forward(self, ids):
+                h, _ = self.lstm(self.emb(ids))
+                return self.head(h)
+
+        ids = jnp.asarray(RS.randint(0, 64, (2, 8)), jnp.int32)
+        lbl = jnp.asarray(RS.randint(0, 64, (2, 8)), jnp.int32)
+        _train_parity(PtbLm, lambda m: _ce(m(ids), lbl),
+                      steps=2, lr=0.05, rtol=5e-4, atol=5e-5)
+
+
+# -- test_se_resnet.py -------------------------------------------------------
+class TestSeResNet:
+    def test_se_block_train_one_step(self):
+        # ref: test_se_resnet.py SqueezeExcitation conv block
+        class SeNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(3, 8, 3, padding=1)
+                self.fc1 = nn.Linear(8, 4)
+                self.fc2 = nn.Linear(4, 8)
+                self.head = nn.Linear(8, 5)
+
+            def forward(self, x):
+                f = nn.functional.relu(self.conv(x))
+                s = jnp.mean(f, axis=(2, 3))          # squeeze
+                e = jax.nn.sigmoid(self.fc2(
+                    nn.functional.relu(self.fc1(s))))  # excitation
+                f = f * e[:, :, None, None]
+                return self.head(jnp.mean(f, axis=(2, 3)))
+
+        x = jnp.asarray(RS.randn(2, 3, 8, 8), jnp.float32)
+        y = jnp.asarray(RS.randint(0, 5, (2,)), jnp.int32)
+        _train_parity(SeNet, lambda m: _ce(m(x), y), steps=2)
+
+
+# -- test_mobile_net.py ------------------------------------------------------
+class TestMobileNet:
+    def test_depthwise_separable_train_one_step(self):
+        # ref: test_mobile_net.py depthwise_separable conv stack
+        class DwNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.dw = nn.Conv2D(4, 4, 3, padding=1, groups=4)
+                self.pw = nn.Conv2D(4, 8, 1)
+                self.head = nn.Linear(8, 3)
+
+            def forward(self, x):
+                h = nn.functional.relu(self.dw(x))
+                h = nn.functional.relu(self.pw(h))
+                return self.head(jnp.mean(h, axis=(2, 3)))
+
+        x = jnp.asarray(RS.randn(2, 4, 8, 8), jnp.float32)
+        y = jnp.asarray(RS.randint(0, 3, (2,)), jnp.int32)
+        _train_parity(DwNet, lambda m: _ce(m(x), y), steps=2)
+
+
+# -- test_word2vec.py --------------------------------------------------------
+class TestWord2Vec:
+    def test_skipgram_train_one_step(self):
+        # ref: test_word2vec.py SkipGram (center/context embedding dot)
+        class SkipGram(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.center = nn.Embedding(64, 16)
+                self.context = nn.Embedding(64, 16)
+
+            def forward(self, c, o, neg):
+                ce = self.center(c)
+                pos = jnp.sum(ce * self.context(o), -1)
+                negs = jnp.einsum("bd,bkd->bk", ce, self.context(neg))
+                return pos, negs
+
+        c = jnp.asarray(RS.randint(0, 64, (8,)), jnp.int32)
+        o = jnp.asarray(RS.randint(0, 64, (8,)), jnp.int32)
+        neg = jnp.asarray(RS.randint(0, 64, (8, 3)), jnp.int32)
+
+        def nce(m):
+            pos, negs = m(c, o, neg)
+            return jnp.mean(jax.nn.softplus(-pos)) + \
+                jnp.mean(jax.nn.softplus(negs))
+
+        _train_parity(SkipGram, nce, steps=2, lr=0.1)
+
+
+# -- test_sentiment.py -------------------------------------------------------
+class TestSentiment:
+    def test_cnn_classifier_train_one_step(self):
+        # ref: test_sentiment.py CNN text classifier
+        class TextCnn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(64, 16)
+                self.conv = nn.Conv2D(1, 8, (3, 16))
+                self.head = nn.Linear(8, 2)
+
+            def forward(self, ids):
+                e = self.emb(ids)[:, None]            # (b, 1, L, E)
+                h = nn.functional.relu(self.conv(e))[..., 0]
+                return self.head(jnp.max(h, axis=-1))
+
+        ids = jnp.asarray(RS.randint(0, 64, (4, 12)), jnp.int32)
+        y = jnp.asarray(RS.randint(0, 2, (4,)), jnp.int32)
+        _train_parity(TextCnn, lambda m: _ce(m(ids), y), steps=2)
+
+
+# -- test_simnet.py / test_simnet_v2.py --------------------------------------
+class TestSimNet:
+    def test_two_tower_hinge_train_one_step(self):
+        # ref: test_simnet.py BOW towers + hinge loss on cosine sims
+        class SimNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(64, 16)   # shared tower
+                self.proj = nn.Linear(16, 16)
+
+            def tower(self, ids):
+                v = self.proj(jnp.mean(self.emb(ids), axis=1))
+                return v / (jnp.linalg.norm(v, axis=-1,
+                                            keepdims=True) + 1e-6)
+
+            def forward(self, q, p, n):
+                tq = self.tower(q)
+                return (jnp.sum(tq * self.tower(p), -1),
+                        jnp.sum(tq * self.tower(n), -1))
+
+        q = jnp.asarray(RS.randint(0, 64, (4, 6)), jnp.int32)
+        p = jnp.asarray(RS.randint(0, 64, (4, 6)), jnp.int32)
+        n = jnp.asarray(RS.randint(0, 64, (4, 6)), jnp.int32)
+
+        def hinge(m):
+            sp, sn = m(q, p, n)
+            return jnp.mean(jnp.maximum(0.0, 0.5 - sp + sn))
+
+        _train_parity(SimNet, hinge, steps=2, lr=0.1)
+
+
+# -- test_break_continue.py --------------------------------------------------
+class TestBreakContinue:
+    def test_python_loop_break_continue(self):
+        # ref: test_break_continue.py test_break_in_for_loop
+        def fn(x):
+            total = jnp.zeros(())
+            for i in range(6):
+                if i == 4:
+                    break
+                if i % 2 == 1:
+                    continue
+                total = total + jnp.sum(x) * i
+            return total
+
+        x = jnp.asarray(RS.randn(3).astype("float32"))
+        e = fn(x)
+        c = jax.jit(convert_function(fn))(x)
+        np.testing.assert_allclose(np.asarray(e), np.asarray(c),
+                                   rtol=1e-6)
+
+
+# -- test_declarative.py -----------------------------------------------------
+class TestDeclarative:
+    def test_enable_to_static_toggle(self):
+        # ref: test_declarative.py + program_translator enable flag
+        from paddle_tpu.jit import ProgramTranslator
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+
+            def forward(self, x):
+                out = self.fc(x)
+                if jnp.sum(x) > 1e9:   # converted tensor-dependent if
+                    out = out * 0.0
+                return out
+
+        x = jnp.asarray(RS.randn(2, 4), jnp.float32)
+        paddle.seed(0)
+        m1 = M()
+        out_static = paddle.jit.to_static(m1)(x)
+        try:
+            ProgramTranslator().enable(False)
+            paddle.seed(0)
+            m2 = M()
+            assert paddle.jit.to_static(m2) is m2  # disabled: no wrap
+            np.testing.assert_allclose(np.asarray(out_static),
+                                       np.asarray(m2(x)), rtol=1e-5)
+        finally:
+            ProgramTranslator().enable(True)
+
+
+# -- test_cache_program.py ---------------------------------------------------
+class TestCacheProgram:
+    def test_traced_layer_tracks_param_updates(self):
+        # ref: test_cache_program.py — the cached program must see
+        # parameter UPDATES (cache keys on code, not stale weights)
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        paddle.seed(0)
+        m = M()
+        tl = paddle.jit.to_static(m)
+        x = jnp.asarray(RS.randn(2, 4), jnp.float32)
+        out1 = np.asarray(tl(x))
+        m.fc.weight.value = m.fc.weight.value + 1.0
+        tl.refresh_state()
+        out2 = np.asarray(tl(x))
+        assert not np.allclose(out1, out2)
+        np.testing.assert_allclose(out2, np.asarray(m(x)), rtol=1e-5)
+
+
+# -- test_save_inference_model.py --------------------------------------------
+class TestSaveInferenceModel:
+    def test_converted_model_saves_and_loads(self, tmp_path):
+        # ref: test_save_inference_model.py — to_static -> save -> load
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+
+            def forward(self, x):
+                out = nn.functional.relu(self.fc(x))
+                if jnp.mean(x) > 1e9:      # converted control flow
+                    out = out * 0.0
+                return out
+
+        from paddle_tpu.jit import InputSpec
+        paddle.seed(0)
+        m = M()
+        m.eval()
+        cm = _convert(m)
+        path = str(tmp_path / "conv_model")
+        paddle.jit.save(cm, path, input_spec=[InputSpec([2, 4])])
+        loaded = paddle.jit.load(path)
+        x = jnp.asarray(RS.randn(2, 4), jnp.float32)
+        np.testing.assert_allclose(np.asarray(cm(x)),
+                                   np.asarray(loaded(x)), rtol=1e-5)
