@@ -43,12 +43,16 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 
 # per-config child wall-clock budgets (compile + warmup + timed iters);
 # the sweep configs compile several step variants
-CHILD_TIMEOUT = {"probe": 150, "gpt_base": 1200, "gpt_1p3b": 900}
+CHILD_TIMEOUT = {"probe": 150, "numerics": 300, "gpt_base": 1200,
+                 "gpt_1p3b": 900, "heter_ctr": 600}
 CHILD_TIMEOUT_DEFAULT = 600
 GLOBAL_BUDGET_S = 2700  # stop launching new configs past this
 
-CONFIG_ORDER = ("gpt_base", "resnet50", "bert_base_amp", "widedeep_ctr",
-                "gpt_1p3b")
+# numerics first: the on-chip kernel-vs-dense validation (r3 item 10) is
+# cheap and must not be starved by the budget; heter_ctr last (r3 item
+# 2's 10x A/B — informative, not the headline)
+CONFIG_ORDER = ("numerics", "gpt_base", "resnet50", "bert_base_amp",
+                "widedeep_ctr", "gpt_1p3b", "heter_ctr")
 
 
 # --------------------------------------------------------------------------
@@ -408,9 +412,95 @@ def bench_bert_amp(jax, on_tpu):
             "final_loss": round(final_loss, 4)}
 
 
+def bench_numerics(jax, on_tpu):
+    """On-chip numerics smoke (r3 verdict item 10): flash-attention
+    fwd/bwd, chunked CE, bf16 matmul vs dense fp32 references on the
+    LIVE backend — the tolerances that CPU-interpret testing cannot
+    validate. Reuses tools/numerics_smoke.py's checks in-process."""
+    sys.path.insert(0, os.path.join(_HERE, "tools"))
+    import numerics_smoke as ns
+
+    checks = []
+    interpret = not on_tpu
+    for fn in (lambda: ns.check_flash_attention(interpret),
+               ns.check_chunked_ce, ns.check_bf16_matmul):
+        checks.extend(fn())
+    return {"numerics_ok": all(c.get("ok") for c in checks),
+            "checks": checks}
+
+
+def bench_heter_ctr(jax, on_tpu):
+    """Heter device-tier vs host-PS embedding A/B on the Wide&Deep CTR
+    shape (r3 verdict item 2's 10x target), overlapped prepare mode."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.engine import ParallelTrainer
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.rec import WideDeep
+
+    if on_tpu:
+        fields, batch, steps, warmup = [100_000] * 26, 4096, 12, 4
+        hidden, cap = (400, 400, 400), 1_000_000
+    else:
+        fields, batch, steps, warmup = [1000] * 8, 256, 3, 1
+        hidden, cap = (64, 32), 4096
+    rng = np.random.RandomState(0)
+
+    def draw_ids():
+        u = rng.zipf(1.3, size=(batch, len(fields)))
+        return (u % np.asarray(fields)[None, :]).astype("int64")
+
+    batches = [(draw_ids(), rng.randn(batch, 13).astype("float32"),
+                rng.randint(0, 2, batch).astype("float32"))
+               for _ in range(steps + warmup)]
+
+    def bce(logit, y):
+        return jnp.mean(jnp.maximum(logit, 0) - logit * y
+                        + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    out = {}
+    for mode in ("heter", True):
+        paddle.seed(0)
+        build_mesh({"data": 1})
+        model = WideDeep(fields, dense_dim=13, embedding_dim=16,
+                         hidden_sizes=hidden, sparse=mode,
+                         heter_capacity=cap)
+        opt = paddle.optimizer.Adagrad(0.05, epsilon=1e-8,
+                                       parameters=model.parameters())
+        tr = ParallelTrainer(model, opt, bce)
+
+        def run(bs):
+            if mode != "heter":
+                for ids, dense, y in bs:
+                    loss = tr.train_step((ids, dense), y)
+                return loss
+            fut = model.prepare_batch_async(bs[0][0])
+            for i, (ids, dense, y) in enumerate(bs):
+                slots = fut.result()
+                loss = tr.train_step((slots, dense), y)
+                if i + 1 < len(bs):
+                    fut = model.prepare_batch_async(bs[i + 1][0])
+            return loss
+
+        float(run(batches[:warmup]))
+        t0 = time.perf_counter()
+        float(run(batches[warmup:]))
+        dt = time.perf_counter() - t0
+        name = "heter_overlapped" if mode == "heter" else "host_ps"
+        out[name + "_samples_per_sec"] = round(batch * steps / dt, 1)
+        if mode == "heter":
+            out["hot_hit_rate"] = round(model.ctr_table.hit_rate, 4)
+    out["speedup_x"] = round(out["heter_overlapped_samples_per_sec"]
+                             / out["host_ps_samples_per_sec"], 2)
+    return out
+
+
 CHILD_FNS = {"gpt_base": bench_gpt, "resnet50": bench_resnet50,
              "bert_base_amp": bench_bert_amp, "widedeep_ctr": bench_widedeep,
-             "gpt_1p3b": bench_gpt_1p3b}
+             "gpt_1p3b": bench_gpt_1p3b, "numerics": bench_numerics,
+             "heter_ctr": bench_heter_ctr}
 
 
 def child_main(name: str) -> int:

@@ -215,23 +215,33 @@ def convert_while(cond_fn, body_fn, init, names, filename="<dy2static>",
         while cond_fn(*vars_):
             vars_ = tuple(body_fn(*vars_))
         return vars_
+
+    def _stage_while(start_vars):
+        """lax.while_loop from start_vars → (True, result) or, for a
+        carry-structure mismatch, (False, the TypeError)."""
+        staged = tuple(jnp.asarray(v) if isinstance(v, (int, float, bool))
+                       else v for v in start_vars)
+        try:
+            return True, jax.lax.while_loop(lambda t: cond_fn(*t),
+                                            lambda t: tuple(body_fn(*t)),
+                                            staged)
+        except TypeError as e:
+            if not _is_structure_error(e):
+                raise
+            return False, e
+
     if _is_tracer(first):
         # tensor-dependent trip count: only the staged form exists
         if any(v is UNDEFINED for v in init):
             init = _seed_loop_locals(cond_fn, body_fn, init, names,
                                      filename, lineno)
-        staged = tuple(jnp.asarray(v) if isinstance(v, (int, float, bool))
-                       else v for v in init)
-        try:
-            return jax.lax.while_loop(lambda t: cond_fn(*t),
-                                      lambda t: tuple(body_fn(*t)), staged)
-        except TypeError as e:
-            if not _is_structure_error(e):
-                raise
-            raise Dy2StaticError(
-                f"{_loc(filename, lineno)}: tensor-dependent `while` body "
-                f"must keep every loop variable {list(names)} at a fixed "
-                f"shape/dtype across iterations: {e}") from e
+        ok, res = _stage_while(init)
+        if ok:
+            return res
+        raise Dy2StaticError(
+            f"{_loc(filename, lineno)}: tensor-dependent `while` body "
+            f"must keep every loop variable {list(names)} at a fixed "
+            f"shape/dtype across iterations: {res}") from res
     # STATIC condition with traced carries. PEEL the first iteration —
     # running the body exactly once decides staged-vs-unrolled without a
     # throwaway trace (an aborted lax.while_loop attempt would already
@@ -239,30 +249,47 @@ def convert_while(cond_fn, body_fn, init, names, filename="<dy2static>",
     # RNG counter draws, buffer writes — in whichever path ran next).
     if not first:
         return tuple(init)
-    vars_ = tuple(body_fn(*init))
+    try:
+        vars_ = tuple(body_fn(*init))
+    except Dy2StaticError:
+        raise
+    except Exception as e:
+        undef = [n for n, v in zip(names, init) if v is UNDEFINED]
+        if undef:  # the located diagnostic, not a raw _Undefined TypeError
+            raise Dy2StaticError(
+                f"{_loc(filename, lineno)}: loop variable {undef[0]!r} "
+                "is not defined before this loop and is read before "
+                "assignment in the body") from e
+        raise
     if _carry_compatible(vars_, tuple(init)):
         # structure-stable: stage the REMAINING iterations compactly
-        staged = tuple(jnp.asarray(v) if isinstance(v, (int, float, bool))
-                       else v for v in vars_)
-        try:
-            return jax.lax.while_loop(lambda t: cond_fn(*t),
-                                      lambda t: tuple(body_fn(*t)), staged)
-        except TypeError as e:  # e.g. dtype promotion inside the body
-            if not _is_structure_error(e):
-                raise
-            # fall through to unrolling from vars_ (iteration 1 done)
+        ok, res = _stage_while(vars_)
+        if ok:
+            return res
+        # stable iter0->iter1 but the staged trace failed later (the
+        # structure evolves from iteration 2 on): that aborted trace
+        # already re-ran the body's Python-side effects once, so
+        # unrolling from here would silently diverge from eager — refuse
+        raise Dy2StaticError(
+            f"{_loc(filename, lineno)}: `while` loop variables change "
+            f"structure after the first iteration ({res}); keep them at "
+            f"fixed shapes across ALL iterations (preallocate and "
+            f"index-update instead of appending)") from res
     # shape/structure-evolving carries with a static trip count (e.g. a
     # decoder appending per-step logits — the reference stages these via
     # TensorArray, test_seq2seq.py): unroll under the trace.
     n = 1
     cond = cond_fn(*vars_)
-    while cond:
+    while True:
         if _is_tracer(cond):
+            # checked BEFORE `while cond` would bool()-concretize it
             raise Dy2StaticError(
                 f"{_loc(filename, lineno)}: `while` condition became "
                 f"tensor-dependent mid-loop while the body mutates "
                 f"loop-variable structure — neither staged nor unrolled "
                 f"form exists")
+        if not cond:
+            break
         n += 1
         if n > _UNROLL_CAP:
             raise Dy2StaticError(

@@ -42,11 +42,13 @@ def test_bench_parent_orchestration_all_configs_cpu():
         f"stderr tail: {proc.stderr[-2000:]}")
     assert res["value"] > 0
     assert res["backend"] == "cpu"
-    for name in ("gpt_base", "resnet50", "bert_base_amp", "widedeep_ctr",
-                 "gpt_1p3b"):
+    for name in ("numerics", "gpt_base", "resnet50", "bert_base_amp",
+                 "widedeep_ctr", "gpt_1p3b", "heter_ctr"):
         cfg = res["extra"][name]
         assert "error" not in cfg, f"{name} failed: {cfg}"
         assert not cfg.get("partial"), f"{name} stuck partial: {cfg}"
+    assert res["extra"]["numerics"]["numerics_ok"] is True
+    assert res["extra"]["heter_ctr"]["speedup_x"] > 0
     # the sweep recorded every CPU variant and picked a best
     sweep = res["extra"]["gpt_base"]["sweep"]
     assert set(sweep) == {"fused_b4", "dense_b4"}
