@@ -249,6 +249,11 @@ def convert_while(cond_fn, body_fn, init, names, filename="<dy2static>",
     # RNG counter draws, buffer writes — in whichever path ran next).
     if not first:
         return tuple(init)
+    # snapshot the pre-body structure: the body may mutate carried
+    # containers IN PLACE (acc.append), in which case init aliases the
+    # body's output and a post-hoc comparison would see the list equal
+    # to itself
+    before = _copy_containers(tuple(init))
     try:
         vars_ = tuple(body_fn(*init))
     except Dy2StaticError:
@@ -261,7 +266,7 @@ def convert_while(cond_fn, body_fn, init, names, filename="<dy2static>",
                 "is not defined before this loop and is read before "
                 "assignment in the body") from e
         raise
-    if _carry_compatible(vars_, tuple(init)):
+    if _carry_compatible(vars_, before):
         # structure-stable: stage the REMAINING iterations compactly
         ok, res = _stage_while(vars_)
         if ok:

@@ -346,6 +346,42 @@ class TestBreakContinue:
                                    rtol=1e-6)
 
 
+class TestUnrollDiagnostics:
+    """The static-trip-count unroll path's failure modes are located
+    diagnostics, not raw jax errors (round-5 review findings)."""
+
+    def test_unroll_cap_diagnostic(self):
+        from paddle_tpu.jit.dy2static import Dy2StaticError
+
+        def fn(x):
+            acc = []
+            s = x                     # traced carry -> the peel path
+            i = 0
+            while i < 600:            # > _UNROLL_CAP with growing carry
+                acc.append(s)
+                s = s * 1.01
+                i = i + 1
+            return acc[-1]
+
+        with pytest.raises(Dy2StaticError, match="unroll cap"):
+            jax.jit(convert_function(fn))(jnp.ones(2))
+
+    def test_read_before_assignment_located(self):
+        from paddle_tpu.jit.dy2static import Dy2StaticError
+
+        def fn(x):
+            s = x
+            n = 0
+            while n < 3:
+                acc = acc + [s]       # noqa: F821 — read before assign
+                s = s * 2.0
+                n = n + 1
+            return acc
+
+        with pytest.raises(Dy2StaticError, match="read before"):
+            jax.jit(convert_function(fn))(jnp.ones(2))
+
+
 # -- test_declarative.py -----------------------------------------------------
 class TestDeclarative:
     def test_enable_to_static_toggle(self):

@@ -119,6 +119,51 @@ class TestPipelineTrajectory:
         sep = [float(tr_p.train_step(x, y)) for _ in range(4)]
         np.testing.assert_allclose(dense, sep, rtol=2e-4)
 
+    @pytest.mark.parametrize("pp_degree,M,schedule",
+                             [(2, 1, "1f1b"), (2, 1, "gpipe"),
+                              (2, 2, "1f1b")],
+                             ids=["1f1b-M1", "gpipe-M1", "1f1b-M=S"])
+    def test_packed_schedule_boundary_shapes(self, pp_degree, M, schedule):
+        """Round-5 packed-tick timing at the boundary shapes: a single
+        microbatch (M=1 — fill/drain only, no steady state) and M == S,
+        under both schedules, still track dense."""
+        x, y = _data(batch=8)
+        tr_d, _ = _dense_trainer(_descs(False), data_degree=1)
+        dense = [float(tr_d.train_step(x, y)) for _ in range(3)]
+        tr_p, _ = _pp_trainer(_descs(False), pp_degree=pp_degree,
+                              data_degree=1, micro_batches=M,
+                              schedule=schedule)
+        pp = [float(tr_p.train_step(x, y)) for _ in range(3)]
+        np.testing.assert_allclose(dense, pp, rtol=2e-4)
+
+    def test_dispatch_knob(self):
+        """pipeline_configs dispatch: 'switch' runs on a collective-free
+        pipe-only mesh and matches dense; the same override REFUSES a
+        mesh with model>1 (collectives under per-device branches are the
+        round-4 deadlock)."""
+        x, y = _data(batch=8)
+        tr_d, _ = _dense_trainer(_descs(False), data_degree=1)
+        dense = [float(tr_d.train_step(x, y)) for _ in range(2)]
+        build_mesh({"pipe": 2})
+        paddle.seed(7)
+        pl = PipelineLayer(_descs(False), num_stages=2, seg_method=SEG)
+        topo = CommunicateTopology(("data", "pipe", "sharding", "model"),
+                                   (1, 2, 1, 1))
+        strat = _Strat(2, "1f1b")
+        strat.pipeline_configs["dispatch"] = "switch"
+        pp = PipelineParallel(pl, HybridCommunicateGroup(topo, 0), strat)
+        opt = paddle.optimizer.SGD(0.05, parameters=pp.parameters())
+        tr_p = ParallelTrainer(pp, opt, _loss_fn, micro_batches=2)
+        got = [float(tr_p.train_step(x, y)) for _ in range(2)]
+        np.testing.assert_allclose(dense, got, rtol=2e-4)
+
+        build_mesh({"pipe": 2, "model": 2, "data": 2})
+        paddle.seed(7)
+        pl2 = PipelineLayer(_descs(False), num_stages=2, seg_method=SEG)
+        pp2 = PipelineParallel(pl2, HybridCommunicateGroup(topo, 0), strat)
+        with pytest.raises(ValueError, match="dispatch='switch' is unsafe"):
+            pp2.build_pipeline_grads_fn(_loss_fn, 2)
+
     def test_pp_tp_dp_composition_matches_dense(self):
         """Full hybrid composition: pipe=2 x model=2 x data=2 (8 devices,
         TP layers inside pipe-sharded stages, vocab-sharded loss) tracks
