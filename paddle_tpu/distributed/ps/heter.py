@@ -163,8 +163,28 @@ class HeterEmbedding(Layer):
             self._trainer.set_opt_slot(self._pname, slot_name, v)
 
     # -- tier exchange ------------------------------------------------------
+    @staticmethod
+    def _pad_pow2(slots: np.ndarray, keys: np.ndarray):
+        """Pad an exchange batch to the next power of two by repeating
+        the last (slot, key) pair. Exchange sizes vary every step, and
+        each distinct size compiles a fresh gather/scatter executable —
+        per-step recompiles that dominate the serial prepare() wall time
+        (and cost far more on a real chip). Duplicated trailing entries
+        are idempotent: the same row is read or written twice with the
+        same values."""
+        n = slots.shape[0]
+        if n <= 1:
+            return slots, keys
+        target = 1 << (int(n) - 1).bit_length()
+        if target == n:
+            return slots, keys
+        reps = target - n
+        return (np.concatenate([slots, np.repeat(slots[-1:], reps)]),
+                np.concatenate([keys, np.repeat(keys[-1:], reps)]))
+
     def _flush(self, slots: np.ndarray, keys: np.ndarray):
         """Evicted rows -> PS, carrying optimizer slots when reachable."""
+        slots, keys = self._pad_pow2(slots, keys)
         vals = np.asarray(self._get_values()[slots], np.float32)
         slot_arrays = [self._get_slot(sn) for sn in self._slot_names]
         if all(a is not None for a in slot_arrays):
@@ -184,6 +204,7 @@ class HeterEmbedding(Layer):
         mapped columns get the PS state, anything else resets to zero —
         a promoted key must never inherit the evicted key's accumulator
         or momentum."""
+        slots, keys = self._pad_pow2(slots, keys)
         rows = self.table.export_rows(keys, create_missing=True)
         self._set_values(
             self._get_values().at[slots].set(rows[:, :self.dim]))

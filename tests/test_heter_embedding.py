@@ -180,6 +180,31 @@ class TestShardedHotTier:
         np.testing.assert_allclose(ref, shd, rtol=2e-4)
 
 
+class TestPadPow2:
+    def test_sizes_and_idempotent_roundtrip(self):
+        """Exchange batches pad to the next power of two by repeating
+        the last (slot, key) pair — sizes exact, and a flush/promote
+        round-trip with a non-pow2 batch equals the unpadded rows (the
+        duplicate-write idempotency the padding relies on)."""
+        from paddle_tpu.distributed.ps.heter import HeterEmbedding
+        for n, want in ((1, 1), (2, 2), (3, 4), (5, 8), (8, 8), (9, 16)):
+            s, k = HeterEmbedding._pad_pow2(
+                np.arange(n, dtype=np.int64), np.arange(n, dtype=np.int64))
+            assert s.shape == (want,) == k.shape, (n, s.shape)
+            np.testing.assert_array_equal(s[:n], np.arange(n))
+            np.testing.assert_array_equal(s[n:], np.full(want - n, n - 1))
+        build_mesh({"data": 1})
+        paddle.seed(0)
+        emb = HeterEmbedding(4, capacity=8, optimizer="sgd",
+                             init_range=0.05)
+        keys = np.asarray([3, 7, 11], np.int64)      # non-pow2 batch
+        slots = emb.prepare(keys)                    # promote (padded)
+        vals = np.asarray(emb(jnp.asarray(slots)))
+        emb.flush_all()                              # flush (padded)
+        rows = emb.table.pull(keys)
+        np.testing.assert_allclose(rows, vals, rtol=1e-6)
+
+
 class TestShardCapacityValidation:
     def test_indivisible_capacity_raises_with_named_numbers(self):
         """An indivisible hot-tier capacity must fail with the numbers
