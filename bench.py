@@ -146,12 +146,15 @@ def _make_fused_loss(inner, chunk):
 
 
 def _gpt_variant(jax, on_tpu, batch, seq, vocab, cfg, fused, chunk=8192,
-                 remat=False):
-    """Measure one (batch, loss-path, remat) GPT-base variant.
+                 remat=False, grad_sync=None):
+    """Measure one (batch, loss-path, remat, grad-sync) GPT-base variant.
 
     fused=True routes through GPTForPretraining.fused_head_loss
     (ops/chunked_ce.py) so the (B*S, vocab) logits never materialize;
-    fused=False is the dense-logits + lse-gather CE path."""
+    fused=False is the dense-logits + lse-gather CE path. grad_sync
+    ("int8"/"bf16") compresses the DP gradient exchange
+    (distributed/compressed.py) — over all local devices on TPU, a
+    single-device mesh otherwise (measures the quantize overhead)."""
     import numpy as np
 
     import paddle_tpu as paddle
@@ -161,7 +164,8 @@ def _gpt_variant(jax, on_tpu, batch, seq, vocab, cfg, fused, chunk=8192,
     from paddle_tpu.text.models import GPTForPretraining
 
     paddle.seed(0)
-    build_mesh({"data": 1})
+    ndev = len(jax.devices()) if (on_tpu and grad_sync) else 1
+    build_mesh({"data": ndev})
     model = GPTForPretraining(
         tensor_parallel=False, vocab_size=vocab, hidden_size=cfg["h"],
         num_layers=cfg["l"], num_heads=cfg["n"],
@@ -169,9 +173,11 @@ def _gpt_variant(jax, on_tpu, batch, seq, vocab, cfg, fused, chunk=8192,
     model.bfloat16()
     opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters())
 
+    sync_kw = dict(grad_sync=grad_sync) if grad_sync else {}
     if fused:
         trainer = ParallelTrainer(_make_fused_loss(model, chunk), opt,
-                                  lambda out, _lbl: out, remat=remat)
+                                  lambda out, _lbl: out, remat=remat,
+                                  **sync_kw)
     else:
         trainer = ParallelTrainer(
             model, opt,
@@ -179,7 +185,7 @@ def _gpt_variant(jax, on_tpu, batch, seq, vocab, cfg, fused, chunk=8192,
             # (fp32 accumulation inside; astype here would materialize a
             # full fp32 (b, s, vocab) tensor)
             lambda logits, lbl: nn.functional.cross_entropy(logits, lbl),
-            remat=remat)
+            remat=remat, **sync_kw)
 
     rng = np.random.RandomState(0)
     ids = rng.randint(0, vocab, (batch, seq)).astype("int32")
@@ -218,10 +224,16 @@ def bench_gpt(jax, on_tpu):
                  ("fused_b32", dict(batch=32, fused=True)),
                  ("fused_b32_remat", dict(batch=32, fused=True,
                                           remat=True)),
-                 ("dense_b32", dict(batch=32, fused=False))]
+                 ("dense_b32", dict(batch=32, fused=False)),
+                 # compressed DP grad exchange over all chips (per-chip
+                 # batch 8): same model, 4x fewer gradient bytes on wire
+                 ("fused_b8_int8dp", dict(batch=8, fused=True,
+                                          grad_sync="int8"))]
                 if on_tpu else
                 [("fused_b4", dict(batch=4, fused=True)),
-                 ("dense_b4", dict(batch=4, fused=False))])
+                 ("dense_b4", dict(batch=4, fused=False)),
+                 ("fused_b4_int8dp", dict(batch=4, fused=True,
+                                          grad_sync="int8"))])
     sweep, best, best_name = {}, None, None
     out = None
     for name, kw in variants:

@@ -88,7 +88,13 @@ class CheckpointManager:
                 enable_async_checkpointing=use_async))
 
     def save(self, step: int, state: Any) -> bool:
+        import numpy as np
         import orbax.checkpoint as ocp
+        # numpy scalars (np.int32(3) etc.) are not in orbax's supported
+        # leaf types — promote them to 0-d ndarrays
+        state = jax.tree_util.tree_map(
+            lambda x: np.asarray(x) if isinstance(x, np.generic) else x,
+            state)
         return self._mngr.save(step, args=ocp.args.StandardSave(state))
 
     def restore(self, step: Optional[int] = None,
@@ -104,7 +110,10 @@ class CheckpointManager:
                 if hasattr(x, "shape") else x, template)
             return self._mngr.restore(
                 step, args=ocp.args.StandardRestore(abstract))
-        return self._mngr.restore(step)
+        # installed orbax refuses a bare restore (no registered handler for
+        # the saved "default" item) — an explicit StandardRestore with no
+        # abstract tree restores everything replicated on the host
+        return self._mngr.restore(step, args=ocp.args.StandardRestore())
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()

@@ -109,8 +109,15 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     if op == ReduceOp.MIN:
         return lax.pmin(tensor, ax)
     if op == ReduceOp.PROD:
-        gathered = lax.all_gather(tensor, ax, axis=0, tiled=False)
-        return jnp.prod(gathered, axis=0)
+        # ring multiply: n-1 ppermute hops, O(1) memory — never materializes
+        # the (n, *shape) gathered stack, and stays exact for int dtypes
+        n = lax.axis_size(ax)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        acc, ring = tensor, tensor
+        for _ in range(n - 1):
+            ring = lax.ppermute(ring, ax, perm)
+            acc = acc * ring
+        return acc
     raise ValueError(f"bad op {op}")
 
 
@@ -149,10 +156,11 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     ax = _axis(group)
     if not in_traced_axis(ax):
         return tensor
-    # select src's value on every member: gather then index (XLA folds this
-    # into a collective-broadcast)
-    gathered = lax.all_gather(tensor, ax, axis=0, tiled=False)
-    return gathered[src]
+    # masked psum: only src contributes, everyone receives — one all-reduce
+    # of x's size instead of materializing the (n, *shape) gathered stack
+    mask = lax.axis_index(ax) == src
+    contrib = jnp.where(mask, tensor, jnp.zeros_like(tensor))
+    return lax.psum(contrib, ax)
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -205,11 +213,15 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
+    """Inverse of ``send``: shifts -1 along the ring, so a send/recv pair
+    composes to identity (previously both shifted +1, moving data TWO ranks
+    — rank r's send landed on r+2 after the pair instead of r+1's recv
+    delivering it)."""
     ax = _axis(group)
     if not in_traced_axis(ax):
         return tensor
     n = lax.axis_size(ax)
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    perm = [(i, (i - 1) % n) for i in range(n)]
     return lax.ppermute(tensor, ax, perm)
 
 

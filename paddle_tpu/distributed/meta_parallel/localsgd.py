@@ -29,6 +29,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...framework.random import get_rng_key
 from ...jit.functionalization import functional_call, state_of
+from ..compressed import (DEFAULT_BLOCK, DEFAULT_BUCKET_BYTES,
+                          GRAD_SYNC_POLICIES, compressed_tree_mean)
 from ..mesh import require_mesh
 
 shard_map = jax.shard_map
@@ -38,11 +40,23 @@ class LocalSGDTrainer:
     """Data-parallel trainer with k-step local updates + parameter
     averaging. ``k_steps`` fixed (LocalSGD) or adapted from the loss
     (AdaptiveLocalSGD: k ~ ceil(sqrt(lr0*loss/(lr*loss0) * init_k)),
-    clamped — replicas sync more often as loss/lr fall)."""
+    clamped — replicas sync more often as loss/lr fall).
+
+    ``param_sync`` compresses the periodic parameter exchange
+    (distributed/compressed.py): what crosses the wire is each replica's
+    DELTA from the shared anchor (the last-synced params) — deltas are
+    update-sized, so block-scaled int8 keeps its resolution on them, where
+    quantizing absolute parameter values would drown the local progress in
+    rounding. The int8 policy carries a per-replica error-feedback
+    residual; optimizer moments always average exactly (they are not
+    wire-critical: same bytes, but no compounding)."""
 
     def __init__(self, model, optimizer, loss_fn: Callable, mesh=None,
                  k_steps: int = 1, adaptive: bool = False,
-                 init_k_steps: int = 1, max_k_steps: int = 16):
+                 init_k_steps: int = 1, max_k_steps: int = 16,
+                 param_sync: str = "fp32",
+                 param_sync_block: int = DEFAULT_BLOCK,
+                 param_sync_bucket_bytes: int = DEFAULT_BUCKET_BYTES):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -52,6 +66,12 @@ class LocalSGDTrainer:
         self.adaptive = adaptive
         self.init_k_steps = init_k_steps
         self.max_k_steps = max_k_steps
+        if param_sync not in GRAD_SYNC_POLICIES:
+            raise ValueError(f"param_sync {param_sync!r} not in "
+                             f"{GRAD_SYNC_POLICIES}")
+        self.param_sync = param_sync
+        self.param_sync_block = param_sync_block
+        self.param_sync_bucket_bytes = param_sync_bucket_bytes
         self._loss0 = None
         self._step_no = 0
         self._init_state()
@@ -85,6 +105,19 @@ class LocalSGDTrainer:
             "buffers": buffers,
             "opt": rep_opt,
         }
+        # anchor = the last-synced params, identical on every replica (each
+        # sync ends with all replicas on the same point); replicated
+        # storage. The int8 residual is per-replica. Both empty for the
+        # exact fp32 path.
+        rep_sh = NamedSharding(self.mesh, P())
+        self.state["anchor"] = (OrderedDict(
+            (k, jax.device_put(jnp.asarray(v), rep_sh))
+            for k, v in tparams.items())
+            if self.param_sync != "fp32" else OrderedDict())
+        self.state["sync_err"] = (
+            OrderedDict((k, rep(jnp.zeros(jnp.shape(v), jnp.float32)))
+                        for k, v in tparams.items())
+            if self.param_sync == "int8" else OrderedDict())
 
     def _build(self):
         mesh = self.mesh
@@ -118,8 +151,41 @@ class LocalSGDTrainer:
             out_specs=(P(), pspec),
             check_vma=False)
 
-        def train_step(params, frozen, buffers, opt_state, key, lr,
-                       step_no, k_arr, inputs, labels):
+        sharded_sync = None
+        if self.param_sync != "fp32":
+            err_spec = {k: pspec[k] for k in self.state["sync_err"]}
+
+            def sync_fn(new_p, anchor, sync_err, do_sync):
+                # local views: params (1, *shape); anchor shared (*shape).
+                # Exchange the per-replica DELTA from the anchor — the
+                # compressed mean of deltas IS the mean param minus anchor
+                deltas = {k: v[0] - anchor[k] for k, v in new_p.items()}
+                res = ({k: sync_err[k][0] for k in deltas}
+                       if sync_err else None)
+                mean_d, res = compressed_tree_mean(
+                    deltas, "data", policy=self.param_sync,
+                    block=self.param_sync_block,
+                    bucket_bytes=self.param_sync_bucket_bytes,
+                    residuals=res)
+                synced = {k: anchor[k] + mean_d[k] for k in deltas}
+                out_p = {k: jnp.where(do_sync, synced[k],
+                                      new_p[k][0])[None] for k in new_p}
+                new_anchor = {k: jnp.where(do_sync, synced[k], anchor[k])
+                              for k in anchor}
+                new_err = ({k: jnp.where(do_sync, res[k],
+                                         sync_err[k][0])[None]
+                            for k in sync_err} if sync_err else sync_err)
+                return out_p, new_anchor, new_err
+
+            anchor_spec = {k: P() for k in self.state["anchor"]}
+            sharded_sync = shard_map(
+                sync_fn, mesh=mesh,
+                in_specs=(pspec, anchor_spec, err_spec, P()),
+                out_specs=(pspec, anchor_spec, err_spec),
+                check_vma=False)
+
+        def train_step(params, frozen, buffers, opt_state, anchor,
+                       sync_err, key, lr, step_no, k_arr, inputs, labels):
             loss, grads = sharded_grads(dict(params), dict(frozen),
                                         dict(buffers), key, inputs, labels)
             new_p, new_opt = opt.apply_gradients(dict(params), grads,
@@ -133,11 +199,15 @@ class LocalSGDTrainer:
                                      v.shape)
                 return jnp.where(do_sync, m, v)
 
-            new_p = {k: avg(v) for k, v in new_p.items()}
+            if sharded_sync is not None:
+                new_p, anchor, sync_err = sharded_sync(
+                    dict(new_p), dict(anchor), dict(sync_err), do_sync)
+            else:
+                new_p = {k: avg(v) for k, v in new_p.items()}
             new_opt = dict(new_opt)
             new_opt["slots"] = jax.tree_util.tree_map(
                 avg, new_opt.get("slots", {}))
-            return loss, new_p, new_opt
+            return loss, new_p, new_opt, anchor, sync_err
 
         self._step = jax.jit(train_step, donate_argnums=(0, 3))
 
@@ -147,13 +217,16 @@ class LocalSGDTrainer:
         data_sh = NamedSharding(self.mesh, P(("data",)))
         inputs = jax.device_put(jnp.asarray(inputs), data_sh)
         labels = jax.device_put(jnp.asarray(labels), data_sh)
-        loss, new_p, new_opt = self._step(
+        loss, new_p, new_opt, new_anchor, new_err = self._step(
             self.state["params"], self.state["frozen"],
-            self.state["buffers"], self.state["opt"], get_rng_key(),
+            self.state["buffers"], self.state["opt"],
+            self.state["anchor"], self.state["sync_err"], get_rng_key(),
             lr, jnp.asarray(self._step_no), jnp.asarray(self.k_steps),
             inputs, labels)
         self.state["params"] = new_p
         self.state["opt"] = new_opt
+        self.state["anchor"] = new_anchor
+        self.state["sync_err"] = new_err
         lv = float(loss)
         if self.adaptive:
             # reference localsgd_optimizer.py:425 communicate_avg_loss:

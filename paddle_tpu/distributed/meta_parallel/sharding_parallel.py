@@ -44,13 +44,27 @@ def opt_state_shardings(opt_state, n_shards, axis=SHARDING_AXIS):
 
 
 class ShardingParallel(Layer):
+    """Wraps a model for ZeRO sharding. ``strategy.sharding_configs`` also
+    carries the gradient-exchange policy consumed by the training engine
+    (distributed/compressed.py): ``grad_sync`` ("fp32" | "bf16" | "int8"),
+    ``grad_sync_block`` (quantization block), ``grad_sync_bucket_bytes``
+    (flat-bucket size — the reference Reducer's bucket MB knob)."""
+
     def __init__(self, layers, hcg, strategy=None):
         super().__init__()
         self._layers = layers
         self._hcg = hcg
         self.stage = 1
+        self.grad_sync = "fp32"
+        self.grad_sync_block = 256
+        self.grad_sync_bucket_bytes = 4 << 20
         if strategy is not None:
-            self.stage = int(strategy.sharding_configs.get("stage", 1))
+            cfg = strategy.sharding_configs
+            self.stage = int(cfg.get("stage", 1))
+            self.grad_sync = cfg.get("grad_sync", "fp32")
+            self.grad_sync_block = int(cfg.get("grad_sync_block", 256))
+            self.grad_sync_bucket_bytes = int(
+                cfg.get("grad_sync_bucket_bytes", 4 << 20))
         n = hcg.get_sharding_parallel_world_size()
         if self.stage >= 3:
             # stage 3: parameters themselves sharded

@@ -1,13 +1,22 @@
 """DataParallel wrapper (reference: fluid/dygraph/parallel.py:382
 DataParallel + the C++ Reducer imperative/reducer.cc).
 
-TPU-native design: there is no bucketed-allreduce Reducer — gradients are
-averaged with a single lax.pmean over the "data" mesh axis inside the jitted
-step (XLA fuses and overlaps the collective with backward compute via its
-latency-hiding scheduler, which is what reducer.cc:798 hand-implements).
-``DataParallel`` therefore only 1) marks the module for DP, 2) installs the
-grad-sync hook used by the training engine, and 3) keeps API parity
-(scale_loss, no_sync, state_dict passthrough).
+TPU-native design: gradients are synced inside the jitted step over the
+"data" mesh axis. The exchange goes through
+``distributed/compressed.py`` — the bucketed Reducer analogue: many small
+per-tensor grads coalesce into a few flat dtype-bucketed segments, and the
+``grad_sync`` policy picks the wire format:
+
+  "fp32"  bucketed pmean (exact, the default);
+  "bf16"  grads cross the wire as bf16 (half the bytes — reference
+          fp16_allreduce_optimizer.py);
+  "int8"  EQuARX-style two-phase block-scaled int8 exchange with an
+          error-feedback residual (~4x fewer bytes).
+
+``comm_buffer_size`` (MB) is honored as the bucket size knob — the same
+meaning as the reference Reducer's bucket MB. ``DataParallel`` otherwise
+only marks the module for DP and keeps API parity (scale_loss, no_sync,
+state_dict passthrough).
 """
 from __future__ import annotations
 
@@ -16,17 +25,26 @@ import contextlib
 from jax import lax
 
 from ..nn.layer import Layer
+from .compressed import (DEFAULT_BLOCK, GRAD_SYNC_POLICIES,
+                         compressed_tree_mean, init_residuals)
 
 
 class DataParallel(Layer):
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False,
-                 group=None):
+                 group=None, grad_sync="fp32",
+                 grad_sync_block=DEFAULT_BLOCK):
         super().__init__()
+        if grad_sync not in GRAD_SYNC_POLICIES:
+            raise ValueError(f"grad_sync {grad_sync!r} not in "
+                             f"{GRAD_SYNC_POLICIES}")
         self._layers = layers
         self.axis_name = group.axis_name if group is not None else "data"
         self._grad_sync_enabled = True
         self.find_unused_parameters = find_unused_parameters
+        self.grad_sync = grad_sync
+        self.grad_sync_block = grad_sync_block
+        self.grad_sync_bucket_bytes = int(comm_buffer_size) << 20
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
@@ -43,17 +61,31 @@ class DataParallel(Layer):
         finally:
             self._grad_sync_enabled = True
 
-    def sync_gradients(self, grads: dict) -> dict:
+    def init_grad_residuals(self, grads: dict) -> dict:
+        """Zero error-feedback state for the int8 policy (one fp32 buffer
+        per grad — per-RANK state: carry it through the jitted step like
+        optimizer slots)."""
+        return init_residuals({k: g for k, g in grads.items()
+                               if g is not None})
+
+    def sync_gradients(self, grads: dict, residuals=None):
         """Average grads over the data axis — called by the training engine
-        inside the jitted/shard_mapped step."""
+        inside the jitted/shard_mapped step. With ``residuals`` given (the
+        int8 error-feedback state) returns ``(grads, new_residuals)``;
+        plain ``grads`` otherwise (back-compat)."""
         if not self._grad_sync_enabled:
-            return grads
+            return grads if residuals is None else (grads, residuals)
         try:
             lax.axis_index(self.axis_name)
         except Exception:
-            return grads
-        return {k: None if g is None else lax.pmean(g, self.axis_name)
-                for k, g in grads.items()}
+            return grads if residuals is None else (grads, residuals)
+        live = {k: g for k, g in grads.items() if g is not None}
+        mean, new_res = compressed_tree_mean(
+            live, self.axis_name, policy=self.grad_sync,
+            block=self.grad_sync_block,
+            bucket_bytes=self.grad_sync_bucket_bytes, residuals=residuals)
+        out = {k: mean.get(k) for k in grads}
+        return out if residuals is None else (out, new_res)
 
     # passthrough API parity
     def state_dict(self, *args, **kwargs):

@@ -1,0 +1,36 @@
+"""Version shims for the installed jax.
+
+The codebase targets the current jax surface (``jax.shard_map`` with the
+``check_vma`` kwarg); the pinned runtime here is jax 0.4.37, where shard_map
+still lives in ``jax.experimental.shard_map`` and the replication checker
+kwarg is named ``check_rep``. Installing the alias once at package import
+keeps every call site (and the tests, which call ``jax.shard_map``
+directly) on the one modern spelling.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def _shard_map_compat(f, mesh=None, in_specs=None, out_specs=None,
+                      check_vma=None, check_rep=None, **kw):
+    from jax.experimental.shard_map import shard_map as _sm
+    if check_rep is None:
+        check_rep = True if check_vma is None else bool(check_vma)
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_rep, **kw)
+
+
+def _axis_size_compat(axis_name):
+    # psum of a python scalar folds statically at trace time, so this is
+    # the pre-0.5 spelling of lax.axis_size (tuple axis names included)
+    from jax import lax
+    return lax.psum(1, axis_name)
+
+
+def install():
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_compat
+    from jax import lax
+    if not hasattr(lax, "axis_size"):
+        lax.axis_size = _axis_size_compat

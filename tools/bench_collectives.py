@@ -1,0 +1,137 @@
+"""Collective-exchange micro-benchmark: fp32 vs bf16 vs int8 gradient sync.
+
+Measures the bucketed compressed exchange (distributed/compressed.py) over
+a forced-host-device mesh (or real TPU devices when present) and prints ONE
+JSON line:
+
+    {"metric": "int8_vs_fp32_bytes_x", "value": ..., "unit": "x",
+     "extra": {per-policy: {wire_bytes_per_rank, ms_per_exchange,
+                            buckets, rel_err}}}
+
+Bytes-on-wire come from the analytic ring model in
+``compressed.wire_bytes_per_rank`` (what each rank moves for one mean:
+all-reduce counts 2(n-1)/n payloads, the int8 figure counts both phases
+plus every scale exchange). Latency is wall-clock on whatever backend runs
+— on forced host devices it measures the code path, not ICI; on TPUs it is
+the real exchange time.
+
+Usage:
+    python tools/bench_collectives.py                     # defaults
+    python tools/bench_collectives.py --numel 4194304 --devices 4 \
+        --block 256 --bucket-mb 4 --iters 20
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--numel", type=int, default=1 << 22,
+                    help="total gradient elements (fp32)")
+    ap.add_argument("--devices", type=int, default=4,
+                    help="forced host device count when no accelerator")
+    ap.add_argument("--block", type=int, default=256,
+                    help="int8 quantization block")
+    ap.add_argument("--bucket-mb", type=int, default=4,
+                    help="flat bucket size in MiB")
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=3)
+    args = ap.parse_args()
+
+    if "XLA_FLAGS" not in os.environ:
+        os.environ["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={args.devices}")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.distributed.compressed import (
+        bucket_sizes, compressed_tree_mean, init_residuals,
+        wire_bytes_per_rank)
+    from paddle_tpu.distributed.mesh import build_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = min(args.devices, len(jax.devices()))
+    mesh = build_mesh({"data": n})
+    bucket_bytes = args.bucket_mb << 20
+    align = n * args.block
+    numel = ((args.numel + align - 1) // align) * align
+    nbuckets = len(bucket_sizes(numel, max(bucket_bytes // 4, align), align))
+
+    rng = np.random.RandomState(0)
+    # per-rank distinct gradients, replica-major then sharded over "data"
+    g = rng.randn(n, numel).astype(np.float32)
+    g_dev = jax.device_put(jnp.asarray(g),
+                           NamedSharding(mesh, P("data", None)))
+    exact = g.mean(axis=0)
+
+    extra = {}
+    for policy in ("fp32", "bf16", "int8"):
+        residuals = {"g": jnp.zeros((n, numel), jnp.float32)} \
+            if policy == "int8" else None
+
+        def exchange(x, res):
+            def f(xs, rs):
+                tree = {"g": xs[0]}
+                r = {"g": rs["g"][0]} if rs else None
+                mean, r = compressed_tree_mean(
+                    tree, "data", policy=policy, block=args.block,
+                    bucket_bytes=bucket_bytes, residuals=r)
+                out_r = {"g": r["g"][None]} if rs else {}
+                return mean["g"][None], out_r
+
+            return jax.shard_map(
+                f, mesh=mesh,
+                in_specs=(P("data", None),
+                          {"g": P("data", None)} if res else {}),
+                out_specs=(P("data", None),
+                           {"g": P("data", None)} if res else {}),
+                check_vma=False)(x, res if res else {})
+
+        jfn = jax.jit(exchange)
+        res_in = residuals if residuals is not None else {}
+        out, _ = jfn(g_dev, res_in)
+        for _ in range(args.warmup):
+            out, _ = jfn(g_dev, res_in)
+        np.asarray(out)  # sync
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out, _ = jfn(g_dev, res_in)
+        np.asarray(out)
+        dt = (time.perf_counter() - t0) / args.iters
+
+        got = np.asarray(out)[0]
+        rel = float(np.abs(got - exact).max() /
+                    (np.abs(exact).max() + 1e-12))
+        extra[policy] = {
+            "wire_bytes_per_rank": wire_bytes_per_rank(
+                numel, n, policy, block=args.block),
+            "ms_per_exchange": round(dt * 1e3, 3),
+            "ms_per_bucket": round(dt * 1e3 / nbuckets, 3),
+            "buckets": nbuckets,
+            "rel_err": rel,
+        }
+
+    ratio = (extra["fp32"]["wire_bytes_per_rank"] /
+             max(extra["int8"]["wire_bytes_per_rank"], 1e-9))
+    print(json.dumps({
+        "metric": "int8_vs_fp32_bytes_x",
+        "value": round(ratio, 3),
+        "unit": "x",
+        "vs_baseline": 1.0,
+        "extra": {"numel": numel, "devices": n, "block": args.block,
+                  "bucket_mb": args.bucket_mb, **extra},
+    }))
+
+
+if __name__ == "__main__":
+    main()

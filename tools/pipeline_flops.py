@@ -131,8 +131,9 @@ def _step_flops(trainer, x, y):
     jaxpr = jax.make_jaxpr(
         lambda *a: step(*a))(trainer.state["params"],
                              trainer.state["buffers"],
-                             trainer.state["opt"], get_rng_key(), 0.05,
-                             inputs, labels)
+                             trainer.state["opt"],
+                             trainer.state["comm_err"], get_rng_key(),
+                             0.05, inputs, labels)
     return matmul_flops(jaxpr.jaxpr)
 
 
