@@ -160,12 +160,12 @@ def _gpt_variant(jax, on_tpu, batch, seq, vocab, cfg, fused, chunk=8192,
     import paddle_tpu as paddle
     from paddle_tpu import nn
     from paddle_tpu.distributed.engine import ParallelTrainer
-    from paddle_tpu.distributed.mesh import build_mesh
+    from tools._mesh_setup import data_mesh
     from paddle_tpu.text.models import GPTForPretraining
 
     paddle.seed(0)
     ndev = len(jax.devices()) if (on_tpu and grad_sync) else 1
-    build_mesh({"data": ndev})
+    data_mesh(ndev)
     model = GPTForPretraining(
         tensor_parallel=False, vocab_size=vocab, hidden_size=cfg["h"],
         num_layers=cfg["l"], num_heads=cfg["n"],
@@ -277,7 +277,7 @@ def bench_gpt_1p3b(jax, on_tpu):
 
     import paddle_tpu as paddle
     from paddle_tpu.distributed.engine import ParallelTrainer
-    from paddle_tpu.distributed.mesh import build_mesh
+    from tools._mesh_setup import data_mesh
     from paddle_tpu.text.models import GPTForPretraining
 
     if on_tpu:
@@ -288,7 +288,7 @@ def bench_gpt_1p3b(jax, on_tpu):
         iters, warmup = 2, 1
 
     paddle.seed(0)
-    build_mesh({"data": 1})
+    data_mesh(1)
     model = GPTForPretraining(
         tensor_parallel=False, vocab_size=vocab, hidden_size=h,
         num_layers=layers, num_heads=heads, max_position_embeddings=seq,
@@ -320,11 +320,11 @@ def bench_resnet50(jax, on_tpu):
     import paddle_tpu as paddle
     from paddle_tpu import nn
     from paddle_tpu.distributed.engine import ParallelTrainer
-    from paddle_tpu.distributed.mesh import build_mesh
+    from tools._mesh_setup import data_mesh
     from paddle_tpu.vision.models import resnet50, resnet18
 
     paddle.seed(0)
-    build_mesh({"data": 1})
+    data_mesh(1)
     if on_tpu:
         model, batch, size, iters, warmup = resnet50(), 128, 224, 20, 8
     else:
@@ -352,11 +352,11 @@ def bench_widedeep(jax, on_tpu):
 
     import paddle_tpu as paddle
     from paddle_tpu.distributed.engine import ParallelTrainer
-    from paddle_tpu.distributed.mesh import build_mesh
+    from tools._mesh_setup import data_mesh
     from paddle_tpu.rec import WideDeep
 
     paddle.seed(0)
-    build_mesh({"data": 1})
+    data_mesh(1)
     if on_tpu:
         fields, batch, iters, warmup = [100_000] * 26, 4096, 20, 8
         hidden = (400, 400, 400)
@@ -390,11 +390,11 @@ def bench_bert_amp(jax, on_tpu):
 
     import paddle_tpu as paddle
     from paddle_tpu.distributed.engine import ParallelTrainer
-    from paddle_tpu.distributed.mesh import build_mesh
+    from tools._mesh_setup import data_mesh
     from paddle_tpu.text.models import BertForPretraining
 
     paddle.seed(0)
-    build_mesh({"data": 1})
+    data_mesh(1)
     if on_tpu:
         cfg = dict(vocab_size=30528, hidden_size=768, num_layers=12,
                    num_heads=12, max_position_embeddings=512)
@@ -449,7 +449,7 @@ def bench_heter_ctr(jax, on_tpu):
 
     import paddle_tpu as paddle
     from paddle_tpu.distributed.engine import ParallelTrainer
-    from paddle_tpu.distributed.mesh import build_mesh
+    from tools._mesh_setup import data_mesh
     from paddle_tpu.rec import WideDeep
 
     if on_tpu:
@@ -475,7 +475,7 @@ def bench_heter_ctr(jax, on_tpu):
     out = {}
     for mode in ("heter", True):
         paddle.seed(0)
-        build_mesh({"data": 1})
+        data_mesh(1)
         model = WideDeep(fields, dense_dim=13, embedding_dim=16,
                          hidden_sizes=hidden, sparse=mode,
                          heter_capacity=cap)

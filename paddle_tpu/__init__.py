@@ -47,6 +47,7 @@ from . import distribution  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import monitor  # noqa: F401,E402
 from . import static  # noqa: F401,E402
+from . import analysis  # noqa: F401,E402
 from . import regularizer  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import sysconfig  # noqa: F401,E402

@@ -1,0 +1,60 @@
+"""Jaxpr program analysis: rule-based linting + cost/memory estimation.
+
+The reference platform runs IR passes over every Program before
+execution; paddle_tpu's IR is the jaxpr and this package is that pass
+layer. ``analyze()`` takes a function or an already-traced ClosedJaxpr,
+runs every registered rule (see :mod:`.rules`) and the cost model
+(:mod:`.cost`), and returns a :class:`~paddle_tpu.analysis.report.Report`
+that renders as text or JSON.
+
+Entry points around the repo:
+- ``paddle_tpu.static.Program.analyze()`` — analyze a captured Program.
+- ``ParallelTrainer.compile(..., analyze=True)`` — analyze the exact
+  jitted train step (incl. comm_err / int8 grad-sync plumbing).
+- ``tools/lint_program.py`` — CLI that stages the bench models and
+  fails non-zero on error-severity findings.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from . import cost, report, rules, walker
+from .report import CostRow, CostSummary, Finding, Report
+from .rules import (RULES, AnalysisConfig, RuleContext, register_rule,
+                    run_rules)
+from .walker import count_eqns, walk
+
+__all__ = [
+    "analyze", "analyze_jaxpr", "AnalysisConfig", "Report", "Finding",
+    "CostRow", "CostSummary", "RULES", "register_rule", "run_rules",
+    "RuleContext", "walker", "rules", "cost", "report",
+]
+
+
+def analyze_jaxpr(closed, mesh=None, donated=None,
+                  config: Optional[AnalysisConfig] = None,
+                  rule_ids: Optional[Iterable[str]] = None) -> Report:
+    """Analyze an already-traced ClosedJaxpr."""
+    cfg = config or AnalysisConfig()
+    findings = run_rules(closed, mesh=mesh, donated=donated, config=cfg,
+                         rules=rule_ids)
+    return Report(
+        findings=findings,
+        cost=cost.summarize(closed, k=cfg.top_k,
+                            while_trips=cfg.while_trips),
+        num_eqns=count_eqns(closed))
+
+
+def analyze(target, *args, mesh=None, donated=None,
+            config: Optional[AnalysisConfig] = None,
+            rule_ids: Optional[Iterable[str]] = None, **kwargs) -> Report:
+    """Analyze a ClosedJaxpr, or trace ``target(*args, **kwargs)`` and
+    analyze the result. Tracing uses abstract values only — pass
+    ``jax.ShapeDtypeStruct`` args to analyze huge programs without
+    materializing the data."""
+    closed = target
+    if not hasattr(target, "jaxpr") and callable(target):
+        import jax
+        closed = jax.make_jaxpr(target)(*args, **kwargs)
+    return analyze_jaxpr(closed, mesh=mesh, donated=donated, config=config,
+                         rule_ids=rule_ids)

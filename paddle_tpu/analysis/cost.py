@@ -1,0 +1,271 @@
+"""Per-equation FLOPs / bytes / peak-live-bytes estimation over jaxprs.
+
+The estimates feed two consumers: the "top-k most expensive equations"
+table in analysis reports (where per-site numbers matter) and total-cost
+regressions like tools/pipeline_flops.py (where traversal semantics
+matter: scan bodies bill per trip — XLA's cost_analysis prices a While
+body once, hiding exactly the per-tick redundancy pipeline schedules can
+hide — and cond branches bill at their MAX, the busiest device's bill).
+
+Conventions:
+- dot_general: 2*B*M*N*K multiply-adds; conv_general_dilated the same
+  over the implied patch matmul.
+- elementwise / everything unpriced: one FLOP per output element.
+- bytes: operands + results (HBM traffic lower bound, ignores fusion).
+- peak-live-bytes: linear-scan liveness over each jaxpr, inner jaxprs
+  billed as their own peak at their call point; an estimate of the
+  unfused working set, not an XLA allocator prediction.
+"""
+from __future__ import annotations
+
+from typing import Callable, List
+
+from .report import CostRow, CostSummary
+from .walker import source_summary, subjaxprs, unwrap, walk
+
+__all__ = [
+    "aval_bytes", "eqn_flops", "eqn_bytes", "dot_general_flops",
+    "total_flops", "matmul_flops", "peak_live_bytes", "top_equations",
+    "summarize",
+]
+
+
+def aval_bytes(aval) -> float:
+    """Concrete byte size of an abstract value (0 for tokens etc.)."""
+    dtype = getattr(aval, "dtype", None)
+    size = getattr(aval, "size", None)
+    if dtype is None or size is None:
+        return 0.0
+    return float(size) * getattr(dtype, "itemsize", 4)
+
+
+def _var_bytes(v) -> float:
+    return aval_bytes(getattr(v, "aval", None))
+
+
+def dot_general_flops(eqn) -> float:
+    """2*B*M*N*K for a dot_general from its dimension numbers."""
+    (lc, _rc), (lb, _rb) = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval.shape
+    rhs = eqn.invars[1].aval.shape
+    batch = 1
+    for i in lb:
+        batch *= lhs[i]
+    k = 1
+    for i in lc:
+        k *= lhs[i]
+    m = 1
+    for i, d in enumerate(lhs):
+        if i not in lc and i not in lb:
+            m *= d
+    n = 1
+    rc, rb = set(_rc), set(_rb)
+    for i, d in enumerate(rhs):
+        if i not in rc and i not in rb:
+            n *= d
+    return 2.0 * batch * m * n * k
+
+
+def _conv_flops(eqn) -> float:
+    """2 * out_elements * (kernel_spatial * in_channels / groups)."""
+    out = eqn.outvars[0].aval
+    rhs = eqn.invars[1].aval.shape  # OIHW-ordered by dimension_numbers
+    dn = eqn.params["dimension_numbers"]
+    groups = int(eqn.params.get("feature_group_count", 1))
+    in_ch = rhs[dn.rhs_spec[1]]
+    spatial = 1
+    for i in dn.rhs_spec[2:]:
+        spatial *= rhs[i]
+    return 2.0 * float(out.size) * spatial * in_ch / max(groups, 1)
+
+
+def _out_elems(eqn) -> float:
+    return float(sum(getattr(v.aval, "size", 0) for v in eqn.outvars))
+
+
+def _reduce_flops(eqn) -> float:
+    return float(sum(getattr(v.aval, "size", 0) for v in eqn.invars
+                     if hasattr(v, "aval")))
+
+
+_FLOPS_FNS = {
+    "dot_general": dot_general_flops,
+    "conv_general_dilated": _conv_flops,
+    "reduce_sum": _reduce_flops,
+    "reduce_max": _reduce_flops,
+    "reduce_min": _reduce_flops,
+    "reduce_prod": _reduce_flops,
+    "reduce_and": _reduce_flops,
+    "reduce_or": _reduce_flops,
+    "argmax": _reduce_flops,
+    "argmin": _reduce_flops,
+}
+
+# pure data movement / bookkeeping: zero FLOPs
+_FREE = frozenset({
+    "reshape", "squeeze", "expand_dims", "broadcast_in_dim", "transpose",
+    "convert_element_type", "bitcast_convert_type", "stop_gradient",
+    "copy", "device_put", "slice", "dynamic_slice", "dynamic_update_slice",
+    "concatenate", "pad", "gather", "scatter", "rev", "iota",
+    "split", "select_n",
+})
+
+
+def eqn_flops(eqn) -> float:
+    """FLOPs of one equation body (inner jaxprs NOT included)."""
+    name = eqn.primitive.name
+    fn = _FLOPS_FNS.get(name)
+    if fn is not None:
+        try:
+            return fn(eqn)
+        except Exception:
+            return _out_elems(eqn)
+    if name in _FREE:
+        return 0.0
+    return _out_elems(eqn)
+
+
+def eqn_bytes(eqn) -> float:
+    """Operand + result bytes of one equation (traffic lower bound)."""
+    total = 0.0
+    for v in eqn.invars:
+        total += _var_bytes(v)
+    for v in eqn.outvars:
+        total += _var_bytes(v)
+    return total
+
+
+# -- totals with traversal semantics ----------------------------------------
+
+def _total(jaxpr, cost_fn: Callable, while_trips: float = 1.0) -> float:
+    raw, _ = unwrap(jaxpr)
+    tot = 0.0
+    for eqn in raw.eqns:
+        subs = list(subjaxprs(eqn))
+        if not subs:
+            tot += cost_fn(eqn)
+            continue
+        kind = subs[0].kind
+        if kind == "scan":
+            tot += subs[0].trips * _total(subs[0].jaxpr, cost_fn,
+                                          while_trips)
+        elif kind == "cond":
+            tot += max(_total(s.jaxpr, cost_fn, while_trips)
+                       for s in subs)
+        elif kind == "while":
+            tot += while_trips * sum(_total(s.jaxpr, cost_fn, while_trips)
+                                     for s in subs)
+        else:  # transparent call / shard_map / unknown higher-order
+            tot += sum(_total(s.jaxpr, cost_fn, while_trips) for s in subs)
+    return tot
+
+
+def total_flops(jaxpr, while_trips: float = 1.0) -> float:
+    """All-primitive FLOPs estimate (scan x length, cond max)."""
+    return _total(jaxpr, eqn_flops, while_trips)
+
+
+def matmul_flops(jaxpr, while_trips: float = 1.0) -> float:
+    """dot_general-only FLOPs — the pipeline_flops regression metric."""
+    return _total(
+        jaxpr,
+        lambda e: dot_general_flops(e)
+        if e.primitive.name == "dot_general" else 0.0,
+        while_trips)
+
+
+def total_bytes(jaxpr, while_trips: float = 1.0) -> float:
+    return _total(jaxpr, eqn_bytes, while_trips)
+
+
+# -- peak live bytes ---------------------------------------------------------
+
+def peak_live_bytes(jaxpr) -> float:
+    """Liveness-scan peak working set for one jaxpr (recursive: a call
+    equation contributes its body's peak at its program point)."""
+    raw, _ = unwrap(jaxpr)
+    is_var = lambda a: hasattr(a, "aval") and not hasattr(a, "val")  # noqa: E731
+    last_use = {}
+    for i, eqn in enumerate(raw.eqns):
+        for a in eqn.invars:
+            if is_var(a):
+                last_use[id(a)] = i
+    n = len(raw.eqns)
+    for v in raw.outvars:
+        if is_var(v):
+            last_use[id(v)] = n  # outputs stay live to the end
+    sizes = {}
+    cur = 0.0
+    for v in list(raw.invars) + list(raw.constvars):
+        sizes[id(v)] = _var_bytes(v)
+        cur += sizes[id(v)]
+    peak = cur
+    for i, eqn in enumerate(raw.eqns):
+        inner = 0.0
+        for s in subjaxprs(eqn):
+            inner = max(inner, peak_live_bytes(s.jaxpr))
+        out_b = 0.0
+        for v in eqn.outvars:
+            sizes[id(v)] = _var_bytes(v)
+            out_b += sizes[id(v)]
+        cur += out_b
+        peak = max(peak, cur + inner)
+        # free everything whose last use is this equation (including
+        # outputs never consumed downstream)
+        for a in list(eqn.invars) + list(eqn.outvars):
+            if is_var(a) and last_use.get(id(a), -1) <= i:
+                cur -= sizes.pop(id(a), 0.0)
+                last_use.pop(id(a), None)
+    return peak
+
+
+# -- top-k table -------------------------------------------------------------
+
+def _out_sig(eqn) -> str:
+    sigs = []
+    for v in eqn.outvars:
+        aval = getattr(v, "aval", None)
+        if aval is None or not hasattr(aval, "shape"):
+            continue
+        dt = getattr(aval, "dtype", None)
+        sigs.append(f"{getattr(dt, 'name', dt)}"
+                    f"[{','.join(str(d) for d in aval.shape)}]")
+    return " ".join(sigs)
+
+
+def top_equations(jaxpr, k: int = 10) -> List[CostRow]:
+    """The k most expensive equations by trip-multiplied FLOPs (bytes
+    break ties, so huge data movers surface even at 0 FLOPs)."""
+    rows = []
+    for site in walk(jaxpr):
+        if has_inner_cheap(site.eqn):
+            continue  # call shells: their cost is their inner equations'
+        f = eqn_flops(site.eqn) * site.trips
+        b = eqn_bytes(site.eqn) * site.trips
+        if f <= 0 and b <= 0:
+            continue
+        rows.append(CostRow(
+            primitive=site.primitive, path="/".join(site.path) or "<top>",
+            eqn_index=site.index, flops=f, bytes=b,
+            out=_out_sig(site.eqn), trips=site.trips,
+            source=source_summary(site.eqn)))
+    rows.sort(key=lambda r: (-r.flops, -r.bytes))
+    return rows[:k]
+
+
+def has_inner_cheap(eqn) -> bool:
+    for _ in subjaxprs(eqn):
+        return True
+    return False
+
+
+def summarize(jaxpr, k: int = 10, while_trips: float = 1.0) -> CostSummary:
+    raw, _ = unwrap(jaxpr)
+    arg_bytes = float(sum(_var_bytes(v) for v in raw.invars))
+    return CostSummary(
+        total_flops=total_flops(jaxpr, while_trips),
+        matmul_flops=matmul_flops(jaxpr, while_trips),
+        total_bytes=total_bytes(jaxpr, while_trips),
+        peak_live_bytes=peak_live_bytes(jaxpr),
+        arg_bytes=arg_bytes,
+        top=top_equations(jaxpr, k))

@@ -1,0 +1,403 @@
+"""Pluggable rule registry for the jaxpr analyzer.
+
+A rule is a generator taking a :class:`RuleContext` and yielding
+:class:`~paddle_tpu.analysis.report.Finding`s via ``ctx.finding(...)``
+(rule id and severity are stamped by the runner from the registration).
+Register with::
+
+    @register_rule("my-rule", "warning")
+    def my_rule(ctx):
+        for site in ctx.sites:
+            if looks_wrong(site.eqn):
+                yield ctx.finding(site, "why it is wrong")
+
+Severity contract: "error" findings gate CI (tools/lint_program.py exits
+non-zero); "warning" is a likely perf/correctness hazard the shipped
+models are allowed to carry; "info" is advisory. Built-in rules below
+cover the reference platform's pre-execution pass checklist translated
+to jaxpr-land: dtype-promotion leaks, collective misuse, host
+round-trips, donation misses, recompilation hazards, dead code, and
+oversized gathers.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Callable, Dict, Iterable, List, Optional
+
+from .report import SEVERITIES, Finding
+from .walker import (EqnSite, iter_jaxprs, source_summary, subjaxprs,
+                     unwrap, walk)
+
+__all__ = [
+    "AnalysisConfig", "RuleContext", "Rule", "RULES", "register_rule",
+    "run_rules", "COLLECTIVE_AXIS_PARAMS", "collective_axes",
+]
+
+
+@dataclass(frozen=True)
+class AnalysisConfig:
+    """Thresholds and knobs shared by all rules."""
+    donate_min_bytes: float = 1 << 20      # 1 MiB: smaller args are cheap
+    allgather_warn_bytes: float = 64 << 20  # 64 MiB gathered output
+    while_trips: float = 1.0               # assumed while-loop trip count
+    top_k: int = 10                        # cost-table length
+    check_fp64: bool = True
+    disabled_rules: frozenset = frozenset()
+
+
+class RuleContext:
+    """Everything a rule may inspect about one program.
+
+    sites   — every equation recursively, with path/axes/trips context.
+    closed  — the ClosedJaxpr under analysis (consts available).
+    mesh    — the active device mesh (None = don't check axis membership).
+    donated — flat indices of donated top-level invars, or None when the
+              caller has no donation info (then the top-level pjit
+              equations' own ``donated_invars`` params are consulted).
+    """
+
+    def __init__(self, closed, mesh=None, donated=None,
+                 config: Optional[AnalysisConfig] = None):
+        self.closed = closed
+        self.raw, self.consts = unwrap(closed)
+        self.mesh = mesh
+        self.donated = frozenset(donated) if donated is not None else None
+        self.config = config or AnalysisConfig()
+        # bound_axes starts empty on purpose: only shard_maps inside the
+        # program bind axes; the mesh is checked by the membership rule.
+        self.sites: List[EqnSite] = list(walk(closed))
+
+    def finding(self, site: Optional[EqnSite], message: str) -> Finding:
+        """A Finding pinned to a site (rule/severity filled by runner)."""
+        if site is None:
+            return Finding(rule="", severity="info", message=message)
+        return Finding(
+            rule="", severity="info", message=message,
+            primitive=site.primitive,
+            path="/".join(site.path) or "<top>", eqn_index=site.index,
+            source=source_summary(site.eqn))
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str
+    fn: Callable[[RuleContext], Iterable[Finding]]
+    doc: str = ""
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register_rule(rule_id: str, severity: str):
+    """Decorator adding a rule to the global registry."""
+    if severity not in SEVERITIES:
+        raise ValueError(f"severity must be one of {SEVERITIES}, "
+                         f"got {severity!r}")
+
+    def deco(fn):
+        if rule_id in RULES:
+            raise ValueError(f"duplicate rule id {rule_id!r}")
+        RULES[rule_id] = Rule(rule_id, severity, fn,
+                              (fn.__doc__ or "").strip())
+        return fn
+    return deco
+
+
+def run_rules(closed, mesh=None, donated=None,
+              config: Optional[AnalysisConfig] = None,
+              rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run (a subset of) the registry over one ClosedJaxpr."""
+    cfg = config or AnalysisConfig()
+    ctx = RuleContext(closed, mesh=mesh, donated=donated, config=cfg)
+    out: List[Finding] = []
+    selected = RULES.keys() if rules is None else rules
+    for rid in selected:
+        rule = RULES[rid]
+        if rid in cfg.disabled_rules:
+            continue
+        for f in rule.fn(ctx):
+            out.append(replace(f, rule=rule.id, severity=rule.severity))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# built-in rules
+# ---------------------------------------------------------------------------
+
+COLLECTIVE_AXIS_PARAMS = {
+    # primitive -> params key holding its axis name(s)
+    "psum": "axes", "pmax": "axes", "pmin": "axes",
+    "all_gather": "axis_name", "all_to_all": "axis_name",
+    "ppermute": "axis_name", "pbroadcast": "axis_name",
+    "psum_scatter": "axis_name", "reduce_scatter": "axis_name",
+    "axis_index": "axis_name",
+}
+
+
+def collective_axes(eqn) -> tuple:
+    """The *named* axes a collective equation operates over (positional
+    vmap axes, which appear as ints, are skipped — they are resolved at
+    trace time and cannot be misused here)."""
+    key = COLLECTIVE_AXIS_PARAMS.get(eqn.primitive.name)
+    if key is None:
+        return ()
+    axes = eqn.params.get(key)
+    if axes is None:
+        return ()
+    if isinstance(axes, (str,)):
+        axes = (axes,)
+    return tuple(a for a in axes if isinstance(a, str))
+
+
+_F64 = ("float64", "complex128")
+
+
+@register_rule("fp64-leak", "error")
+def fp64_leak(ctx):
+    """float64/complex128 values in the program: TPUs have no fp64
+    units, so these run emulated (or crash compile) — almost always a
+    jax_enable_x64 leak or a numpy-double const sneaking in."""
+    if not ctx.config.check_fp64:
+        return
+    for site in ctx.sites:
+        bad = [v for v in site.eqn.outvars
+               if getattr(getattr(v, "aval", None), "dtype", None) is not None
+               and v.aval.dtype.name in _F64]
+        if bad:
+            yield ctx.finding(
+                site, f"{site.primitive} produces {bad[0].aval.dtype.name}; "
+                      "TPUs have no fp64 units (check jax_enable_x64 and "
+                      "numpy float64 constants)")
+
+
+@register_rule("amp-fp32-leak", "warning")
+def amp_fp32_leak(ctx):
+    """A matmul executing in fp32 on operands that were explicitly
+    upcast from bf16/fp16 — the silent-promotion pattern that makes an
+    AMP region pay full-precision MXU time anyway."""
+    low = ("bfloat16", "float16")
+    for path, raw in iter_jaxprs(ctx.closed):
+        producer = {}
+        for eqn in raw.eqns:
+            for v in eqn.outvars:
+                producer[id(v)] = eqn
+        for i, eqn in enumerate(raw.eqns):
+            if eqn.primitive.name != "dot_general":
+                continue
+            out_dt = getattr(eqn.outvars[0].aval.dtype, "name", "")
+            if out_dt != "float32":
+                continue
+            for opnd in eqn.invars[:2]:
+                src = producer.get(id(opnd))
+                if (src is not None
+                        and src.primitive.name == "convert_element_type"
+                        and getattr(src.invars[0], "aval", None) is not None
+                        and src.invars[0].aval.dtype.name in low
+                        and opnd.aval.dtype.name == "float32"):
+                    site = EqnSite(eqn, path, i, frozenset(), 1.0,
+                                   False, False)
+                    yield ctx.finding(
+                        site,
+                        f"fp32 matmul on operand upcast from "
+                        f"{src.invars[0].aval.dtype.name}: the AMP region "
+                        "pays full-precision MXU time (keep the matmul in "
+                        "bf16 and upcast the result instead)")
+                    break
+
+
+@register_rule("collective-unbound-axis", "error")
+def collective_unbound_axis(ctx):
+    """A collective over an axis name no enclosing shard_map binds.
+    Under jit this NameErrors at trace time, but programs built with
+    axis_env tracing or vmap without axis_name reach here with the axis
+    dangling — at run time the collective is a no-op or a crash."""
+    for site in ctx.sites:
+        for ax in collective_axes(site.eqn):
+            if ax not in site.bound_axes:
+                yield ctx.finding(
+                    site, f"{site.primitive} over axis {ax!r} which no "
+                          "enclosing shard_map binds (psum under vmap needs "
+                          "axis_name; collectives need to run inside "
+                          "shard_map over that axis)")
+
+
+@register_rule("collective-axis-not-in-mesh", "error")
+def collective_axis_not_in_mesh(ctx):
+    """A collective over an axis that IS bound by a shard_map but does
+    not exist in the active device mesh — the program was written for a
+    different mesh layout than the one it will run on."""
+    if ctx.mesh is None:
+        return
+    mesh_axes = set(getattr(ctx.mesh, "axis_names", ()))
+    for site in ctx.sites:
+        for ax in collective_axes(site.eqn):
+            if ax in site.bound_axes and ax not in mesh_axes:
+                yield ctx.finding(
+                    site, f"{site.primitive} over axis {ax!r} which is not "
+                          f"in the active mesh (axes: "
+                          f"{sorted(mesh_axes)})")
+
+
+@register_rule("ppermute-non-permutation", "error")
+def ppermute_non_permutation(ctx):
+    """ppermute whose (src, dst) pairs are not a partial permutation —
+    a duplicated source sends twice (one wins arbitrarily) and a
+    duplicated destination receives garbage; jax traces it silently."""
+    for site in ctx.sites:
+        if site.primitive != "ppermute":
+            continue
+        perm = site.eqn.params.get("perm") or ()
+        srcs = [p[0] for p in perm]
+        dsts = [p[1] for p in perm]
+        if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+            yield ctx.finding(
+                site, f"ppermute perm {list(perm)!r} is not a permutation "
+                      "(duplicate source or destination device)")
+
+
+_HOST_CALLBACKS = frozenset({
+    "pure_callback", "debug_callback", "io_callback", "callback",
+    "host_callback", "outside_call",
+})
+
+
+@register_rule("host-callback", "warning")
+def host_callback(ctx):
+    """A host round-trip (pure_callback/debug_callback/io_callback)
+    inside the program: on TPU this stalls the device every step —
+    worse inside a scan/while body where it fires per trip."""
+    for site in ctx.sites:
+        if site.primitive in _HOST_CALLBACKS:
+            where = " inside a loop body" if site.in_loop else ""
+            yield ctx.finding(
+                site, f"{site.primitive} forces a host round-trip on the "
+                      f"hot path{where}; move it out of the jitted step or "
+                      "behind a debug flag")
+
+
+def _aval_nbytes(v) -> float:
+    aval = getattr(v, "aval", None)
+    dtype = getattr(aval, "dtype", None)
+    size = getattr(aval, "size", None)
+    if dtype is None or size is None:
+        return 0.0
+    return float(size) * getattr(dtype, "itemsize", 4)
+
+
+def _human_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0:
+            return f"{n:.4g}{unit}"
+        n /= 1024.0
+    return f"{n:.4g}TiB"
+
+
+@register_rule("non-donated-large-arg", "warning")
+def non_donated_large_arg(ctx):
+    """A large input buffer the jitted step does not donate: XLA must
+    keep the old value live across the step, doubling its HBM footprint
+    — the classic forgotten ``donate_argnums`` on params/opt state."""
+    thresh = ctx.config.donate_min_bytes
+    if ctx.donated is not None:
+        # the caller (e.g. ParallelTrainer.compile) told us exactly
+        # which flat invars it donates — authoritative, skip pjit scan
+        for i, v in enumerate(ctx.raw.invars):
+            nb = _aval_nbytes(v)
+            if i not in ctx.donated and nb >= thresh:
+                yield ctx.finding(
+                    None, f"input #{i} ({_human_bytes(nb)}) is not donated; "
+                          "donating it lets XLA reuse the buffer in-place "
+                          "(donate_argnums)")
+        return
+    # otherwise: inspect top-level jit/pjit equations' own donation masks
+    for site in ctx.sites:
+        if site.path != () or site.primitive not in ("pjit", "jit",
+                                                     "xla_call"):
+            continue
+        donated = site.eqn.params.get("donated_invars")
+        if donated is None:
+            continue
+        for i, (v, d) in enumerate(zip(site.eqn.invars, donated)):
+            nb = _aval_nbytes(v)
+            if not d and nb >= thresh:
+                yield ctx.finding(
+                    site, f"jitted call input #{i} ({_human_bytes(nb)}) is "
+                          "not donated; donating it lets XLA reuse the "
+                          "buffer in-place (donate_argnums)")
+
+
+@register_rule("recompile-scalar-const", "info")
+def recompile_scalar_const(ctx):
+    """0-d constants baked into the trace: if the Python value changes
+    (a float hyper-parameter, a step count), jit retraces and recompiles
+    the whole program — pass it as an argument instead."""
+    for cv, val in zip(ctx.raw.constvars, ctx.consts):
+        aval = getattr(cv, "aval", None)
+        if aval is not None and getattr(aval, "shape", None) == ():
+            dt = getattr(getattr(aval, "dtype", None), "name", "?")
+            yield ctx.finding(
+                None, f"0-d {dt} constant ({val!r}) baked into the trace; "
+                      "changing its Python value forces a recompile — pass "
+                      "it as an argument")
+
+
+@register_rule("dead-equation", "info")
+def dead_equation(ctx):
+    """Equations whose outputs nothing consumes (and which have no side
+    effects): wasted compute the user probably thinks is contributing —
+    XLA DCEs them, so they also signal a tracing bug (e.g. a metric that
+    never made it to the outputs)."""
+    for path, raw in iter_jaxprs(ctx.closed):
+        live = {id(v) for v in raw.outvars}
+        dead_idx = []
+        for i in range(len(raw.eqns) - 1, -1, -1):
+            eqn = raw.eqns[i]
+            if getattr(eqn, "effects", None):
+                used = True  # effectful: never dead
+            else:
+                used = any(id(v) in live for v in eqn.outvars)
+            if not used and not any(True for _ in subjaxprs(eqn)):
+                dead_idx.append(i)
+                continue  # its inputs don't become live
+            for a in eqn.invars:
+                if hasattr(a, "aval") and not hasattr(a, "val"):
+                    live.add(id(a))
+        # one finding per (scope, source line), not per equation: a dead
+        # value usually drags a whole chain of producers with it and 30
+        # findings for one forgotten expression is noise
+        groups: dict = {}
+        for i in reversed(dead_idx):
+            site = EqnSite(raw.eqns[i], path, i, frozenset(), 1.0,
+                           False, False)
+            key = source_summary(raw.eqns[i])
+            groups.setdefault(key, []).append(site)
+        for src, sites in groups.items():
+            first = sites[0]
+            extra = f" (+{len(sites) - 1} more in its dead chain)" \
+                if len(sites) > 1 else ""
+            yield ctx.finding(
+                first,
+                f"{first.primitive} output is never used (no "
+                f"effects){extra}; dead compute or a value that was "
+                "meant to be returned")
+
+
+@register_rule("oversized-allgather", "warning")
+def oversized_allgather(ctx):
+    """An all_gather whose replicated output exceeds the warning
+    threshold: every device materializes the full gathered tensor, the
+    usual way model-parallel programs quietly re-densify their memory
+    footprint."""
+    thresh = ctx.config.allgather_warn_bytes
+    for site in ctx.sites:
+        if site.primitive != "all_gather":
+            continue
+        out_b = sum(_aval_nbytes(v) for v in site.eqn.outvars)
+        if out_b >= thresh:
+            yield ctx.finding(
+                site, f"all_gather materializes {_human_bytes(out_b)} on "
+                      "every device (threshold "
+                      f"{_human_bytes(thresh)}); consider keeping the "
+                      "tensor sharded (psum_scatter / rechunk the "
+                      "computation)")

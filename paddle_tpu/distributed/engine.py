@@ -47,6 +47,13 @@ def _spec_has_axis(spec, axis: str) -> bool:
                for ax in spec)
 
 
+def _rank(x) -> int:
+    """Array rank; works for arrays, scalars AND ShapeDtypeStructs (which
+    jnp.shape rejects) so staging can run on abstract batches."""
+    shape = getattr(x, "shape", None)
+    return len(shape) if shape is not None else len(jnp.shape(x))
+
+
 class ParallelTrainer:
     """Builds and runs the sharded jitted train step.
 
@@ -516,12 +523,71 @@ class ParallelTrainer:
 
     def _leaf_spec(self, x):
         """Per-leaf data PartitionSpec (see make_step docstring)."""
-        r = len(jnp.shape(x))
+        r = _rank(x)
         if r == 0:
             return P()
         if self._sep and r >= 2:
             return P(DATA_AXES, "sep")
         return P(DATA_AXES)
+
+    def _stage(self, inputs, labels, place: bool = True):
+        """Normalize a batch and get its jitted step from the cache
+        (tracing it on first use). ``place=False`` skips device_put so
+        ShapeDtypeStruct batches can stage without materializing data.
+        Returns (inputs, labels, step)."""
+        conv = lambda x: x if isinstance(x, jax.ShapeDtypeStruct) \
+            else jnp.asarray(x)  # noqa: E731
+        inputs = jax.tree_util.tree_map(conv, inputs)
+        labels = jax.tree_util.tree_map(conv, labels)
+        in_specs = jax.tree_util.tree_map(self._leaf_spec, inputs)
+        lb_specs = jax.tree_util.tree_map(self._leaf_spec, labels)
+        if place:
+            inputs = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(
+                    x, NamedSharding(self.mesh, s)), inputs, in_specs)
+            labels = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(
+                    x, NamedSharding(self.mesh, s)), labels, lb_specs)
+        cache_key = (jax.tree_util.tree_structure((inputs, labels)),
+                     tuple(_rank(l) for l in jax.tree_util.tree_leaves(
+                         (inputs, labels))))
+        step = self._step_cache.get(cache_key)
+        if step is None:
+            step = self._make_step(in_specs, lb_specs)
+            self._step_cache[cache_key] = step
+        return inputs, labels, step
+
+    # -- staging / analysis -------------------------------------------------
+    def compile(self, inputs, labels, lr: Optional[float] = None,
+                analyze: bool = False, config=None):
+        """Stage the jitted train step for this batch shape without
+        running it. Returns the step function; with ``analyze=True``
+        returns ``(step, Report)`` where the Report comes from tracing
+        the EXACT staged step — donation mask, comm_err / compressed
+        grad-sync plumbing and all — through paddle_tpu.analysis.
+
+        ``inputs``/``labels`` may be real arrays or ShapeDtypeStructs
+        (nothing is materialized or executed either way)."""
+        inputs, labels, step = self._stage(inputs, labels, place=False)
+        if not analyze:
+            return step
+        from .. import analysis
+        from ..framework.random import get_rng_key
+        lr = self.optimizer.get_lr() if lr is None else lr
+        args = (self.state["params"], self.state["buffers"],
+                self.state["opt"], self.state["comm_err"], get_rng_key(),
+                lr, inputs, labels)
+        closed = jax.make_jaxpr(lambda *a: step(*a))(*args)
+        # flat invar indices of jit's donate_argnums=(0, 2, 3)
+        donated, off = set(), 0
+        for i, a in enumerate(args):
+            n = len(jax.tree_util.tree_leaves(a))
+            if i in (0, 2, 3):
+                donated.update(range(off, off + n))
+            off += n
+        report = analysis.analyze_jaxpr(closed, mesh=self.mesh,
+                                        donated=donated, config=config)
+        return step, report
 
     # -- run ----------------------------------------------------------------
     def train_step(self, inputs, labels, lr: Optional[float] = None):
@@ -536,24 +602,7 @@ class ParallelTrainer:
                 f"batch size {batch0} is not divisible by "
                 f"accumulate_steps={self.accumulate_steps}")
         # inputs/labels may be arbitrary pytrees (e.g. (mlm, nsp) labels)
-        inputs = jax.tree_util.tree_map(lambda x: jnp.asarray(x), inputs)
-        labels = jax.tree_util.tree_map(lambda x: jnp.asarray(x), labels)
-        in_specs = jax.tree_util.tree_map(self._leaf_spec, inputs)
-        lb_specs = jax.tree_util.tree_map(self._leaf_spec, labels)
-        inputs = jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(
-                x, NamedSharding(self.mesh, s)), inputs, in_specs)
-        labels = jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(
-                x, NamedSharding(self.mesh, s)), labels, lb_specs)
-        cache_key = (jax.tree_util.tree_structure((inputs, labels)),
-                     tuple(len(jnp.shape(l))
-                           for l in jax.tree_util.tree_leaves(
-                               (inputs, labels))))
-        step = self._step_cache.get(cache_key)
-        if step is None:
-            step = self._make_step(in_specs, lb_specs)
-            self._step_cache[cache_key] = step
+        inputs, labels, step = self._stage(inputs, labels)
         loss, new_params, new_opt, new_comm_err = step(
             self.state["params"], self.state["buffers"], self.state["opt"],
             self.state["comm_err"], key, lr, inputs, labels)

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from . import _pb
+from ..analysis import walker as _walker
 from ._writer import (_GraphBuilder, _model, _node, _tensor,  # noqa: F401
                       _value_info, FLOAT, INT64)
 
@@ -166,9 +167,7 @@ class _Converter:
     def _try_fold(self, eq) -> bool:
         if not all(self.is_const(a) for a in eq.invars):
             return False
-        if eq.primitive.name in ("jit", "pjit", "custom_jvp_call",
-                                 "custom_vjp_call", "remat",
-                                 "checkpoint", "custom_vjp_call_jaxpr"):
+        if _walker.inline_target(eq) is not None:
             return False  # recurse instead; folding inner calls is rarer
         try:
             vals = [jnp.asarray(self.val_of(a)) for a in eq.invars]
@@ -185,13 +184,11 @@ class _Converter:
 
     def eqn(self, eq):
         prim = eq.primitive.name
-        if prim in ("jit", "pjit", "closed_call", "remat", "checkpoint"):
-            inner = eq.params.get("jaxpr") or eq.params.get("call_jaxpr")
-            return self._inline(eq, inner)
-        if prim in ("custom_jvp_call", "custom_vjp_call",
-                    "custom_vjp_call_jaxpr", "custom_jvp_call_jaxpr"):
-            inner = (eq.params.get("call_jaxpr")
-                     or eq.params.get("fun_jaxpr"))
+        # the shared walker knows every call-like primitive's inner-jaxpr
+        # layout (incl. remat2, this jax's spelling of checkpoint, which
+        # the old hand-rolled dispatch missed)
+        inner = _walker.inline_target(eq)
+        if inner is not None:
             return self._inline(eq, inner)
         if prim == "stop_gradient":
             self._alias(eq)
@@ -200,6 +197,10 @@ class _Converter:
             return
         fn = getattr(self, f"p_{prim}", None)
         if fn is None:
+            if _walker.has_inner(eq):
+                raise NotImplementedError(
+                    f"higher-order primitive {prim!r} (control flow / "
+                    "shard_map) is not supported by the ONNX exporter")
             raise NotImplementedError(
                 f"primitive {prim!r} has no ONNX mapping")
         fn(eq)

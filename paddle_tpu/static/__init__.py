@@ -103,12 +103,37 @@ class Program:
         return list(self._fetch_names)
 
     def num_ops(self) -> int:
-        return 0 if self._jaxpr is None else len(self._jaxpr.jaxpr.eqns)
+        """Equation count, recursive through inner jaxprs (pjit/scan/
+        cond/... bodies) — the ProgramDesc op count, not just block 0."""
+        if self._jaxpr is None:
+            return 0
+        from ..analysis.walker import count_eqns
+        return count_eqns(self._jaxpr)
+
+    def analyze(self, mesh=None, config=None):
+        """Run the jaxpr analyzer (paddle_tpu.analysis) over this
+        Program: rule findings + cost/memory estimate as a Report."""
+        if self._jaxpr is None:
+            from ..analysis import Report
+            return Report()
+        from ..analysis import analyze_jaxpr
+        return analyze_jaxpr(self._jaxpr, mesh=mesh, config=config)
 
     def to_string(self, throw_on_error=True, with_details=False) -> str:
         return "<empty Program>" if self._jaxpr is None else str(self._jaxpr)
 
     __str__ = to_string
+
+    def __repr__(self) -> str:
+        if self._jaxpr is None:
+            return "<Program: empty>"
+        try:
+            summary = self.analyze().summary()
+        except Exception:
+            summary = "analysis unavailable"
+        return (f"<Program: {len(self._specs)} feeds, "
+                f"{len(self._fetch_names)} fetches, {self.num_ops()} ops; "
+                f"{summary}>")
 
     def clone(self, for_test: bool = False) -> "Program":
         import copy

@@ -95,6 +95,31 @@ def test_onnx_export_residual_via_trace(tmp_path):
                for b in blobs)
 
 
+def test_onnx_export_checkpointed_layer_via_trace(tmp_path):
+    """A jax.checkpoint'd forward traces to the 'remat2' primitive on
+    this jax; the converter must inline it like any call (it used to
+    know only the 'remat'/'checkpoint' spellings and died with a
+    misleading 'no ONNX mapping' error)."""
+    import jax
+
+    class Remat(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return jax.checkpoint(lambda v: self.fc(v) + v)(x)
+
+    m = Remat()
+    m.eval()
+    out = paddle.onnx.export(m, str(tmp_path / "remat.onnx"),
+                             input_spec=[InputSpec([2, 4], "float32")])
+    assert out.endswith(".onnx")
+    _, _, nodes, _ = _decode_model(out)
+    ops = _op_types(nodes)
+    assert "MatMul" in ops and "Add" in ops
+
+
 def _io_elem_types(graph):
     """[(name, elem_type, dims)] for graph inputs (field 11) / outputs
     (12); dims entries are ints or the dim_param string."""

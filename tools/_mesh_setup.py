@@ -1,0 +1,47 @@
+"""Shared setup for the repo's CLI tools (bench.py, bench_collectives,
+lint_program): repo-root path handling, forced-host-device env, and the
+plain data mesh every tool was rebuilding by hand.
+
+Import order matters: ``force_host_devices`` touches XLA_FLAGS /
+JAX_PLATFORMS and must run BEFORE the first ``import jax`` anywhere in
+the process (both only set defaults, so an operator's explicit env wins).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = ["repo_root", "ensure_repo_on_path", "force_host_devices",
+           "data_mesh"]
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def ensure_repo_on_path() -> str:
+    """Make ``import paddle_tpu`` work when a tool runs as a script
+    (sys.path[0] is then tools/, not the repo root)."""
+    root = repo_root()
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    return root
+
+
+def force_host_devices(n: int, platform: str = "cpu") -> None:
+    """Default the process to ``n`` virtual host devices (no-op for any
+    var the operator already set, so real-TPU runs are unaffected)."""
+    if "XLA_FLAGS" not in os.environ:
+        os.environ["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={n}")
+    os.environ.setdefault("JAX_PLATFORMS", platform)
+
+
+def data_mesh(n: int = 1):
+    """Build the plain data-parallel mesh over at most ``n`` devices
+    (clamped to what the backend actually has)."""
+    import jax
+
+    from paddle_tpu.distributed.mesh import build_mesh
+
+    return build_mesh({"data": max(1, min(n, len(jax.devices())))})

@@ -24,8 +24,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
-import sys
 import time
 
 
@@ -43,25 +41,22 @@ def main():
     ap.add_argument("--warmup", type=int, default=3)
     args = ap.parse_args()
 
-    if "XLA_FLAGS" not in os.environ:
-        os.environ["XLA_FLAGS"] = (
-            f"--xla_force_host_platform_device_count={args.devices}")
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from _mesh_setup import (data_mesh, ensure_repo_on_path,
+                             force_host_devices)
+    force_host_devices(args.devices)
+    ensure_repo_on_path()
 
     import numpy as np
     import jax
     import jax.numpy as jnp
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
     from paddle_tpu.distributed.compressed import (
         bucket_sizes, compressed_tree_mean, init_residuals,
         wire_bytes_per_rank)
-    from paddle_tpu.distributed.mesh import build_mesh
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    n = min(args.devices, len(jax.devices()))
-    mesh = build_mesh({"data": n})
+    mesh = data_mesh(args.devices)
+    n = mesh.devices.size
     bucket_bytes = args.bucket_mb << 20
     align = n * args.block
     numel = ((args.numel + align - 1) // align) * align

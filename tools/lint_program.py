@@ -1,0 +1,138 @@
+"""Lint the shipped bench models' train steps with the jaxpr analyzer.
+
+Stages the bench GPT / BERT configurations (CPU shapes), traces the
+EXACT jitted step each ParallelTrainer would run (donation mask,
+comm_err / compressed grad-sync plumbing included) and runs every rule
+in paddle_tpu.analysis over it, plus the cost model's top-k
+most-expensive-equations table.
+
+Exit status is the CI contract: 0 when no error-severity finding on any
+model, 1 otherwise — warnings and infos print but do not fail.
+
+Usage:
+    python tools/lint_program.py                  # gpt + bert, text report
+    python tools/lint_program.py --model gpt --json  # machine-readable
+    python tools/lint_program.py --smoke          # tiny config, tier-1 CI
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from _mesh_setup import data_mesh, ensure_repo_on_path, force_host_devices
+
+
+def _build_gpt(smoke: bool):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.engine import ParallelTrainer
+    from paddle_tpu.text.models import GPTForPretraining
+
+    if smoke:
+        vocab, h, layers, heads, seq, batch = 256, 64, 1, 2, 32, 4
+    else:  # the bench.py CPU gpt_base shape
+        vocab, h, layers, heads, seq, batch = 1024, 128, 2, 4, 128, 4
+    paddle.seed(0)
+    model = GPTForPretraining(
+        tensor_parallel=False, vocab_size=vocab, hidden_size=h,
+        num_layers=layers, num_heads=heads, max_position_embeddings=seq,
+        attn_dropout=0.0, hidden_dropout=0.0)
+    model.bfloat16()
+    opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters())
+    trainer = ParallelTrainer(
+        model, opt,
+        lambda logits, lbl: nn.functional.cross_entropy(logits, lbl))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (batch, seq)).astype("int32")
+    labels = rng.randint(0, vocab, (batch, seq)).astype("int32")
+    return trainer, ids, labels
+
+
+def _build_bert(smoke: bool):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.engine import ParallelTrainer
+    from paddle_tpu.text.models import BertForPretraining
+
+    if smoke:
+        cfg = dict(vocab_size=256, hidden_size=64, num_layers=1,
+                   num_heads=2, max_position_embeddings=32)
+        batch, seq = 4, 32
+    else:  # the bench.py CPU bert_base_amp shape
+        cfg = dict(vocab_size=1024, hidden_size=128, num_layers=2,
+                   num_heads=4, max_position_embeddings=128)
+        batch, seq = 4, 64
+    paddle.seed(0)
+    model = BertForPretraining(tensor_parallel=False, attn_dropout=0.0,
+                               hidden_dropout=0.0, **cfg)
+    model.bfloat16()
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+
+    def loss_fn(outputs, labels):
+        mlm_logits, nsp_logits = outputs
+        mlm_labels, nsp_labels = labels
+        return model.loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels)
+
+    trainer = ParallelTrainer(model, opt, loss_fn)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg["vocab_size"], (batch, seq)).astype("int32")
+    mlm = np.full((batch, seq), -100, dtype="int32")
+    mlm[:, ::8] = rng.randint(0, cfg["vocab_size"], (batch, seq // 8))
+    nsp = rng.randint(0, 2, (batch,)).astype("int32")
+    return trainer, ids, (mlm, nsp)
+
+
+BUILDERS = {"gpt": _build_gpt, "bert": _build_bert}
+
+
+def lint_model(name: str, smoke: bool, top: int):
+    from paddle_tpu.analysis import AnalysisConfig
+
+    data_mesh(1)
+    trainer, inputs, labels = BUILDERS[name](smoke)
+    _, report = trainer.compile(inputs, labels, analyze=True,
+                                config=AnalysisConfig(top_k=top))
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", choices=("gpt", "bert", "all"),
+                    default="all")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON report object keyed by model")
+    ap.add_argument("--top", type=int, default=10,
+                    help="cost-table length (default 10)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny 1-layer configs; the tier-1 CI wrapper")
+    ap.add_argument("--devices", type=int, default=1,
+                    help="forced host device count when no accelerator")
+    args = ap.parse_args(argv)
+
+    force_host_devices(args.devices)
+    ensure_repo_on_path()
+
+    models = ("gpt", "bert") if args.model == "all" else (args.model,)
+    reports = {}
+    for name in models:
+        reports[name] = lint_model(name, args.smoke, args.top)
+
+    if args.json:
+        print(json.dumps({n: r.to_dict() for n, r in reports.items()}))
+    else:
+        for name, rep in reports.items():
+            print(f"== {name} ==")
+            print(rep.to_text())
+    ok = all(r.ok for r in reports.values())
+    if not ok:
+        print("lint_program: error-severity findings present",
+              file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,62 +53,18 @@ CFG = dict(vocab_size=128, hidden_size=64, num_layers=4, num_heads=4,
 
 
 # -- jaxpr matmul-FLOPs estimator ------------------------------------------
+# The estimator now lives in paddle_tpu.analysis.cost (same semantics:
+# scan bodies x trip count, cond branches at their MAX); this module
+# keeps its historical names as thin aliases for its tests.
 
-def _dot_flops(eqn):
-    dn = eqn.params["dimension_numbers"]
-    (lc, _rc), (lb, _rb) = dn
-    lhs = eqn.invars[0].aval.shape
-    rhs = eqn.invars[1].aval.shape
-    batch = 1
-    for i in lb:
-        batch *= lhs[i]
-    k = 1
-    for i in lc:
-        k *= lhs[i]
-    m = 1
-    for i, d in enumerate(lhs):
-        if i not in lc and i not in lb:
-            m *= d
-    n = 1
-    rc, rb = set(_rc), set(_rb)
-    for i, d in enumerate(rhs):
-        if i not in rc and i not in rb:
-            n *= d
-    return 2.0 * batch * m * n * k
+from paddle_tpu.analysis.cost import (  # noqa: E402
+    dot_general_flops as _dot_flops, matmul_flops)
+from paddle_tpu.analysis.walker import subjaxprs as _subjaxpr_sites  # noqa: E402
 
 
 def _sub_jaxprs(eqn):
-    for v in eqn.params.values():
-        if isinstance(v, jax.extend.core.ClosedJaxpr):
-            yield v.jaxpr
-        elif hasattr(v, "eqns"):  # raw Jaxpr
-            yield v
-        elif isinstance(v, (list, tuple)):
-            for x in v:
-                if isinstance(x, jax.extend.core.ClosedJaxpr):
-                    yield x.jaxpr
-                elif hasattr(x, "eqns"):
-                    yield x
-
-
-def matmul_flops(jaxpr) -> float:
-    total = 0.0
-    for eqn in jaxpr.eqns:
-        name = eqn.primitive.name
-        if name == "dot_general":
-            total += _dot_flops(eqn)
-        elif name == "scan":
-            length = eqn.params.get("length", 1)
-            inner = sum(matmul_flops(j) for j in _sub_jaxprs(eqn))
-            total += length * inner
-        elif name == "cond":
-            branches = eqn.params.get("branches", ())
-            costs = [matmul_flops(b.jaxpr if hasattr(b, "jaxpr") else b)
-                     for b in branches]
-            total += max(costs) if costs else 0.0
-        else:
-            total += sum(matmul_flops(j) for j in _sub_jaxprs(eqn))
-    return total
+    for sub in _subjaxpr_sites(eqn):
+        yield sub.jaxpr
 
 
 # -- trainers ---------------------------------------------------------------
