@@ -158,7 +158,7 @@ def _gpt_variant(jax, on_tpu, batch, seq, vocab, cfg, fused, chunk=8192,
     import numpy as np
 
     import paddle_tpu as paddle
-    from paddle_tpu import nn
+    from paddle_tpu import nn, telemetry
     from paddle_tpu.distributed.engine import ParallelTrainer
     from tools._mesh_setup import data_mesh
     from paddle_tpu.text.models import GPTForPretraining
@@ -166,43 +166,61 @@ def _gpt_variant(jax, on_tpu, batch, seq, vocab, cfg, fused, chunk=8192,
     paddle.seed(0)
     ndev = len(jax.devices()) if (on_tpu and grad_sync) else 1
     data_mesh(ndev)
-    model = GPTForPretraining(
-        tensor_parallel=False, vocab_size=vocab, hidden_size=cfg["h"],
-        num_layers=cfg["l"], num_heads=cfg["n"],
-        max_position_embeddings=seq, attn_dropout=0.0, hidden_dropout=0.0)
-    model.bfloat16()
-    opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters())
+    # fresh per-variant registry, no run_dir/profiler: the per-step sync
+    # telemetry adds is the loss fetch _timed_steps does anyway
+    with telemetry.scope(profile=False) as tel:
+        model = GPTForPretraining(
+            tensor_parallel=False, vocab_size=vocab, hidden_size=cfg["h"],
+            num_layers=cfg["l"], num_heads=cfg["n"],
+            max_position_embeddings=seq, attn_dropout=0.0,
+            hidden_dropout=0.0)
+        model.bfloat16()
+        opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters())
 
-    sync_kw = dict(grad_sync=grad_sync) if grad_sync else {}
-    if fused:
-        trainer = ParallelTrainer(_make_fused_loss(model, chunk), opt,
-                                  lambda out, _lbl: out, remat=remat,
-                                  **sync_kw)
-    else:
-        trainer = ParallelTrainer(
-            model, opt,
-            # bf16 logits straight into the fused lse-gather CE fast path
-            # (fp32 accumulation inside; astype here would materialize a
-            # full fp32 (b, s, vocab) tensor)
-            lambda logits, lbl: nn.functional.cross_entropy(logits, lbl),
-            remat=remat, **sync_kw)
+        sync_kw = dict(grad_sync=grad_sync) if grad_sync else {}
+        if fused:
+            trainer = ParallelTrainer(_make_fused_loss(model, chunk), opt,
+                                      lambda out, _lbl: out, remat=remat,
+                                      **sync_kw)
+        else:
+            trainer = ParallelTrainer(
+                model, opt,
+                # bf16 logits straight into the fused lse-gather CE fast
+                # path (fp32 accumulation inside; astype here would
+                # materialize a full fp32 (b, s, vocab) tensor)
+                lambda logits, lbl: nn.functional.cross_entropy(logits, lbl),
+                remat=remat, **sync_kw)
 
-    rng = np.random.RandomState(0)
-    ids = rng.randint(0, vocab, (batch, seq)).astype("int32")
-    labels = rng.randint(0, vocab, (batch, seq)).astype("int32")
-    iters = 16 if on_tpu else 3
-    warmup = 8 if on_tpu else 2
-    inputs = (ids, labels) if fused else ids
-    lbls = 0.0 if fused else labels
-    dt, final_loss = _timed_steps(trainer, inputs, lbls, warmup, iters)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, vocab, (batch, seq)).astype("int32")
+        labels = rng.randint(0, vocab, (batch, seq)).astype("int32")
+        iters = 16 if on_tpu else 3
+        warmup = 8 if on_tpu else 2
+        inputs = (ids, labels) if fused else ids
+        lbls = 0.0 if fused else labels
+        dt, final_loss = _timed_steps(trainer, inputs, lbls, warmup, iters)
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     out = {"tokens_per_sec": round(batch * seq * iters / dt, 1),
-           "params": n_params, "final_loss": round(final_loss, 4)}
+           "params": n_params, "final_loss": round(final_loss, 4),
+           "telemetry": _harvest_telemetry(tel.registry)}
     if on_tpu:
         # memory_stats peak is process-cumulative: attributable to THIS
         # variant only while the sweep runs smallest-footprint-first
         out["hbm_peak_so_far_gb"] = _hbm_peak_gb(jax)
     return out
+
+
+def _harvest_telemetry(reg):
+    """Registry -> the compact telemetry dict appended to bench JSON."""
+    def val(name, default=None):
+        m = reg.get(name)
+        return m.value() if m is not None else default
+    return {
+        "mfu": round(val("mfu", 0.0), 6),
+        "recompiles": int(val("recompiles_total", 0)),
+        "wire_bytes": val("grad_sync_bytes_total", 0.0),
+        "step_time_avg_s": round(val("step_time_seconds", 0.0), 6),
+    }
 
 
 def bench_gpt(jax, on_tpu):
@@ -634,6 +652,10 @@ def main():
         result["mfu"] = gpt["mfu_6N"]
         result["params"] = gpt["params"]
         result["final_loss"] = gpt["final_loss"]
+    if "telemetry" in gpt:
+        # best-variant registry harvest (mfu from the cost model,
+        # recompiles, wire bytes) — see paddle_tpu/telemetry
+        result["telemetry"] = gpt["telemetry"]
     print(json.dumps(result))
     return 0 if ok else 1
 

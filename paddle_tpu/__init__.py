@@ -45,6 +45,7 @@ from . import text  # noqa: F401,E402
 from . import rec  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
+from . import telemetry  # noqa: F401,E402
 from . import monitor  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import analysis  # noqa: F401,E402

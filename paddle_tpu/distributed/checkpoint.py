@@ -18,6 +18,7 @@ own threads; XLA-side this is the idiomatic equivalent).
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Optional
 
 import jax
@@ -27,6 +28,25 @@ __all__ = ["save_checkpoint", "load_checkpoint", "CheckpointManager",
 
 
 _cached = {}  # one checkpointer per mode: async saves barrier on reuse
+
+
+def _record(op: str, dt: float, state: Any):
+    """Telemetry: save/restore wall time + bytes moved. For async saves the
+    duration is the dispatch (host-blocking) portion — the part that stalls
+    training — not the background write."""
+    from .. import telemetry
+    if not telemetry.enabled():
+        return
+    telemetry.histogram(
+        f"checkpoint_{op}_seconds",
+        f"checkpoint {op} wall time (host-blocking part)").observe(dt)
+    nbytes = float(sum(getattr(v, "nbytes", 0) or 0
+                       for v in jax.tree_util.tree_leaves(state)))
+    if nbytes:
+        telemetry.counter(
+            "checkpoint_bytes_total", "checkpointed bytes").inc(
+                nbytes, op=op)
+    telemetry.emit("checkpoint", op=op, seconds=dt, bytes=nbytes)
 
 
 def _checkpointer(use_async: bool):
@@ -49,8 +69,10 @@ def save_checkpoint(path: str, state: Any, overwrite: bool = True,
     returned checkpointer before process exit."""
     import orbax.checkpoint as ocp
     ckptr = _checkpointer(use_async)
+    t0 = time.perf_counter()
     ckptr.save(os.path.abspath(path), args=ocp.args.StandardSave(state),
                force=overwrite)
+    _record("save", time.perf_counter() - t0, state)
     return ckptr
 
 
@@ -60,6 +82,7 @@ def load_checkpoint(path: str, template: Optional[Any] = None):
     its devices; without it, arrays land replicated on the default device."""
     import orbax.checkpoint as ocp
     ckptr = _checkpointer(False)
+    t0 = time.perf_counter()
     if template is not None:
         abstract = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(
@@ -67,9 +90,12 @@ def load_checkpoint(path: str, template: Optional[Any] = None):
                 sharding=getattr(x, "sharding", None)) if hasattr(x, "shape")
             else x,
             template)
-        return ckptr.restore(os.path.abspath(path),
-                             args=ocp.args.StandardRestore(abstract))
-    return ckptr.restore(os.path.abspath(path))
+        out = ckptr.restore(os.path.abspath(path),
+                            args=ocp.args.StandardRestore(abstract))
+    else:
+        out = ckptr.restore(os.path.abspath(path))
+    _record("restore", time.perf_counter() - t0, out)
+    return out
 
 
 class CheckpointManager:
@@ -95,7 +121,11 @@ class CheckpointManager:
         state = jax.tree_util.tree_map(
             lambda x: np.asarray(x) if isinstance(x, np.generic) else x,
             state)
-        return self._mngr.save(step, args=ocp.args.StandardSave(state))
+        t0 = time.perf_counter()
+        saved = self._mngr.save(step, args=ocp.args.StandardSave(state))
+        if saved:  # interval-skipped saves shouldn't pollute the histogram
+            _record("save", time.perf_counter() - t0, state)
+        return saved
 
     def restore(self, step: Optional[int] = None,
                 template: Optional[Any] = None):
@@ -103,17 +133,22 @@ class CheckpointManager:
         step = self.latest_step() if step is None else step
         if step is None:
             return None
+        t0 = time.perf_counter()
         if template is not None:
             abstract = jax.tree_util.tree_map(
                 lambda x: jax.ShapeDtypeStruct(
                     x.shape, x.dtype, sharding=getattr(x, "sharding", None))
                 if hasattr(x, "shape") else x, template)
-            return self._mngr.restore(
+            out = self._mngr.restore(
                 step, args=ocp.args.StandardRestore(abstract))
-        # installed orbax refuses a bare restore (no registered handler for
-        # the saved "default" item) — an explicit StandardRestore with no
-        # abstract tree restores everything replicated on the host
-        return self._mngr.restore(step, args=ocp.args.StandardRestore())
+        else:
+            # installed orbax refuses a bare restore (no registered handler
+            # for the saved "default" item) — an explicit StandardRestore
+            # with no abstract tree restores everything replicated on the
+            # host
+            out = self._mngr.restore(step, args=ocp.args.StandardRestore())
+        _record("restore", time.perf_counter() - t0, out)
+        return out
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()

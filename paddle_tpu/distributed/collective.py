@@ -96,10 +96,23 @@ def in_traced_axis(axis_name: str) -> bool:
         return False
 
 
+def _count(op: str, ax: str):
+    """Telemetry: collectives issued at TRACE time (once per compilation,
+    not per step — zero cost on the executed hot path). The per-op/axis
+    counts profile a program's communication pattern the way the
+    reference's collective_helper instance counts did."""
+    from .. import telemetry
+    if telemetry.enabled():
+        telemetry.counter(
+            "collective_calls_total",
+            "collective ops issued at trace time").inc(op=op, axis=ax)
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     ax = _axis(group)
     if not in_traced_axis(ax):
         return tensor
+    _count("all_reduce", ax)
     if op == ReduceOp.SUM:
         return lax.psum(tensor, ax)
     if op == ReduceOp.AVG:
@@ -129,6 +142,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
         if isinstance(tensor_list, list):
             tensor_list.append(tensor)
         return tensor
+    _count("all_gather", ax)
     gathered = lax.all_gather(tensor, ax, axis=axis, tiled=False)
     if isinstance(tensor_list, list):
         n = gathered.shape[axis]
@@ -142,6 +156,7 @@ def all_gather_concat(tensor, group=None, axis=0):
     ax = _axis(group)
     if not in_traced_axis(ax):
         return tensor
+    _count("all_gather", ax)
     return lax.all_gather(tensor, ax, axis=axis, tiled=True)
 
 
@@ -149,6 +164,7 @@ def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, axis=0):
     ax = _axis(group)
     if not in_traced_axis(ax):
         return tensor
+    _count("reduce_scatter", ax)
     return lax.psum_scatter(tensor, ax, scatter_dimension=axis, tiled=True)
 
 
@@ -156,6 +172,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     ax = _axis(group)
     if not in_traced_axis(ax):
         return tensor
+    _count("broadcast", ax)
     # masked psum: only src contributes, everyone receives — one all-reduce
     # of x's size instead of materializing the (n, *shape) gathered stack
     mask = lax.axis_index(ax) == src
@@ -189,6 +206,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True,
         x = jnp.stack(list(in_tensor_list), axis=0)
         if not in_traced_axis(ax):
             return list(in_tensor_list)
+        _count("alltoall", ax)
         out = lax.all_to_all(x, ax, split_axis=0, concat_axis=0, tiled=False)
         res = [out[i] for i in range(out.shape[0])]
         if isinstance(out_tensor_list, list):
@@ -196,6 +214,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True,
         return res
     if not in_traced_axis(ax):
         return in_tensor_list
+    _count("alltoall", ax)
     return lax.all_to_all(in_tensor_list, ax, split_axis=split_axis,
                           concat_axis=concat_axis, tiled=True)
 
@@ -207,6 +226,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     ax = _axis(group)
     if not in_traced_axis(ax):
         return tensor
+    _count("send", ax)
     n = lax.axis_size(ax)
     perm = [(i, (i + 1) % n) for i in range(n)]
     return lax.ppermute(tensor, ax, perm)
@@ -220,6 +240,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
     ax = _axis(group)
     if not in_traced_axis(ax):
         return tensor
+    _count("recv", ax)
     n = lax.axis_size(ax)
     perm = [(i, (i - 1) % n) for i in range(n)]
     return lax.ppermute(tensor, ax, perm)

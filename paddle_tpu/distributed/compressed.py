@@ -41,6 +41,7 @@ __all__ = [
     "GRAD_SYNC_POLICIES", "DEFAULT_BLOCK", "DEFAULT_BUCKET_BYTES",
     "quantize_int8_blocks", "dequantize_int8_blocks",
     "compressed_tree_mean", "init_residuals", "wire_bytes_per_rank",
+    "tree_wire_bytes", "residual_norm",
 ]
 
 GRAD_SYNC_POLICIES = ("fp32", "bf16", "int8")
@@ -276,3 +277,45 @@ def wire_bytes_per_rank(numel: int, n: int, policy: str,
                 + ring * numel * 1            # phase 1: int8 all_to_all
                 + ring * (numel * 1 + nscales * 4))  # phase 2: all_gather
     raise ValueError(f"unknown policy {policy!r}")
+
+
+def tree_wire_bytes(tree, n: int, policy: str,
+                    block: int = DEFAULT_BLOCK) -> float:
+    """Logical bytes ONE ``compressed_tree_mean`` over ``n`` ranks moves
+    per rank for this pytree — the telemetry counterpart of
+    ``wire_bytes_per_rank``, applying the exchange's actual grouping:
+    float leaves coalesce per dtype group into an fp32 flat padded to
+    ``n*block``; non-float leaves go through a per-leaf pmean."""
+    if n <= 1:
+        return 0.0
+    leaves = jax.tree_util.tree_leaves(tree)
+    align = n * block
+    total = 0.0
+    for dtype, idxs in _dtype_groups(leaves).items():
+        sizes = [int(jnp.asarray(leaves[i]).size) for i in idxs]
+        if not jnp.issubdtype(dtype, jnp.floating):
+            itemsize = jnp.dtype(dtype).itemsize
+            total += sum(2 * (n - 1) / n * s * itemsize for s in sizes)
+            continue
+        padded = _round_up(sum(sizes), align)
+        total += wire_bytes_per_rank(padded, n, policy, block)
+    return total
+
+
+_RESIDUAL_NORM_FN = None
+
+
+def residual_norm(tree) -> float:
+    """Host-side L2 norm of the error-feedback residual state — the
+    telemetry hook watching whether int8 quantization error stays bounded
+    (it should hover, not grow, once error feedback converges). Blocks on
+    the device reduction; call off the hot path / when telemetry is on."""
+    global _RESIDUAL_NORM_FN
+    if _RESIDUAL_NORM_FN is None:
+        def _norm(t):
+            leaves = jax.tree_util.tree_leaves(t)
+            sq = sum(jnp.sum(jnp.square(v.astype(jnp.float32)))
+                     for v in leaves)
+            return jnp.sqrt(sq)
+        _RESIDUAL_NORM_FN = jax.jit(_norm)
+    return float(_RESIDUAL_NORM_FN(tree))

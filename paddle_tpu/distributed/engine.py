@@ -21,6 +21,7 @@ XLA's latency-hiding scheduler (replacing reducer.cc:798's manual overlap).
 """
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from functools import partial
 from typing import Callable, Optional
@@ -32,6 +33,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 shard_map = jax.shard_map
 
+from .. import profiler as _profiler
+from .. import telemetry as _telemetry
 from ..framework.random import get_rng_key
 from ..jit.functionalization import functional_call, state_of
 from .compressed import compressed_tree_mean
@@ -520,6 +523,29 @@ class ParallelTrainer:
         self._make_step = make_step
         self._sep = sep
         self._step_cache = {}
+        self._step_costs = {}      # cache_key -> analysis.cost numbers
+        self._last_cache_key = None
+
+        # Telemetry wire accounting: logical bytes one train_step's
+        # bucketed DP exchange moves per rank. Static per trainer (the
+        # exchange is shape-independent of the batch); ZeRO-2/3 leaves go
+        # through per-tensor psum_scatter and are not counted here.
+        n_sync = 1
+        for ax in sync_axes:
+            n_sync *= mesh.shape.get(ax, 1)
+        plain_params = {k: v for k, v in self.state["params"].items()
+                        if self.trainable[k] and k not in zero2_dims
+                        and k not in zero3_dims}
+        if plain_params and n_sync > 1:
+            from .compressed import tree_wire_bytes
+            self._wire_bytes_per_step = K * tree_wire_bytes(
+                plain_params, n_sync, self.grad_sync,
+                block=self.grad_sync_block)
+            self._wire_fp32_per_step = K * tree_wire_bytes(
+                plain_params, n_sync, "fp32", block=self.grad_sync_block)
+        else:
+            self._wire_bytes_per_step = 0.0
+            self._wire_fp32_per_step = 0.0
 
     def _leaf_spec(self, x):
         """Per-leaf data PartitionSpec (see make_step docstring)."""
@@ -552,10 +578,54 @@ class ParallelTrainer:
                      tuple(_rank(l) for l in jax.tree_util.tree_leaves(
                          (inputs, labels))))
         step = self._step_cache.get(cache_key)
+        self._last_stage_miss = step is None
         if step is None:
+            t0 = time.perf_counter()
             step = self._make_step(in_specs, lb_specs)
             self._step_cache[cache_key] = step
+            if _telemetry.enabled():
+                _telemetry.counter(
+                    "recompiles_total",
+                    "train-step stagings (cache misses) + jit shape "
+                    "recompiles").inc()
+                _telemetry.histogram(
+                    "stage_time_seconds",
+                    "wall time building a step for a new batch "
+                    "structure").observe(time.perf_counter() - t0)
+                self._step_costs[cache_key] = self._trace_step_cost(
+                    step, inputs, labels)
+        self._last_cache_key = cache_key
         return inputs, labels, step
+
+    def _trace_step_cost(self, step, inputs, labels):
+        """Static per-step cost of the EXACT staged jaxpr — donation mask,
+        comm_err plumbing and all — via analysis.cost. Telemetry-only:
+        traces with ShapeDtypeStructs (no rng draw, nothing executed) and
+        never raises into the training path."""
+        try:
+            from ..analysis import cost as _cost
+            to_struct = lambda x: (jax.ShapeDtypeStruct(x.shape, x.dtype)  # noqa: E731
+                                   if hasattr(x, "shape") and
+                                   hasattr(x, "dtype") else x)
+            key_aval = jax.eval_shape(lambda: jax.random.key(0))
+            args = jax.tree_util.tree_map(to_struct, (
+                self.state["params"], self.state["buffers"],
+                self.state["opt"], self.state["comm_err"]))
+            lr = float(self.optimizer.get_lr())
+            closed = jax.make_jaxpr(lambda *a: step(*a))(
+                *args, key_aval, lr,
+                jax.tree_util.tree_map(to_struct, inputs),
+                jax.tree_util.tree_map(to_struct, labels))
+            donated = sum(
+                getattr(v, "nbytes", 0)
+                for part in (self.state["params"], self.state["opt"],
+                             self.state["comm_err"])
+                for v in jax.tree_util.tree_leaves(part))
+            return {"flops": _cost.total_flops(closed),
+                    "peak_live_bytes": _cost.peak_live_bytes(closed),
+                    "donated_bytes": float(donated)}
+        except Exception:
+            return None
 
     # -- staging / analysis -------------------------------------------------
     def compile(self, inputs, labels, lr: Optional[float] = None,
@@ -568,7 +638,13 @@ class ParallelTrainer:
 
         ``inputs``/``labels`` may be real arrays or ShapeDtypeStructs
         (nothing is materialized or executed either way)."""
+        t0 = time.perf_counter()
         inputs, labels, step = self._stage(inputs, labels, place=False)
+        if _telemetry.enabled():
+            _telemetry.histogram(
+                "compile_time_seconds",
+                "ParallelTrainer.compile wall time").observe(
+                    time.perf_counter() - t0)
         if not analyze:
             return step
         from .. import analysis
@@ -602,13 +678,30 @@ class ParallelTrainer:
                 f"batch size {batch0} is not divisible by "
                 f"accumulate_steps={self.accumulate_steps}")
         # inputs/labels may be arbitrary pytrees (e.g. (mlm, nsp) labels)
+        tel = _telemetry.enabled()
+        t_start = time.perf_counter() if tel else 0.0
         inputs, labels, step = self._stage(inputs, labels)
+        # Host range for the profiler/chrome trace; the telemetry counter
+        # track is aligned against these. Skipped entirely (no object,
+        # no named_scope) when the profiler is off.
+        ev = (_profiler.RecordEvent("train_step").begin()
+              if _profiler.is_profiler_enabled() else None)
+        n_compiled0 = self._jit_cache_size(step) if tel else None
         loss, new_params, new_opt, new_comm_err = step(
             self.state["params"], self.state["buffers"], self.state["opt"],
             self.state["comm_err"], key, lr, inputs, labels)
+        if tel or ev is not None:
+            # the documented telemetry sync point: step wall time includes
+            # device execution (loss is the last value the step produces)
+            jax.block_until_ready(loss)
+        if ev is not None:
+            ev.end()
         self.state["params"] = new_params
         self.state["opt"] = new_opt
         self.state["comm_err"] = new_comm_err
+        if tel:
+            self._record_step_telemetry(
+                time.perf_counter() - t_start, inputs, step, n_compiled0)
         from ..framework import flags as _flags
         if _flags.flag("check_nan_inf"):
             _flags.check_numerics({"loss": loss}, "train_step:")
@@ -618,6 +711,86 @@ class ParallelTrainer:
         if _flags.flag("benchmark"):
             jax.block_until_ready(loss)
         return loss
+
+    @staticmethod
+    def _jit_cache_size(step):
+        """Compiled-executable count of a jitted step (None if this jax
+        doesn't expose it). Lets the recompile counter catch SHAPE misses
+        — same batch structure/ranks, so a _step_cache hit, but jit still
+        retraces — not just staging misses."""
+        try:
+            return step._cache_size()
+        except Exception:
+            return None
+
+    def _record_step_telemetry(self, dt, inputs, step, n_compiled0):
+        """Host-side per-step metrics (telemetry enabled only)."""
+        _telemetry.histogram(
+            "step_time_seconds",
+            "train_step wall time incl. device execution").observe(dt)
+        if not self._last_stage_miss and n_compiled0 is not None:
+            n1 = self._jit_cache_size(step)
+            if n1 is not None and n1 > n_compiled0:
+                _telemetry.counter(
+                    "recompiles_total",
+                    "train-step stagings (cache misses) + jit shape "
+                    "recompiles").inc()
+        tokens = None
+        leaves = jax.tree_util.tree_leaves(inputs)
+        if leaves:
+            shape = jnp.shape(leaves[0])
+            if len(shape) >= 2:
+                tokens = int(shape[0]) * int(shape[1])
+            elif len(shape) == 1:
+                tokens = int(shape[0])
+        tps = None
+        if tokens and dt > 0:
+            tps = tokens / dt
+            _telemetry.gauge(
+                "tokens_per_sec",
+                "elements of the lead input's first two dims per "
+                "second").set(tps)
+        mfu = None
+        cost = self._step_costs.get(self._last_cache_key)
+        if cost:
+            if cost["flops"] and dt > 0:
+                mfu = cost["flops"] / dt / _telemetry.peak_flops_per_sec()
+                _telemetry.gauge(
+                    "mfu", "model FLOPs utilization: analysis.cost FLOPs "
+                    "of the staged step / wall time / hardware peak"
+                ).set(mfu)
+            _telemetry.gauge(
+                "peak_live_bytes", "liveness-scan peak working set of the "
+                "staged step jaxpr").set(cost["peak_live_bytes"])
+            _telemetry.gauge(
+                "donated_bytes", "bytes of donated state "
+                "(params + opt + comm_err)").set(cost["donated_bytes"])
+        if self._wire_bytes_per_step:
+            _telemetry.counter(
+                "grad_sync_bytes_total",
+                "logical wire bytes per rank of the bucketed grad "
+                "exchange").inc(self._wire_bytes_per_step,
+                                policy=self.grad_sync)
+            if self._wire_bytes_per_step > 0:
+                _telemetry.gauge(
+                    "grad_sync_compression_x",
+                    "fp32 wire bytes / policy wire bytes").set(
+                        self._wire_fp32_per_step /
+                        self._wire_bytes_per_step)
+        res = None
+        if self.state["comm_err"]:
+            from .compressed import residual_norm
+            try:
+                res = residual_norm(self.state["comm_err"])
+                _telemetry.gauge(
+                    "grad_sync_residual_norm",
+                    "L2 norm of the int8 error-feedback residual").set(res)
+            except Exception:
+                res = None
+        _telemetry.emit(
+            "step", step_time=dt,
+            **{k: v for k, v in (("tokens_per_sec", tps), ("mfu", mfu),
+                                 ("residual_norm", res)) if v is not None})
 
     def check_replication(self):
         """Debug aid (FLAGS_check_replication): assert every param whose

@@ -253,6 +253,53 @@ class VisualDL(Callback):
                 self._write(f"eval/{k}", v, self._step)
 
 
+class TelemetryCallback(Callback):
+    """Folds telemetry into hapi ``logs`` (ISSUE 3).
+
+    Self-times each train batch (hapi drives its own jitted steps, not
+    ParallelTrainer, so the wall clock here IS the step time) and:
+
+    - always adds ``step_time`` to the batch logs — downstream callbacks
+      (ProgBarLogger, VisualDL) surface it for free;
+    - when telemetry is enabled, records the time into the global
+      ``step_time_seconds`` histogram and emits a ``step`` JSONL event;
+    - copies trainer-level registry metrics (``mfu``, ``tokens_per_sec``,
+      ``recompiles_total``) into the logs when present, so a
+      ParallelTrainer run wrapped in hapi-style reporting shows them.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._t0 = None
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        from .. import telemetry
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        if logs is not None:
+            logs["step_time"] = dt
+        if telemetry.enabled():
+            telemetry.histogram(
+                "step_time_seconds",
+                "train_step wall time incl. device execution").observe(dt)
+            telemetry.emit("step", step_time=dt, source="hapi")
+        reg = telemetry.get_registry()
+        if logs is not None:
+            for log_key, metric in (("mfu", "mfu"),
+                                    ("tokens_per_sec", "tokens_per_sec")):
+                m = reg.get(metric)
+                if m is not None:
+                    logs[log_key] = m.value()
+            c = reg.get("recompiles_total")
+            if c is not None:
+                logs["recompiles"] = int(c.value())
+
+
 def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
                      steps=None, log_freq=2, verbose=2, save_freq=1,
                      save_dir=None, metrics=None, mode="train"):

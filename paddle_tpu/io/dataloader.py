@@ -13,6 +13,7 @@ import itertools
 import multiprocessing as mp
 import queue as queue_mod
 import threading
+import time
 import traceback
 
 import numpy as np
@@ -196,13 +197,43 @@ class DataLoader:
         else:
             base = self._iter_single()
         if self.use_buffer_reader:
-            return _PrefetchIter(base, depth=self.prefetch_factor)
-        return iter(base)
+            it = _PrefetchIter(base, depth=self.prefetch_factor)
+        else:
+            it = iter(base)
+        from .. import telemetry
+        if telemetry.enabled():
+            return _TimedIter(it)
+        return it
 
     def __len__(self):
         if self.batch_sampler is not None:
             return len(self.batch_sampler)
         raise TypeError("length of IterableDataset loader is unknown")
+
+
+class _TimedIter:
+    """Telemetry wrapper: time each batch fetch. With prefetch in front,
+    near-zero fetch times mean the pipeline keeps up; fetch times
+    approaching step time are the input-starvation signature (compare the
+    dataloader_fetch_seconds histogram against step_time_seconds)."""
+
+    def __init__(self, it):
+        self._it = iter(it)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from .. import telemetry
+        t0 = time.perf_counter()
+        item = next(self._it)
+        telemetry.histogram(
+            "dataloader_fetch_seconds",
+            "wall time blocked fetching one batch").observe(
+                time.perf_counter() - t0)
+        telemetry.counter(
+            "dataloader_batches_total", "batches served").inc()
+        return item
 
 
 class _PrefetchIter:

@@ -1,15 +1,24 @@
-"""paddle_tpu.monitor — named int64 gauges (Prometheus-like counters).
+"""paddle_tpu.monitor — named int64 gauges (legacy bridge).
 
 Capability map: platform/monitor.h:44 StatValue (thread-safe named gauges
 with add/sub/set/reset, registered in a global registry) exposed to Python
-via pybind/global_value_getter_setter.cc. Here the registry is pure Python;
-values are plain ints guarded by a lock — the TPU runtime has no C++ hot
-path that needs native gauges.
+via pybind/global_value_getter_setter.cc.
+
+DEPRECATION PATH: since ISSUE 3 this module is a thin bridge onto
+``paddle_tpu.telemetry`` — every ``StatValue`` stores through a telemetry
+``Gauge`` of the same name in the CURRENT default registry, so monitor
+stats appear in ``telemetry.prometheus_text()`` / the JSONL summary with
+one source of truth. ``stat``/``get_all_stats``/``reset_all_stats`` keep
+their int-valued API for existing callers; new code should use
+``telemetry.counter/gauge/histogram`` directly (labels, histograms, and
+exporters live there). This module will eventually become a pure alias.
 """
 from __future__ import annotations
 
 import threading
 from typing import Dict
+
+from . import telemetry as _telemetry
 
 __all__ = ["StatValue", "stat", "get_all_stats", "reset_all_stats"]
 
@@ -18,34 +27,34 @@ _reg_lock = threading.Lock()
 
 
 class StatValue:
-    """reference: platform/monitor.h:44."""
+    """reference: platform/monitor.h:44 — int view over a telemetry Gauge.
+
+    The gauge is looked up per operation (not cached) so a
+    ``telemetry.scope(fresh=True)`` registry swap is observed immediately.
+    """
 
     def __init__(self, name: str, value: int = 0):
         self.name = name
-        self._v = int(value)
-        self._lock = threading.Lock()
+        if value:
+            self._gauge().set(int(value))
+
+    def _gauge(self):
+        return _telemetry.gauge(self.name, "monitor.StatValue bridge")
 
     def increase(self, n: int = 1) -> int:
-        with self._lock:
-            self._v += n
-            return self._v
+        return int(self._gauge().inc(int(n)))
 
     def decrease(self, n: int = 1) -> int:
-        with self._lock:
-            self._v -= n
-            return self._v
+        return int(self._gauge().dec(int(n)))
 
     def set(self, v: int) -> int:
-        with self._lock:
-            self._v = int(v)
-            return self._v
+        return int(self._gauge().set(int(v)))
 
     def reset(self) -> int:
         return self.set(0)
 
     def get(self) -> int:
-        with self._lock:
-            return self._v
+        return int(self._gauge().value())
 
     def __repr__(self):
         return f"StatValue({self.name}={self.get()})"

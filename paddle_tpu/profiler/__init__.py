@@ -29,6 +29,7 @@ import jax
 __all__ = [
     "RecordEvent", "record_event", "start_profiler", "stop_profiler",
     "reset_profiler", "profiler", "is_profiler_enabled", "export_chrome_tracing",
+    "snapshot_events",
 ]
 
 _state = threading.local()
@@ -37,6 +38,7 @@ _enabled = False
 _events = []          # completed: (name, parent_path, start_ns, end_ns, tid)
 _trace_dir = None     # jax.profiler output dir when device tracing is on
 _start_wall_ns = 0
+_session = 0          # bumped by start/stop; pairs RecordEvent begin/end
 
 
 def _stack():
@@ -54,17 +56,28 @@ class RecordEvent:
 
     reference: platform/profiler.h:127 (RAII RecordEvent) and the public
     paddle.profiler.RecordEvent of later versions.
+
+    Pair-safe across profiler state changes: each ``begin()`` captures the
+    profiler session it started in, and ``end()`` only records the range
+    if the SAME session is still active — a start/stop between the pair
+    silently drops the range instead of writing garbage timestamps into
+    the new session. The nesting stack holds the event objects themselves
+    (removed by identity), so an ``end()`` arriving out of LIFO order can
+    never pop another event's entry; the ``jax.named_scope`` is always
+    exited iff it was entered.
     """
 
     def __init__(self, name: str):
         self.name = name
         self._t0 = None
         self._scope = None
+        self._session = None
 
     def begin(self):
         if _enabled:
+            self._session = _session
             self._t0 = time.perf_counter_ns()
-            _stack().append(self.name)
+            _stack().append(self)
             self._scope = jax.named_scope(self.name)
             self._scope.__enter__()
         return self
@@ -74,16 +87,21 @@ class RecordEvent:
             return
         t1 = time.perf_counter_ns()
         stack = _stack()
-        if stack and stack[-1] == self.name:
-            stack.pop()
-        parent = "/".join(stack)
-        with _lock:
-            _events.append((self.name, parent, self._t0, t1,
-                            threading.get_ident()))
+        try:
+            stack.remove(self)
+        except ValueError:
+            pass  # stack was cleared by a profiler restart
+        if _enabled and self._session == _session:
+            parent = "/".join(e.name for e in stack
+                              if e._session == _session)
+            with _lock:
+                _events.append((self.name, parent, self._t0, t1,
+                                threading.get_ident()))
         if self._scope is not None:
             self._scope.__exit__(None, None, None)
             self._scope = None
         self._t0 = None
+        self._session = None
 
     __enter__ = begin
 
@@ -116,10 +134,11 @@ def start_profiler(state: str = "All", tracer_option: str = "Default",
 
     reference: fluid/profiler.py:190 (states CPU/GPU/All).
     """
-    global _enabled, _trace_dir, _start_wall_ns
+    global _enabled, _trace_dir, _start_wall_ns, _session
     if state not in ("CPU", "GPU", "TPU", "All"):
         raise ValueError(f"state must be CPU/GPU/TPU/All, got {state}")
     reset_profiler()
+    _session += 1  # invalidate RecordEvents begun before this point
     _start_wall_ns = time.perf_counter_ns()
     _enabled = True
     if state in ("GPU", "TPU", "All") and tracer_option != "HostOnly":
@@ -132,17 +151,21 @@ def start_profiler(state: str = "All", tracer_option: str = "Default",
 
 
 def stop_profiler(sorted_key: Optional[str] = None,
-                  profile_path: str = "/tmp/profile"):
+                  profile_path: str = "/tmp/profile",
+                  verbose: bool = True):
     """Disable recording; print a summary table sorted by ``sorted_key``
     (total/calls/max/min/ave) and write chrome tracing json to
-    ``profile_path``.
+    ``profile_path``. ``verbose=False`` suppresses the summary print
+    (telemetry.scope stops the profiler quietly and exports its own
+    merged trace).
 
     reference: fluid/profiler.py:257.
     """
-    global _enabled, _trace_dir
+    global _enabled, _trace_dir, _session
     if not _enabled:
         return
     _enabled = False
+    _session += 1  # RecordEvents still open will not record into the next run
     if _trace_dir is not None:
         try:
             jax.profiler.stop_trace()
@@ -154,7 +177,8 @@ def stop_profiler(sorted_key: Optional[str] = None,
             export_chrome_tracing(profile_path)
         except OSError:
             pass
-    _print_summary(sorted_key)
+    if verbose:
+        _print_summary(sorted_key)
 
 
 def _aggregate():
@@ -190,16 +214,28 @@ def _print_summary(sorted_key):
               f"{mx:>9.3f} {mn:>9.3f}")
 
 
+def snapshot_events():
+    """Raw completed events + the session start timestamp, for exporters
+    that merge host ranges with other timelines (telemetry.export)."""
+    with _lock:
+        return list(_events), _start_wall_ns
+
+
 def export_chrome_tracing(path: str):
     """Write completed host events as chrome://tracing JSON (the reference
-    reaches the same format via tools/timeline.py over profiler.proto)."""
-    with _lock:
-        events = list(_events)
+    reaches the same format via tools/timeline.py over profiler.proto).
+
+    The time origin is the EARLIEST of the session start and any recorded
+    event's begin — events that slipped in from before ``start_profiler``
+    reset ``_start_wall_ns`` must not produce negative timestamps (chrome
+    silently drops those)."""
+    events, start_ns = snapshot_events()
+    base = min([start_ns] + [t0 for _n, _p, t0, _t1, _tid in events])
     trace = []
     for name, parent, t0, t1, tid in events:
         trace.append({
             "name": name, "cat": "host", "ph": "X",
-            "ts": (t0 - _start_wall_ns) / 1e3,
+            "ts": (t0 - base) / 1e3,
             "dur": (t1 - t0) / 1e3,
             "pid": os.getpid(), "tid": tid,
             "args": {"parent": parent} if parent else {},

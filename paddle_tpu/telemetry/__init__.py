@@ -1,0 +1,144 @@
+"""paddle_tpu.telemetry — unified training telemetry (ISSUE 3).
+
+The framework-wide observability spine: a labeled metrics registry
+(Counter / Gauge / Histogram), exporters (Prometheus text, JSONL event
+log, chrome-trace counter merge), and a ``scope(run_dir)`` context that
+wires registry + profiler + sink together for a run.
+
+Hot-path contract: instrumentation sites (engine.train_step, dataloader,
+checkpoint, collectives) call ``telemetry.enabled()`` first — a single
+module-global read — and only touch the registry when it returns True.
+Metrics themselves are recorded host-side around jitted calls, never
+inside traces.  ``monitor.StatValue`` is a thin bridge onto this
+registry (one source of truth).
+
+Typical use::
+
+    with paddle_tpu.telemetry.scope("runs/gpt13b") as tel:
+        trainer.compile(inputs, labels)
+        for batch in loader:
+            trainer.train_step(*batch)
+    # -> runs/gpt13b/{events.jsonl, metrics.prom, trace.json}
+
+Metric catalogue (recorded by the built-in instrumentation; see README
+"Telemetry" for label conventions):
+
+=============================  =========  =================================
+name                           kind       source
+=============================  =========  =================================
+step_time_seconds              histogram  engine.train_step / hapi callback
+stage_time_seconds             histogram  engine._stage cache miss
+compile_time_seconds           histogram  engine.compile
+recompiles_total               counter    engine._stage misses + jit shape
+                                          misses
+tokens_per_sec                 gauge      engine.train_step
+mfu                            gauge      analysis.cost FLOPs / step time /
+                                          peak_flops_per_sec()
+peak_live_bytes                gauge      analysis.cost over the staged step
+donated_bytes                  gauge      donated state (params+opt+residual)
+grad_sync_bytes_total          counter    logical wire bytes {policy=...}
+grad_sync_compression_x        gauge      fp32 bytes / policy bytes
+grad_sync_residual_norm        gauge      int8 error-feedback residual L2
+collective_calls_total         counter    collective.py, trace time {op=...}
+dataloader_fetch_seconds       histogram  io.DataLoader batch fetch
+checkpoint_save_seconds        histogram  distributed.checkpoint
+checkpoint_restore_seconds     histogram  distributed.checkpoint
+checkpoint_bytes_total         counter    distributed.checkpoint {op=...}
+=============================  =========  =================================
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from .metrics import (Counter, DEFAULT_BUCKETS, Gauge, Histogram,  # noqa: F401
+                      Registry)
+from .scope import TelemetryScope, scope  # noqa: F401
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "DEFAULT_BUCKETS",
+    "scope", "TelemetryScope",
+    "enable", "disable", "enabled", "is_enabled",
+    "get_registry", "counter", "gauge", "histogram",
+    "prometheus_text", "emit", "peak_flops_per_sec",
+]
+
+_enabled = False
+_registry = Registry()
+_sink = None  # active JsonlSink, installed by scope(run_dir=...)
+
+
+def enable(on: bool = True):
+    """Turn the instrumentation sites on (or off with ``enable(False)``)."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def disable():
+    enable(False)
+
+
+def enabled() -> bool:
+    """The one check every instrumentation site makes per event."""
+    return _enabled
+
+
+is_enabled = enabled
+
+
+def get_registry() -> Registry:
+    return _registry
+
+
+def _set_registry(reg: Registry):
+    global _registry
+    _registry = reg
+
+
+def _set_sink(sink):
+    global _sink
+    _sink = sink
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return _registry.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return _registry.gauge(name, help)
+
+
+def histogram(name: str, help: str = "", buckets=None) -> Histogram:
+    return _registry.histogram(name, help, buckets=buckets)
+
+
+def prometheus_text(registry: Optional[Registry] = None) -> str:
+    from .export import prometheus_text as _pt
+    return _pt(registry if registry is not None else _registry)
+
+
+def emit(event: str, **fields):
+    """Append an event to the run's JSONL log (no-op outside scope(run_dir))."""
+    s = _sink
+    if s is not None:
+        s.emit({"event": event, "ts": time.time(), **fields})
+
+
+def peak_flops_per_sec() -> float:
+    """Hardware peak used as the MFU denominator.
+
+    Override with ``PADDLE_TPU_PEAK_FLOPS`` (e.g. per-chip bf16 peak of
+    the actual slice); defaults to the v5e bf16 peak on TPU and a nominal
+    1 TFLOP/s elsewhere so MFU stays a positive, comparable-within-a-run
+    number on CPU test meshes.
+    """
+    env = os.environ.get("PADDLE_TPU_PEAK_FLOPS")
+    if env:
+        return float(env)
+    try:
+        import jax
+        backend = jax.default_backend()
+    except Exception:  # pragma: no cover - jax always present in-repo
+        backend = "cpu"
+    return 197e12 if backend == "tpu" else 1e12

@@ -53,6 +53,12 @@ def test_bench_parent_orchestration_all_configs_cpu():
     sweep = res["extra"]["gpt_base"]["sweep"]
     assert set(sweep) == {"fused_b4", "dense_b4", "fused_b4_int8dp"}
     assert res["extra"]["gpt_base"]["variant"] in sweep
+    # telemetry harvested from the winning variant's scoped registry
+    tel = res["extra"]["gpt_base"]["telemetry"]
+    assert tel["recompiles"] >= 1
+    assert tel["mfu"] > 0
+    assert tel["step_time_avg_s"] > 0
+    assert tel["wire_bytes"] >= 0  # 0 on the single-device CPU data mesh
 
 
 def test_bench_child_failure_is_isolated():
@@ -77,6 +83,27 @@ def test_bench_parent_timeout_path():
         sys.path.remove(REPO)
     assert payload is None
     assert "timed out" in err
+
+
+def test_bench_collectives_smoke_telemetry():
+    """tools/bench_collectives.py --smoke: tiny shapes, telemetry wired
+    through telemetry.scope, wire-byte counters asserted in-process and
+    re-checked here from the one-line JSON contract."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_collectives.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=600, env=_env())
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    res = json.loads(lines[-1])
+    assert res["metric"] == "int8_vs_fp32_bytes_x"
+    assert res["value"] > 1.0
+    extra = res["extra"]
+    assert extra["smoke"] is True
+    wb = extra["telemetry"]["wire_bytes"]
+    assert wb["int8"] > 0
+    assert wb["fp32"] > wb["int8"]
+    assert extra["telemetry"]["prometheus_bytes"] > 0
 
 
 def test_numerics_smoke_cpu():

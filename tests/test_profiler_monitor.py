@@ -43,6 +43,72 @@ def test_profiler_context_and_disabled():
     assert not profiler.is_profiler_enabled()
 
 
+def test_record_event_begun_before_start_not_recorded():
+    """Pair-safety: a begin() while the profiler is off is inert — an end()
+    after start_profiler must not write a garbage range into the new
+    session (ISSUE 3 satellite)."""
+    profiler.reset_profiler()
+    ev = profiler.RecordEvent("orphan")
+    ev.begin()                       # disabled: no-op
+    profiler.start_profiler("CPU")
+    ev.end()                         # must not record
+    try:
+        assert all(e["name"] != "orphan" for e in profiler.get_events())
+    finally:
+        profiler.stop_profiler(profile_path="", verbose=False)
+
+
+def test_record_event_spanning_stop_start_not_recorded():
+    """A range begun in session A whose end() arrives in session B is
+    dropped (previously its stale timestamps landed in B's event list)."""
+    profiler.start_profiler("CPU")
+    ev = profiler.RecordEvent("spanning").begin()
+    profiler.stop_profiler(profile_path="", verbose=False)
+    profiler.start_profiler("CPU")
+    ev.end()                         # session changed under it: dropped
+    inner = profiler.RecordEvent("inner")
+    with inner:
+        pass
+    events = profiler.get_events()
+    profiler.stop_profiler(profile_path="", verbose=False)
+    names = [e["name"] for e in events]
+    assert "spanning" not in names
+    assert "inner" in names
+    # the dead event also must not linger on the nesting stack as a parent
+    assert next(e for e in events if e["name"] == "inner")["parent"] == ""
+
+
+def test_record_event_non_lifo_end_order():
+    """Identity-based stack removal: ending the OUTER event first must not
+    pop the inner one's entry (the old index-pop recorded wrong parents)."""
+    profiler.start_profiler("CPU")
+    outer = profiler.RecordEvent("outer").begin()
+    inner = profiler.RecordEvent("inner").begin()
+    outer.end()
+    inner.end()
+    events = {e["name"]: e for e in profiler.get_events()}
+    profiler.stop_profiler(profile_path="", verbose=False)
+    assert set(events) == {"outer", "inner"}
+    assert events["outer"]["parent"] == "inner"   # still nested at its end
+    assert events["inner"]["parent"] == ""
+
+
+def test_export_chrome_tracing_clamps_negative_ts(tmp_path):
+    """An event whose begin predates _start_wall_ns (stale session data)
+    must not export a negative ts — chrome silently drops those."""
+    profiler.start_profiler("CPU")
+    with profiler.RecordEvent("ok"):
+        pass
+    with profiler._lock:
+        early = profiler._start_wall_ns - 5_000_000   # 5ms before start
+        profiler._events.append(("early", "", early, early + 1_000_000, 0))
+    out = tmp_path / "trace.json"
+    profiler.stop_profiler(profile_path=str(out), verbose=False)
+    trace = json.loads(out.read_text())
+    assert {e["name"] for e in trace["traceEvents"]} == {"ok", "early"}
+    assert all(e["ts"] >= 0 for e in trace["traceEvents"])
+
+
 def test_monitor_gauges():
     g = monitor.stat("STAT_test_mem")
     g.reset()
@@ -63,3 +129,15 @@ def test_monitor_gauges():
     assert g.get() == 7 + 4000
     g.reset()
     assert g.get() == 0
+
+
+def test_monitor_stat_exports_via_telemetry():
+    """monitor.StatValue is a bridge onto the telemetry registry: its value
+    shows up in the Prometheus text of the current default registry."""
+    from paddle_tpu import telemetry
+    g = monitor.stat("STAT_prom_bridge")
+    g.reset()
+    g.set(42)
+    assert telemetry.get_registry().get("STAT_prom_bridge").value() == 42.0
+    assert "STAT_prom_bridge 42" in telemetry.prometheus_text()
+    g.reset()

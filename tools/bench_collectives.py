@@ -19,6 +19,8 @@ Usage:
     python tools/bench_collectives.py                     # defaults
     python tools/bench_collectives.py --numel 4194304 --devices 4 \
         --block 256 --bucket-mb 4 --iters 20
+    python tools/bench_collectives.py --smoke   # tiny shapes + telemetry
+                                                # self-check (CI)
 """
 from __future__ import annotations
 
@@ -39,7 +41,13 @@ def main():
                     help="flat bucket size in MiB")
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes + telemetry self-check; asserts the "
+                         "registry saw the per-policy wire-byte counters")
     args = ap.parse_args()
+    if args.smoke:
+        args.numel, args.devices, args.block = 4096, 2, 64
+        args.iters, args.warmup = 2, 1
 
     from _mesh_setup import (data_mesh, ensure_repo_on_path,
                              force_host_devices)
@@ -50,6 +58,7 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    from paddle_tpu import telemetry
     from paddle_tpu.distributed.compressed import (
         bucket_sizes, compressed_tree_mean, init_residuals,
         wire_bytes_per_rank)
@@ -69,6 +78,9 @@ def main():
                            NamedSharding(mesh, P("data", None)))
     exact = g.mean(axis=0)
 
+    tel_cm = telemetry.scope(profile=False)
+    tel = tel_cm.__enter__()
+    reg = tel.registry
     extra = {}
     for policy in ("fp32", "bf16", "int8"):
         residuals = {"g": jnp.zeros((n, numel), jnp.float32)} \
@@ -107,9 +119,16 @@ def main():
         got = np.asarray(out)[0]
         rel = float(np.abs(got - exact).max() /
                     (np.abs(exact).max() + 1e-12))
+        wire = wire_bytes_per_rank(numel, n, policy, block=args.block)
+        telemetry.counter(
+            "grad_sync_bytes_total",
+            "logical wire bytes per rank of the bucketed grad "
+            "exchange").inc(wire * args.iters, policy=policy)
+        telemetry.histogram(
+            "grad_sync_exchange_seconds",
+            "one compressed_tree_mean wall time").observe(dt, policy=policy)
         extra[policy] = {
-            "wire_bytes_per_rank": wire_bytes_per_rank(
-                numel, n, policy, block=args.block),
+            "wire_bytes_per_rank": wire,
             "ms_per_exchange": round(dt * 1e3, 3),
             "ms_per_bucket": round(dt * 1e3 / nbuckets, 3),
             "buckets": nbuckets,
@@ -118,13 +137,25 @@ def main():
 
     ratio = (extra["fp32"]["wire_bytes_per_rank"] /
              max(extra["int8"]["wire_bytes_per_rank"], 1e-9))
+    extra["telemetry"] = {
+        "wire_bytes": {p: reg.get("grad_sync_bytes_total").value(policy=p)
+                       for p in ("fp32", "bf16", "int8")},
+        "prometheus_bytes": len(telemetry.prometheus_text(reg)),
+    }
+    tel_cm.__exit__(None, None, None)
+    if args.smoke:
+        prom = telemetry.prometheus_text(reg)
+        wb = extra["telemetry"]["wire_bytes"]
+        assert "grad_sync_bytes_total" in prom, "telemetry missing metric"
+        assert wb["int8"] > 0 and wb["fp32"] > wb["int8"], wb
     print(json.dumps({
         "metric": "int8_vs_fp32_bytes_x",
         "value": round(ratio, 3),
         "unit": "x",
         "vs_baseline": 1.0,
         "extra": {"numel": numel, "devices": n, "block": args.block,
-                  "bucket_mb": args.bucket_mb, **extra},
+                  "bucket_mb": args.bucket_mb, "smoke": bool(args.smoke),
+                  **extra},
     }))
 
 
