@@ -145,30 +145,38 @@ class GradScaler:
     @staticmethod
     def _unscale_dict(grads, inv):
         """Shared by unscale_ and unscale_and_update: multiply every grad
-        by ``inv`` and report whether any was non-finite (traced bool)."""
-        flat = [jnp.all(jnp.isfinite(g)) for g in grads.values()
-                if g is not None]
-        finite = jnp.all(jnp.stack(flat)) if flat else jnp.asarray(True)
+        by ``inv`` and report whether any was non-finite (traced bool) —
+        ONE fused all-finite reduction (resilience.guard), the in-graph
+        equivalent of check_finite_and_unscale_op's single kernel."""
+        from ..resilience.guard import all_finite
         inv = jnp.asarray(inv, jnp.float32)
-        return ({k: None if g is None else g * inv.astype(g.dtype)
-                 for k, g in grads.items()}, ~finite)
+        unscaled = {k: None if g is None else g * inv.astype(g.dtype)
+                    for k, g in grads.items()}
+        return unscaled, ~all_finite(unscaled)
 
     def unscale_(self, grads_or_optimizer):
         """Unscale grads; detect non-finite. Accepts a dict of grads (returns
-        (unscaled, found_inf)) or an optimizer (unscales Parameter.grad)."""
+        (unscaled, found_inf)) or an optimizer (unscales Parameter.grad).
+
+        The optimizer path checks all grads with ONE jitted stacked
+        reduction and a single device sync — the per-parameter
+        ``bool(jnp.all(jnp.isfinite(g)))`` loop it replaces paid one
+        blocking sync per leaf."""
         if isinstance(grads_or_optimizer, dict):
             return self._unscale_dict(grads_or_optimizer, 1.0 / self._scale)
         opt = grads_or_optimizer
         if self._already_unscaled:
             return self._found_inf
+        from ..resilience.guard import all_finite_value
         inv = 1.0 / self._scale
-        found = False
-        for p in opt._parameter_list or []:
+        unscaled = {}
+        for i, p in enumerate(opt._parameter_list or []):
             if p.grad is not None:
-                g = p.grad * inv
-                if not bool(jnp.all(jnp.isfinite(g))):
-                    found = True
-                p.grad = g
+                unscaled[i] = p.grad * inv
+        found = not all_finite_value(unscaled)   # one host sync, total
+        for i, p in enumerate(opt._parameter_list or []):
+            if p.grad is not None:
+                p.grad = unscaled[i]
         self._found_inf = found
         self._already_unscaled = True
         return found
@@ -211,6 +219,25 @@ class GradScaler:
         """Scale a loss by the live (traced) scale from the state pytree."""
         return loss * scale_state["scale"].astype(loss.dtype)
 
+    def update_scale_state(self, scale_state, found_inf):
+        """Pure incr/decr policy: (scale_state, traced found_inf bool) →
+        new scale_state — the jnp.where translation of update(). Shared by
+        unscale_and_update and the engine's in-step NaN guard (which feeds
+        it the guard's own fused finite check)."""
+        if not (self._enable and self._dynamic):  # same gate as update()
+            return scale_state
+        scale = scale_state["scale"]
+        bad = jnp.where(found_inf, scale_state["bad"] + 1, 0)
+        good = jnp.where(found_inf, 0, scale_state["good"] + 1)
+        decr = bad >= self._decr_every
+        incr = good >= self._incr_every
+        new_scale = jnp.where(
+            decr, jnp.maximum(scale * self._decr_ratio, 1.0),
+            jnp.where(incr, scale * self._incr_ratio, scale))
+        return {"scale": new_scale,
+                "good": jnp.where(incr, 0, good),
+                "bad": jnp.where(decr, 0, bad)}
+
     def unscale_and_update(self, grads, scale_state):
         """Pure: (grads dict, scale_state) → (unscaled, found_inf, new_state).
 
@@ -218,21 +245,8 @@ class GradScaler:
         applies the same incr/decr policy as update() with jnp.where so the
         scale actually moves across jitted steps.
         """
-        scale = scale_state["scale"]
-        unscaled, found = self._unscale_dict(grads, 1.0 / scale)
-        if not (self._enable and self._dynamic):  # same gate as update()
-            return unscaled, found, scale_state
-        bad = jnp.where(found, scale_state["bad"] + 1, 0)
-        good = jnp.where(found, 0, scale_state["good"] + 1)
-        decr = bad >= self._decr_every
-        incr = good >= self._incr_every
-        new_scale = jnp.where(
-            decr, jnp.maximum(scale * self._decr_ratio, 1.0),
-            jnp.where(incr, scale * self._incr_ratio, scale))
-        new_state = {"scale": new_scale,
-                     "good": jnp.where(incr, 0, good),
-                     "bad": jnp.where(decr, 0, bad)}
-        return unscaled, found, new_state
+        unscaled, found = self._unscale_dict(grads, 1.0 / scale_state["scale"])
+        return unscaled, found, self.update_scale_state(scale_state, found)
 
     def step(self, optimizer):
         found = self.unscale_(optimizer)

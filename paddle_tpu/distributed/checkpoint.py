@@ -14,17 +14,34 @@ Capability map (reference):
 Async: orbax's async checkpointer overlaps the device→host gather and file
 write with training (the reference's PS tier saved asynchronously via its
 own threads; XLA-side this is the idiomatic equivalent).
+
+Crash consistency: orbax already writes each step into a temp dir and
+atomically renames it, but a crash BETWEEN the rename and the end of the
+file writes' journey to stable storage (or plain on-disk rot) can still
+leave a step directory that lists as present yet does not restore. The
+manager therefore runs a two-phase commit on top: after the write
+completes it fsyncs every file, records a CRC32-checksum ``MANIFEST.json``
+(tmp + fsync + atomic rename + dir fsync), and only then counts the step
+as committed. ``restore()`` verifies the manifest and falls back to the
+newest step that checks out (counting ``ckpt_restore_fallbacks_total``);
+retention GC runs only after a verified commit and never removes the last
+valid step.
 """
 from __future__ import annotations
 
+import json
 import os
 import time
-from typing import Any, Optional
+import zlib
+from typing import Any, List, Optional
 
 import jax
 
 __all__ = ["save_checkpoint", "load_checkpoint", "CheckpointManager",
-           "TrainEpochRange", "train_epoch_range"]
+           "TrainEpochRange", "train_epoch_range",
+           "write_manifest", "verify_manifest", "MANIFEST_NAME"]
+
+MANIFEST_NAME = "MANIFEST.json"
 
 
 _cached = {}  # one checkpointer per mode: async saves barrier on reuse
@@ -60,6 +77,100 @@ def _checkpointer(use_async: bool):
     return _cached[key]
 
 
+# -- checksum manifest (two-phase commit) -----------------------------------
+
+def _fsync_file(path: str):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str):
+    try:
+        _fsync_file(path)
+    except OSError:
+        pass  # some filesystems refuse directory fsync; rename is still atomic
+
+
+def _crc_file(path: str, chunk: int = 1 << 20) -> int:
+    c = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            c = zlib.crc32(b, c)
+    return c & 0xFFFFFFFF
+
+
+def write_manifest(step_dir: str) -> dict:
+    """Commit marker: fsync every file under ``step_dir``, then atomically
+    write a CRC32/size manifest. The manifest is written LAST (tmp + fsync +
+    rename + dir fsync), so its presence proves every byte it attests to
+    reached stable storage — a kill -9 at any point leaves either no
+    manifest (step invalid, restore falls back) or a complete one."""
+    files = {}
+    for root, _dirs, names in os.walk(step_dir):
+        for n in sorted(names):
+            if n in (MANIFEST_NAME, MANIFEST_NAME + ".tmp"):
+                continue
+            p = os.path.join(root, n)
+            _fsync_file(p)  # durability BEFORE attestation
+            files[os.path.relpath(p, step_dir)] = {
+                "size": os.path.getsize(p), "crc32": _crc_file(p)}
+    manifest = {"version": 1, "files": files}
+    tmp = os.path.join(step_dir, MANIFEST_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(step_dir, MANIFEST_NAME))
+    _fsync_dir(step_dir)
+    return manifest
+
+
+def verify_manifest(step_dir: str) -> Optional[bool]:
+    """Three-valued: ``True`` — manifest present and every attested file
+    matches size+CRC; ``False`` — manifest present but unreadable, or a file
+    is missing/corrupt (torn checkpoint); ``None`` — no manifest (a legacy
+    checkpoint from before this commit protocol; restore attempts it and
+    relies on orbax's own errors)."""
+    mpath = os.path.join(step_dir, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        return None
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return False
+    for rel, meta in manifest.get("files", {}).items():
+        p = os.path.join(step_dir, rel)
+        try:
+            if os.path.getsize(p) != meta["size"] or \
+                    _crc_file(p) != meta["crc32"]:
+                return False
+        except OSError:
+            return False
+    return True
+
+
+def _corrupt_one_file(step_dir: str):
+    """Fault-injection helper (ckpt_torn): truncate the largest data file —
+    what a machine loss mid-flush leaves behind."""
+    best, size = None, -1
+    for root, _dirs, names in os.walk(step_dir):
+        for n in names:
+            p = os.path.join(root, n)
+            s = os.path.getsize(p)
+            if s > size:
+                best, size = p, s
+    if best is not None:
+        with open(best, "r+b") as f:
+            f.truncate(max(1, size // 2))
+
+
 def save_checkpoint(path: str, state: Any, overwrite: bool = True,
                     use_async: bool = False):
     """Save a pytree of (possibly sharded) jax arrays. Each host writes only
@@ -68,10 +179,17 @@ def save_checkpoint(path: str, state: Any, overwrite: bool = True,
     in-flight one (no torn writes) — call ``wait_until_finished`` on the
     returned checkpointer before process exit."""
     import orbax.checkpoint as ocp
+    from ..resilience import faults
+    from ..resilience.retry import call_with_retry
     ckptr = _checkpointer(use_async)
     t0 = time.perf_counter()
-    ckptr.save(os.path.abspath(path), args=ocp.args.StandardSave(state),
-               force=overwrite)
+
+    def _write():
+        faults.maybe_raise("ckpt_io", msg="injected ckpt_io on save")
+        ckptr.save(os.path.abspath(path), args=ocp.args.StandardSave(state),
+                   force=overwrite)
+
+    call_with_retry(_write, site="ckpt_save", tries=3, base_delay=0.01)
     _record("save", time.perf_counter() - t0, state)
     return ckptr
 
@@ -81,19 +199,24 @@ def load_checkpoint(path: str, template: Optional[Any] = None):
     ShapeDtypeStruct with .sharding) restores each leaf sharded directly to
     its devices; without it, arrays land replicated on the default device."""
     import orbax.checkpoint as ocp
+    from ..resilience.retry import call_with_retry
     ckptr = _checkpointer(False)
     t0 = time.perf_counter()
-    if template is not None:
-        abstract = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(
-                x.shape, x.dtype,
-                sharding=getattr(x, "sharding", None)) if hasattr(x, "shape")
-            else x,
-            template)
-        out = ckptr.restore(os.path.abspath(path),
-                            args=ocp.args.StandardRestore(abstract))
-    else:
-        out = ckptr.restore(os.path.abspath(path))
+
+    def _read():
+        if template is not None:
+            abstract = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype,
+                    sharding=getattr(x, "sharding", None))
+                if hasattr(x, "shape") else x,
+                template)
+            return ckptr.restore(os.path.abspath(path),
+                                 args=ocp.args.StandardRestore(abstract))
+        return ckptr.restore(os.path.abspath(path))
+
+    out = call_with_retry(_read, site="ckpt_restore", tries=2,
+                          base_delay=0.01)
     _record("restore", time.perf_counter() - t0, out)
     return out
 
@@ -101,66 +224,210 @@ def load_checkpoint(path: str, template: Optional[Any] = None):
 class CheckpointManager:
     """Step-numbered checkpoints with retention + save-interval policy
     (reference capability: ModelCheckpoint callback hapi/callbacks.py:533 +
-    auto_checkpoint retention)."""
+    auto_checkpoint retention), hardened with a two-phase commit:
+
+    1. write — orbax writes the step (tmp dir + atomic rename), possibly
+       async; the step is tracked as *pending*.
+    2. commit — after the write finishes, every file is fsynced and a CRC32
+       ``MANIFEST.json`` is atomically recorded; only then does retention GC
+       run. GC keeps the newest ``max_to_keep`` VALID steps and never
+       removes the last valid one, so a torn newest step can always fall
+       back to a good predecessor.
+
+    ``restore()`` (no explicit step) scans newest→oldest, skipping steps
+    that fail verification or error mid-restore, counting each skip in
+    ``ckpt_restore_fallbacks_total``.
+    """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  save_interval_steps: int = 1, use_async: bool = True):
         import orbax.checkpoint as ocp
+        self._dir = os.path.abspath(directory)
+        self._max_to_keep = max_to_keep
+        self._use_async = use_async
+        # retention is OURS (post-commit, validity-aware): orbax counting
+        # torn steps toward max_to_keep could GC the last valid one.
         self._mngr = ocp.CheckpointManager(
-            os.path.abspath(directory),
+            self._dir,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep,
+                max_to_keep=None,
                 save_interval_steps=save_interval_steps,
                 enable_async_checkpointing=use_async))
+        self._pending: List[int] = []   # written (maybe in flight), no manifest yet
+        self._vcache = {}               # step -> verify_manifest result
+        self.restore_fallbacks_total = 0   # corrupt steps skipped over
+        self.last_restored_step: Optional[int] = None
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self._dir, str(step))
+
+    def _verify(self, step: int) -> Optional[bool]:
+        if step not in self._vcache:
+            self._vcache[step] = verify_manifest(self._step_dir(step))
+        return self._vcache[step]
+
+    def _commit_pending(self):
+        """Phase 2: barrier on in-flight writes, manifest each pending step,
+        then GC. An injected ``ckpt_torn`` fault corrupts the step and skips
+        its manifest before raising SimulatedCrash — the kill -9 window."""
+        if not self._pending:
+            return
+        self._mngr.wait_until_finished()
+        from ..resilience import faults
+        while self._pending:
+            step = self._pending.pop(0)
+            sdir = self._step_dir(step)
+            if faults.fires("ckpt_torn", step=step):
+                _corrupt_one_file(sdir)
+                self._vcache.pop(step, None)
+                raise faults.SimulatedCrash(
+                    f"simulated kill -9 committing checkpoint step {step}")
+            if os.path.isdir(sdir):
+                write_manifest(sdir)
+                self._vcache[step] = True
+        self._gc()
+
+    def _gc(self):
+        """Retention, run only after a verified commit. Keeps the newest
+        ``max_to_keep`` valid steps; steps that fail verification and fall
+        outside the kept window are deleted too (torn debris), but if
+        NOTHING verifies, nothing is deleted."""
+        if not self._max_to_keep:
+            return
+        steps = sorted(self._mngr.all_steps() or [])
+        valid = [s for s in steps if self._verify(s) is not False]
+        if not valid:
+            return
+        keep = set(valid[-self._max_to_keep:])
+        for s in steps:
+            if s in keep or s in self._pending:
+                continue
+            try:
+                self._mngr.delete(s)
+            except Exception:
+                continue
+            self._vcache.pop(s, None)
 
     def save(self, step: int, state: Any) -> bool:
         import numpy as np
         import orbax.checkpoint as ocp
+        from ..resilience import faults
+        from ..resilience.retry import call_with_retry
         # numpy scalars (np.int32(3) etc.) are not in orbax's supported
         # leaf types — promote them to 0-d ndarrays
         state = jax.tree_util.tree_map(
             lambda x: np.asarray(x) if isinstance(x, np.generic) else x,
             state)
+        self._commit_pending()  # previous async write: barrier + manifest
+        if step in (self._mngr.all_steps() or []):
+            # a restart legitimately replays the step it crashed in — clear
+            # the stale (possibly torn) attempt so orbax doesn't refuse
+            self._mngr.delete(step)
+            self._vcache.pop(step, None)
+
+        def _write():
+            faults.maybe_raise("ckpt_io", step=step,
+                               msg=f"injected ckpt_io at step {step}")
+            return self._mngr.save(step, args=ocp.args.StandardSave(state))
+
         t0 = time.perf_counter()
-        saved = self._mngr.save(step, args=ocp.args.StandardSave(state))
+        saved = call_with_retry(_write, site="ckpt_save", tries=3,
+                                base_delay=0.01)
         if saved:  # interval-skipped saves shouldn't pollute the histogram
+            self._pending.append(step)
+            if not self._use_async:
+                self._commit_pending()
             _record("save", time.perf_counter() - t0, state)
         return saved
 
-    def restore(self, step: Optional[int] = None,
-                template: Optional[Any] = None):
+    def _restore_step(self, step: int, template: Optional[Any]):
         import orbax.checkpoint as ocp
-        step = self.latest_step() if step is None else step
-        if step is None:
-            return None
-        t0 = time.perf_counter()
         if template is not None:
             abstract = jax.tree_util.tree_map(
                 lambda x: jax.ShapeDtypeStruct(
                     x.shape, x.dtype, sharding=getattr(x, "sharding", None))
                 if hasattr(x, "shape") else x, template)
-            out = self._mngr.restore(
+            return self._mngr.restore(
                 step, args=ocp.args.StandardRestore(abstract))
-        else:
-            # installed orbax refuses a bare restore (no registered handler
-            # for the saved "default" item) — an explicit StandardRestore
-            # with no abstract tree restores everything replicated on the
-            # host
-            out = self._mngr.restore(step, args=ocp.args.StandardRestore())
-        _record("restore", time.perf_counter() - t0, out)
-        return out
+        # installed orbax refuses a bare restore (no registered handler
+        # for the saved "default" item) — an explicit StandardRestore
+        # with no abstract tree restores everything replicated on the
+        # host
+        return self._mngr.restore(step, args=ocp.args.StandardRestore())
+
+    def _count_fallbacks(self, n: int):
+        if not n:
+            return
+        self.restore_fallbacks_total += n
+        from .. import telemetry
+        if telemetry.enabled():
+            telemetry.counter(
+                "ckpt_restore_fallbacks_total",
+                "restores that skipped corrupt/torn checkpoints").inc(n)
+
+    def restore(self, step: Optional[int] = None,
+                template: Optional[Any] = None):
+        from ..resilience.retry import call_with_retry
+        self._commit_pending()
+        if step is not None:  # explicit step: verify, no fallback
+            # re-verify from disk (not the cache): restore is rare and this
+            # catches rot that happened after the commit
+            self._vcache.pop(step, None)
+            if self._verify(step) is False:
+                raise OSError(
+                    f"checkpoint step {step} failed manifest verification")
+            t0 = time.perf_counter()
+            out = call_with_retry(self._restore_step, step, template,
+                                  site="ckpt_restore", tries=2,
+                                  base_delay=0.01)
+            _record("restore", time.perf_counter() - t0, out)
+            self.last_restored_step = step
+            return out
+        fallbacks = 0
+        for s in sorted(self._mngr.all_steps() or [], reverse=True):
+            self._vcache.pop(s, None)
+            if self._verify(s) is False:
+                fallbacks += 1
+                continue
+            try:
+                t0 = time.perf_counter()
+                out = call_with_retry(self._restore_step, s, template,
+                                      site="ckpt_restore", tries=2,
+                                      base_delay=0.01)
+            except Exception:
+                # no manifest (legacy) or rot the manifest couldn't see —
+                # orbax/tensorstore raised; fall back to an older step
+                fallbacks += 1
+                continue
+            _record("restore", time.perf_counter() - t0, out)
+            self._count_fallbacks(fallbacks)
+            self.last_restored_step = s
+            return out
+        self._count_fallbacks(fallbacks)
+        return None
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
+
+    def latest_valid_step(self) -> Optional[int]:
+        """Newest step that passes (or predates) manifest verification."""
+        for s in sorted(self._mngr.all_steps() or [], reverse=True):
+            if self._verify(s) is not False:
+                return s
+        return None
 
     def all_steps(self):
         return self._mngr.all_steps()
 
     def wait_until_finished(self):
         self._mngr.wait_until_finished()
+        self._commit_pending()
 
     def close(self):
-        self._mngr.close()
+        try:
+            self._commit_pending()
+        finally:
+            self._mngr.close()
 
 
 class TrainEpochRange:

@@ -37,6 +37,7 @@ from .. import profiler as _profiler
 from .. import telemetry as _telemetry
 from ..framework.random import get_rng_key
 from ..jit.functionalization import functional_call, state_of
+from ..resilience.guard import all_finite
 from .compressed import compressed_tree_mean
 from .mesh import require_mesh
 from .meta_parallel.pipeline_parallel import PipelineParallel
@@ -71,7 +72,9 @@ class ParallelTrainer:
                  fp16_allreduce: bool = False,
                  grad_sync: Optional[str] = None,
                  grad_sync_block: int = 256,
-                 grad_sync_bucket_bytes: int = 4 << 20):
+                 grad_sync_bucket_bytes: int = 4 << 20,
+                 nan_guard: bool = True,
+                 scaler=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -79,6 +82,19 @@ class ParallelTrainer:
         self.micro_batches = micro_batches
         self.remat = remat
         self.zero_stage = zero_stage
+        # In-step NaN/Inf guard (reference: check_finite_and_unscale +
+        # update_loss_scaling IN the graph): one fused all-finite reduction
+        # over the exchanged grads decides — via jnp.where, no host sync,
+        # no recompile — whether this step's param/opt/comm_err update
+        # applies or is skipped, bumping the skipped-steps counter carried
+        # in state["guard"].
+        self.nan_guard = nan_guard
+        # Optional amp.GradScaler: its functional scale state rides in
+        # state["guard"]["amp"]; the traced step scales the loss, unscales
+        # the grads, and applies the dynamic incr/decr policy off the same
+        # finite flag the guard uses.
+        self.scaler = scaler if (scaler is not None
+                                 and scaler.is_enable()) else None
         # gradient-exchange policy (distributed/compressed.py): the DP grad
         # sync is a bucketed flat exchange — "fp32" exact, "bf16" half the
         # wire bytes (reference fp16_allreduce_optimizer.py), "int8" the
@@ -213,8 +229,16 @@ class ParallelTrainer:
                 self.comm_err_specs[k] = spec
                 comm_err[k] = put(
                     jnp.zeros((R,) + jnp.shape(v), jnp.float32), spec)
+        # guard state: replicated scalars threaded through the jitted step
+        # (skipped-step counter; plus the loss-scale state when a scaler is
+        # attached) — in state so checkpoints carry it.
+        guard = {"skipped": put(jnp.zeros((), jnp.int32), P())}
+        if self.scaler is not None:
+            guard["amp"] = jax.tree_util.tree_map(
+                lambda v: put(v, P()), self.scaler.init_scale_state())
         self.state = {"params": params, "buffers": buffers,
-                      "opt": opt_state, "comm_err": comm_err}
+                      "opt": opt_state, "comm_err": comm_err,
+                      "guard": guard}
 
     def _slot_specs(self, opt_state, params, n_shard):
         """Sharding specs for the optimizer state.
@@ -318,7 +342,16 @@ class ParallelTrainer:
             # update) runs identically at any device count
             sync_axes = live_axes
 
-        def grads_fn(params, buffers, comm_err, key, inputs, labels):
+        # loss scaling (scaler attached): the loss is scaled BEFORE the
+        # backward pass (underflow protection is in the gradient compute,
+        # scaling afterwards would be too late) and grads are unscaled
+        # before the exchange so comm_err magnitudes stay policy-stable.
+        # The pp schedules compute grads manually and skip the scaling —
+        # on bf16 TPU scale=1 anyway; the dynamic scale policy still runs
+        # off the guard's finite flag.
+        use_amp = self.scaler is not None
+
+        def grads_fn(params, buffers, comm_err, scale, key, inputs, labels):
             tparams = {k: v for k, v in params.items() if self.trainable[k]}
             frozen = {k: v for k, v in params.items() if not self.trainable[k]}
 
@@ -355,9 +388,16 @@ class ParallelTrainer:
                     for ax in reduce_axes:
                         if mesh.shape.get(ax, 1) > 1:
                             loss = lax.pmean(loss, ax)
+                    if use_amp:
+                        loss = loss * scale.astype(loss.dtype)
                     return loss
 
                 loss, grads = jax.value_and_grad(lf)(tparams)
+                if use_amp:
+                    inv = 1.0 / scale
+                    loss = loss * inv.astype(loss.dtype)
+                    grads = {k: g * inv.astype(g.dtype)
+                             for k, g in grads.items()}
             # DP grad averaging over the data axes; 'model'/'pipe' grads
             # are handled by shard_map transposition of the collectives.
             # Pipe-replicated grads are psum'd FIRST: psum/pmean commute
@@ -470,13 +510,22 @@ class ParallelTrainer:
             sharded_grads = shard_map(
                 grads_fn, mesh=mesh,
                 in_specs=(dict(self.param_specs), dict(self.buffer_specs),
-                          dict(self.comm_err_specs), P(), input_specs,
+                          dict(self.comm_err_specs), P(), P(), input_specs,
                           label_specs),
                 out_specs=(P(), dict(tspecs), dict(self.comm_err_specs)),
                 check_vma=False)
 
-            def train_step(params, buffers, opt_state, comm_err, key, lr,
-                           inputs, labels):
+            nan_guard = self.nan_guard
+            scaler = self.scaler
+
+            def train_step(params, buffers, opt_state, comm_err, guard,
+                           key, lr, taint, inputs, labels):
+                # taint: the fault-injection operand (1.0 in normal runs,
+                # NaN when faults.py poisons a grad leaf). A traced weak
+                # scalar, so flipping it never recompiles.
+                comm_err0 = comm_err
+                scale = (guard["amp"]["scale"] if use_amp
+                         else jnp.float32(1.0))
                 if K > 1:
                     # gradient merge: grads averaged over K sequential
                     # chunks (activation memory is 1/K; same numerics as
@@ -495,7 +544,7 @@ class ParallelTrainer:
                             lambda x: x[i], chunk)
                         l_i, g_i, comm_err = sharded_grads(
                             dict(params), dict(buffers), dict(comm_err),
-                            keys[i], ins_i, lbs_i)
+                            scale, keys[i], ins_i, lbs_i)
                         loss = loss + l_i / K
                         grads = g_i if grads is None else \
                             jax.tree_util.tree_map(
@@ -503,8 +552,13 @@ class ParallelTrainer:
                     grads = jax.tree_util.tree_map(lambda g: g / K, grads)
                 else:
                     loss, grads, comm_err = sharded_grads(
-                        dict(params), dict(buffers), dict(comm_err), key,
-                        inputs, labels)
+                        dict(params), dict(buffers), dict(comm_err),
+                        scale, key, inputs, labels)
+                if grads:
+                    # fault-injection surface: poison ONE grad leaf
+                    k0 = next(iter(grads))
+                    grads[k0] = grads[k0] * jnp.asarray(
+                        taint, grads[k0].dtype)
                 tparams = {k: v for k, v in params.items()
                            if self.trainable[k]}
                 new_t, new_opt = opt.apply_gradients(tparams, grads,
@@ -516,9 +570,27 @@ class ParallelTrainer:
                     lambda v, s: lax.with_sharding_constraint(
                         v, NamedSharding(mesh, s)),
                     new_opt, self.opt_specs)
-                return loss, new_params, new_opt, comm_err
+                new_guard = dict(guard)
+                if nan_guard or use_amp:
+                    # ONE fused reduction, fully in-graph: no host sync,
+                    # and the same flag serves the loss-scale policy
+                    finite = all_finite(grads)
+                    if nan_guard:
+                        def keep(new, old):
+                            return jax.tree_util.tree_map(
+                                lambda n, o: jnp.where(finite, n, o),
+                                new, old)
+                        new_params = keep(new_params, dict(params))
+                        new_opt = keep(new_opt, opt_state)
+                        comm_err = keep(comm_err, comm_err0)
+                        new_guard["skipped"] = guard["skipped"] + \
+                            (~finite).astype(jnp.int32)
+                    if use_amp:
+                        new_guard["amp"] = scaler.update_scale_state(
+                            guard["amp"], ~finite)
+                return loss, new_params, new_opt, comm_err, new_guard
 
-            return jax.jit(train_step, donate_argnums=(0, 2, 3))
+            return jax.jit(train_step, donate_argnums=(0, 2, 3, 4))
 
         self._make_step = make_step
         self._sep = sep
@@ -610,16 +682,17 @@ class ParallelTrainer:
             key_aval = jax.eval_shape(lambda: jax.random.key(0))
             args = jax.tree_util.tree_map(to_struct, (
                 self.state["params"], self.state["buffers"],
-                self.state["opt"], self.state["comm_err"]))
+                self.state["opt"], self.state["comm_err"],
+                self.state["guard"]))
             lr = float(self.optimizer.get_lr())
             closed = jax.make_jaxpr(lambda *a: step(*a))(
-                *args, key_aval, lr,
+                *args, key_aval, lr, 1.0,
                 jax.tree_util.tree_map(to_struct, inputs),
                 jax.tree_util.tree_map(to_struct, labels))
             donated = sum(
                 getattr(v, "nbytes", 0)
                 for part in (self.state["params"], self.state["opt"],
-                             self.state["comm_err"])
+                             self.state["comm_err"], self.state["guard"])
                 for v in jax.tree_util.tree_leaves(part))
             return {"flops": _cost.total_flops(closed),
                     "peak_live_bytes": _cost.peak_live_bytes(closed),
@@ -651,14 +724,14 @@ class ParallelTrainer:
         from ..framework.random import get_rng_key
         lr = self.optimizer.get_lr() if lr is None else lr
         args = (self.state["params"], self.state["buffers"],
-                self.state["opt"], self.state["comm_err"], get_rng_key(),
-                lr, inputs, labels)
+                self.state["opt"], self.state["comm_err"],
+                self.state["guard"], get_rng_key(), lr, 1.0, inputs, labels)
         closed = jax.make_jaxpr(lambda *a: step(*a))(*args)
-        # flat invar indices of jit's donate_argnums=(0, 2, 3)
+        # flat invar indices of jit's donate_argnums=(0, 2, 3, 4)
         donated, off = set(), 0
         for i, a in enumerate(args):
             n = len(jax.tree_util.tree_leaves(a))
-            if i in (0, 2, 3):
+            if i in (0, 2, 3, 4):
                 donated.update(range(off, off + n))
             off += n
         report = analysis.analyze_jaxpr(closed, mesh=self.mesh,
@@ -666,7 +739,12 @@ class ParallelTrainer:
         return step, report
 
     # -- run ----------------------------------------------------------------
-    def train_step(self, inputs, labels, lr: Optional[float] = None):
+    def train_step(self, inputs, labels, lr: Optional[float] = None,
+                   grad_taint: Optional[float] = None):
+        """One jitted step. ``grad_taint`` is the fault-injection operand:
+        a scalar multiplied into one gradient leaf inside the step (NaN
+        poisons the step; the in-graph guard must then skip the update).
+        Normal callers leave it None."""
         key = get_rng_key()
         lr = self.optimizer.get_lr() if lr is None else lr
         leaves = jax.tree_util.tree_leaves(inputs)
@@ -687,9 +765,11 @@ class ParallelTrainer:
         ev = (_profiler.RecordEvent("train_step").begin()
               if _profiler.is_profiler_enabled() else None)
         n_compiled0 = self._jit_cache_size(step) if tel else None
-        loss, new_params, new_opt, new_comm_err = step(
+        taint = 1.0 if grad_taint is None else float(grad_taint)
+        loss, new_params, new_opt, new_comm_err, new_guard = step(
             self.state["params"], self.state["buffers"], self.state["opt"],
-            self.state["comm_err"], key, lr, inputs, labels)
+            self.state["comm_err"], self.state["guard"], key, lr, taint,
+            inputs, labels)
         if tel or ev is not None:
             # the documented telemetry sync point: step wall time includes
             # device execution (loss is the last value the step produces)
@@ -699,6 +779,7 @@ class ParallelTrainer:
         self.state["params"] = new_params
         self.state["opt"] = new_opt
         self.state["comm_err"] = new_comm_err
+        self.state["guard"] = new_guard
         if tel:
             self._record_step_telemetry(
                 time.perf_counter() - t_start, inputs, step, n_compiled0)
@@ -809,6 +890,11 @@ class ParallelTrainer:
                     raise AssertionError(
                         f"param {k!r} declared replicated but devices "
                         f"{shards[0].device} and {s.device} disagree")
+
+    def skipped_steps(self) -> int:
+        """Steps the in-graph NaN guard skipped so far (ONE host sync —
+        call at checkpoint/summary boundaries, not per step)."""
+        return int(jax.device_get(self.state["guard"]["skipped"]))
 
     # -- live-state access (HeterPS hot-tier insert/evict between steps) ----
     def param_name_of(self, box) -> Optional[str]:

@@ -80,11 +80,18 @@ class ElasticManager:
 
     # -- membership ----------------------------------------------------------
     def register(self):
-        """reference :142 — announce this member; refresh = heartbeat."""
+        """reference :142 — announce this member; refresh = heartbeat.
+        The KV write is retried (site ``elastic_kv``): on shared staging
+        volumes a transient EIO here must not kill the member."""
         if not self.enable:
             return
-        with open(self._member_file(), "w") as f:
-            f.write(str(os.getpid()))
+        from ...resilience.retry import call_with_retry
+
+        def _write():
+            with open(self._member_file(), "w") as f:
+                f.write(str(os.getpid()))
+
+        call_with_retry(_write, site="elastic_kv", tries=3, base_delay=0.02)
         self._registered = True
 
     def heartbeat(self):
@@ -106,12 +113,17 @@ class ElasticManager:
             self._registered = False
 
     def hosts(self) -> List[str]:
-        """Live members (heartbeat within timeout)."""
+        """Live members (heartbeat within timeout). The directory scan is
+        retried (site ``elastic_kv``) — a transient listdir failure must
+        degrade to a delayed observation, not a RESTART decision."""
         if not self.enable:
             return []
+        from ...resilience.retry import call_with_retry
         now = time.time()
         out = []
-        for fn in os.listdir(self._dir()):
+        for fn in call_with_retry(lambda: os.listdir(self._dir()),
+                                  site="elastic_kv", tries=3,
+                                  base_delay=0.02):
             if not fn.endswith(".alive"):
                 continue
             full = os.path.join(self._dir(), fn)

@@ -185,8 +185,22 @@ class DataLoader:
             yield self._to_output(self.collate_fn(batch))
 
     def _iter_single(self):
+        from ..resilience import faults
+        from ..resilience.retry import call_with_retry
+        step = 0
         for indices in self.batch_sampler:
-            samples = [self.dataset[i] for i in indices]
+
+            def _fetch():
+                # transient source failures (remote fs hiccups, injected
+                # data_fetch faults) are retried here, not surfaced to the
+                # training loop
+                faults.maybe_raise("data_fetch", step=step,
+                                   msg="injected data_fetch in dataloader")
+                return [self.dataset[i] for i in indices]
+
+            samples = call_with_retry(_fetch, site="dataloader_fetch",
+                                      tries=3, base_delay=0.01)
+            step += 1
             yield self._to_output(self.collate_fn(samples))
 
     def __iter__(self):

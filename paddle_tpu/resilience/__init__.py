@@ -1,0 +1,24 @@
+"""Fault-resilient training runtime.
+
+Four small parts compose the recovery story (see each module's docstring):
+
+- ``faults``  — deterministic fault injection (every recovery path has a
+  reproducible trigger)
+- ``retry``   — jittered exponential backoff at the I/O seams
+- ``guard``   — fused all-finite reduction for the in-graph NaN step-guard
+  (wired into distributed.engine + amp.GradScaler)
+- ``runner``  — ``run_resilient``: auto-resume, graceful SIGTERM/SIGINT
+  drain, elastic-restart and simulated-crash recovery
+
+Crash-consistent checkpoint commits live with the checkpoint code itself
+(``distributed.checkpoint``: manifest write/verify + fallback restore).
+"""
+from . import faults  # noqa: F401
+from .faults import SimulatedCrash, inject  # noqa: F401
+from .guard import all_finite, all_finite_value  # noqa: F401
+from .retry import call_with_retry, retry  # noqa: F401
+from .runner import RunResult, run_resilient  # noqa: F401
+
+__all__ = ["faults", "SimulatedCrash", "inject", "all_finite",
+           "all_finite_value", "retry", "call_with_retry",
+           "RunResult", "run_resilient"]

@@ -1,0 +1,135 @@
+"""Deterministic fault injection — the test substrate for the resilience
+layer (reference capability: the fault tolerance the platform's elastic /
+auto-checkpoint stack is *for*; here the faults themselves are first-class
+so every recovery path has a reproducible trigger).
+
+A fault is armed with the ``inject`` context manager and fires at the
+instrumentation sites built into the framework:
+
+    with faults.inject("ckpt_torn", at_step=3):
+        run_resilient(trainer, loader, steps=10, manager=mgr)
+
+Kinds (each names the site that consults it):
+
+==============  ==========================================================
+kind            effect at the instrumented site
+==============  ==========================================================
+``ckpt_io``     ``CheckpointManager.save`` raises ``IOError`` before the
+                write (a transient filesystem hiccup; exercised by retry)
+``ckpt_torn``   the commit phase after the checkpoint write corrupts one
+                data file, skips the manifest, and raises
+                ``SimulatedCrash`` — a ``kill -9`` mid-save
+``nan_grad``    the training loop poisons one gradient leaf with NaN
+                (via the step's ``grad_taint`` operand)
+``data_fetch``  the dataloader / runner batch fetch raises ``IOError``
+``sigterm``     the runner delivers a real ``SIGTERM`` to this process
+==============  ==========================================================
+
+Determinism: ``at_step`` fires exactly when the site reports that step;
+``prob`` draws from ``random.Random`` seeded per (seed, call-index), so a
+given spec fires at the same call sites in every run. Each armed fault
+fires at most ``times`` times (default 1).
+"""
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+from typing import List, Optional
+
+__all__ = ["KINDS", "SimulatedCrash", "inject", "fires", "maybe_raise",
+           "active", "reset"]
+
+KINDS = ("ckpt_io", "ckpt_torn", "nan_grad", "data_fetch", "sigterm")
+
+
+class SimulatedCrash(RuntimeError):
+    """An injected hard crash (kill -9 analogue). Deliberately NOT an
+    OSError so retry decorators do not absorb it — only the resilient
+    runner's restart path may recover from it."""
+
+
+class _Fault:
+    def __init__(self, kind: str, at_step: Optional[int], prob: float,
+                 seed: int, times: int):
+        self.kind = kind
+        self.at_step = at_step
+        self.prob = prob
+        self.seed = seed
+        self.remaining = times
+        self.calls = 0          # site consultations of this spec
+        self.fired = 0
+
+    def should_fire(self, step: Optional[int]) -> bool:
+        if self.remaining <= 0:
+            return False
+        self.calls += 1
+        if self.at_step is not None:
+            if step is None or step != self.at_step:
+                return False
+        elif self.prob > 0.0:
+            # per-call deterministic draw — independent of global RNG state
+            draw = random.Random(self.seed * 1000003 + self.calls).random()
+            if draw >= self.prob:
+                return False
+        # at_step=None, prob=0: fire unconditionally (until times exhausted)
+        self.remaining -= 1
+        self.fired += 1
+        return True
+
+
+_lock = threading.Lock()
+_ACTIVE: List[_Fault] = []
+
+
+@contextlib.contextmanager
+def inject(kind: str, at_step: Optional[int] = None, prob: float = 0.0,
+           seed: int = 0, times: int = 1):
+    """Arm a fault for the duration of the block; yields the spec so tests
+    can assert ``spec.fired``."""
+    if kind not in KINDS:
+        raise ValueError(f"unknown fault kind {kind!r}; one of {KINDS}")
+    f = _Fault(kind, at_step, prob, seed, times)
+    with _lock:
+        _ACTIVE.append(f)
+    try:
+        yield f
+    finally:
+        with _lock:
+            _ACTIVE.remove(f)
+
+
+def active(kind: Optional[str] = None) -> bool:
+    """Any armed fault (of ``kind``) with shots remaining? Sites may use
+    this as a cheap guard before doing per-call work."""
+    with _lock:
+        return any(f.remaining > 0 and (kind is None or f.kind == kind)
+                   for f in _ACTIVE)
+
+
+def fires(kind: str, step: Optional[int] = None) -> bool:
+    """Consult the armed faults at an instrumentation site. Counts
+    ``resilience_faults_injected_total{kind=...}`` when one fires."""
+    with _lock:
+        hit = any([f.should_fire(step) for f in _ACTIVE if f.kind == kind])
+    if hit:
+        from .. import telemetry
+        if telemetry.enabled():
+            telemetry.counter(
+                "resilience_faults_injected_total",
+                "faults fired by the injection harness").inc(kind=kind)
+    return hit
+
+
+def maybe_raise(kind: str, step: Optional[int] = None, exc=IOError,
+                msg: Optional[str] = None):
+    """``fires`` that raises ``exc`` on a hit (the IOError-style kinds)."""
+    if fires(kind, step=step):
+        raise exc(msg or f"injected fault: {kind}"
+                  + (f" at step {step}" if step is not None else ""))
+
+
+def reset():
+    """Disarm everything (test teardown safety net)."""
+    with _lock:
+        _ACTIVE.clear()

@@ -1,0 +1,40 @@
+"""Fused all-finite reduction — shared by the in-step NaN guard
+(distributed/engine.py) and AmpScaler's dynamic loss scaling.
+
+The reference puts this check IN the graph (operators/amp/
+check_finite_and_unscale_op: one kernel scans every grad, one found_inf
+flag feeds update_loss_scaling). The JAX translation: stack the per-leaf
+``all(isfinite)`` partials and reduce once — under jit this is a handful
+of fused reductions with NO host sync; eagerly (``all_finite_value``) the
+whole tree costs exactly ONE device round-trip instead of the
+one-sync-per-parameter the naive loop pays.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["all_finite", "all_finite_value"]
+
+
+def all_finite(tree) -> jax.Array:
+    """Traced 0-d bool: every inexact leaf of ``tree`` is finite.
+    Non-floating leaves (int counters, bool masks) are ignored; an empty
+    tree is vacuously finite."""
+    parts = [jnp.all(jnp.isfinite(x))
+             for x in jax.tree_util.tree_leaves(tree)
+             if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact)]
+    if not parts:
+        return jnp.asarray(True)
+    if len(parts) == 1:
+        return parts[0]
+    return jnp.all(jnp.stack(parts))
+
+
+_all_finite_jit = jax.jit(all_finite)
+
+
+def all_finite_value(tree) -> bool:
+    """Eager/host form: one compiled reduction over the whole tree, one
+    device sync for the bool (the AmpScaler.unscale_ fix)."""
+    return bool(_all_finite_jit(tree))

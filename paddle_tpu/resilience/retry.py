@@ -1,0 +1,89 @@
+"""Jittered exponential backoff with timeout + telemetry.
+
+The reference platform retries at many layers (HDFS client command retry
+in fleet/utils/fs.py, etcd re-registration in fleet/elastic.py, RPC
+re-sends in the PS core). Here that policy lives in ONE decorator applied
+at the I/O seams: checkpoint save/restore, the elastic KV directory, and
+dataloader fetches.
+
+Every absorbed failure counts ``retries_total{site=...}``; giving up
+counts ``retry_exhausted_total{site=...}`` and re-raises the last error.
+Jitter is deterministic per (site, seed, attempt) so tests replay
+byte-identical schedules; ``sleep`` is injectable for zero-wall-time
+tests.
+"""
+from __future__ import annotations
+
+import functools
+import random
+import time
+import zlib
+from typing import Callable, Optional, Tuple, Type
+
+__all__ = ["retry", "call_with_retry"]
+
+
+def _backoff(attempt: int, base_delay: float, factor: float,
+             max_delay: float, jitter: float, site: str, seed: int) -> float:
+    delay = min(max_delay, base_delay * (factor ** (attempt - 1)))
+    if jitter:
+        u = random.Random(
+            zlib.crc32(f"{site}:{seed}:{attempt}".encode())).random()
+        delay *= 1.0 + jitter * u
+    return delay
+
+
+def retry(tries: int = 3, base_delay: float = 0.05, factor: float = 2.0,
+          max_delay: float = 2.0, jitter: float = 0.5,
+          timeout: Optional[float] = None,
+          retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+          site: str = "", seed: int = 0,
+          sleep: Callable[[float], None] = time.sleep):
+    """Decorator: retry ``fn`` on ``retry_on`` with jittered exponential
+    backoff, at most ``tries`` attempts, within ``timeout`` seconds of the
+    first attempt."""
+
+    def deco(fn):
+        label = site or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            deadline = (time.monotonic() + timeout) if timeout else None
+            last: Optional[BaseException] = None
+            for attempt in range(1, tries + 1):
+                try:
+                    return fn(*args, **kwargs)
+                except retry_on as e:  # noqa: PERF203 - the whole point
+                    last = e
+                    from .. import telemetry
+                    tel = telemetry.enabled()
+                    if attempt >= tries:
+                        break
+                    delay = _backoff(attempt, base_delay, factor, max_delay,
+                                     jitter, label, seed)
+                    if deadline is not None and \
+                            time.monotonic() + delay > deadline:
+                        break
+                    if tel:
+                        telemetry.counter(
+                            "retries_total",
+                            "absorbed transient failures, by call site"
+                        ).inc(site=label)
+                    sleep(delay)
+            from .. import telemetry
+            if telemetry.enabled():
+                telemetry.counter(
+                    "retry_exhausted_total",
+                    "operations that failed after all retries"
+                ).inc(site=label)
+            raise last
+
+        return wrapper
+
+    return deco
+
+
+def call_with_retry(fn, *args, **retry_kwargs):
+    """One-shot form: ``call_with_retry(fn, site="ckpt_save", tries=5)``.
+    Positional args beyond ``fn`` are passed to ``fn``."""
+    return retry(**retry_kwargs)(fn)(*args)

@@ -1,0 +1,248 @@
+"""Preemption-safe resilient training loop.
+
+``run_resilient`` wraps a ParallelTrainer step loop with every recovery
+path the platform's production story needs (reference capabilities:
+fleet/elastic.py relaunch-on-membership-change, incubate auto_checkpoint
+transparent resume, the launcher's SIGTERM drain):
+
+- **auto-resume** — on entry, restores the newest VALID checkpoint
+  (params, optimizer state, comm-error residuals, NaN-guard counters,
+  data-epoch cursor, global RNG key) and continues from the next step.
+- **graceful preemption** — SIGTERM/SIGINT set a flag; the loop finishes
+  the in-flight step, writes a final checkpoint, and returns the
+  conventional exit code (143 / 130) so the scheduler can tell a drained
+  worker from a crash.
+- **in-process restart** — a ``faults.SimulatedCrash`` (the injected
+  kill -9 mid-commit) unwinds to the loop, which restores and replays;
+  bounded by ``max_restarts``.
+- **elastic restart** — when an ElasticManager observes a membership
+  change (``ElasticStatus.RESTART``), the loop checkpoints and returns
+  exit code 75 (EX_TEMPFAIL: re-exec me), instead of raising through the
+  user's stack.
+- **faulty input pipeline** — batch fetches run under retry/backoff
+  (site ``dataloader_fetch``); the ``nan_grad`` fault is delivered via the
+  step's ``grad_taint`` operand so the in-graph guard — not the runner —
+  does the skipping.
+"""
+from __future__ import annotations
+
+import dataclasses
+import signal
+from typing import Any, Iterable, Optional
+
+import jax
+import numpy as np
+
+from . import faults
+from .retry import call_with_retry
+
+__all__ = ["RunResult", "run_resilient"]
+
+# exit-code conventions: 128+signum for signal-terminated (what a shell
+# reports), EX_TEMPFAIL for "transient — restart me"
+EXIT_OK = 0
+EXIT_SIGTERM = 128 + signal.SIGTERM   # 143
+EXIT_SIGINT = 128 + signal.SIGINT     # 130
+EXIT_RESTART = 75                     # os.EX_TEMPFAIL
+
+
+@dataclasses.dataclass
+class RunResult:
+    exit_code: int
+    status: str            # completed | sigterm | sigint | restart
+    steps_done: int        # global steps completed, resumed ones included
+    last_step: int         # global index of the last completed step (-1: none)
+    loss: Optional[float]
+    restarts: int          # in-process SimulatedCrash recoveries
+    skipped_steps: int     # NaN-guard skips (from trainer state, total)
+    restore_fallbacks: int # corrupt checkpoints skipped during restores
+
+
+class _StopFlag:
+    """Signal → flag. Handlers only record the signum; the loop acts at
+    the next step boundary so the final checkpoint is never torn by the
+    handler itself."""
+
+    def __init__(self):
+        self.signum: Optional[int] = None
+        self._prev = {}
+
+    def _handler(self, signum, frame):
+        self.signum = signum
+
+    def install(self):
+        for s in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._prev[s] = signal.signal(s, self._handler)
+            except ValueError:      # not the main thread — run unguarded
+                pass
+
+    def uninstall(self):
+        for s, h in self._prev.items():
+            signal.signal(s, h)
+        self._prev = {}
+
+
+def _rng_key_data() -> np.ndarray:
+    from ..framework import random as _random
+    return np.asarray(jax.random.key_data(_random._state.key))
+
+
+def _set_rng_key_data(data):
+    from ..framework import random as _random
+    _random._state.key = jax.random.wrap_key_data(
+        jax.numpy.asarray(np.asarray(data), dtype=np.uint32))
+
+
+def _meta(step: int, epoch: int, batch: int) -> dict:
+    return {"step": np.asarray(step), "epoch": np.asarray(epoch),
+            "batch": np.asarray(batch), "rng": _rng_key_data()}
+
+
+def _save(manager, trainer, step: int, epoch: int, batch: int) -> bool:
+    return manager.save(step, {"trainer": trainer.state,
+                               "meta": _meta(step, epoch, batch)})
+
+
+def _restore(manager, trainer):
+    """Newest-valid restore; returns (step, epoch, batch) of the restored
+    cursor or None when starting fresh. Falls back past torn checkpoints
+    (counted by the manager)."""
+    if manager is None:
+        return None
+    template = {"trainer": trainer.state, "meta": _meta(0, 0, 0)}
+    restored = manager.restore(template=template)
+    if restored is None:
+        return None
+    trainer.state = restored["trainer"]
+    meta = restored["meta"]
+    _set_rng_key_data(meta["rng"])
+    return (int(meta["step"]), int(meta["epoch"]), int(meta["batch"]))
+
+
+def run_resilient(trainer, loader: Iterable, steps: int,
+                  manager=None, save_every: int = 1,
+                  elastic=None, lr: Optional[float] = None,
+                  max_restarts: int = 2,
+                  handle_signals: bool = True) -> RunResult:
+    """Run ``steps`` training steps with checkpoint/resume, signal drain,
+    retry-wrapped fetches, and fault-injection hooks. ``loader`` must be
+    re-iterable with a deterministic order (the epoch/batch cursor
+    fast-forwards it on resume)."""
+    from .. import telemetry
+    tel = telemetry.enabled()
+    stop = _StopFlag()
+    if handle_signals:
+        stop.install()
+    restarts = 0
+    step, epoch, batch = 0, 0, 0
+    last_loss = None
+
+    def _resume():
+        nonlocal step, epoch, batch
+        cur = _restore(manager, trainer)
+        if cur is not None:
+            step, epoch, batch = cur[0] + 1, cur[1], cur[2]
+            if tel:
+                telemetry.counter(
+                    "resilience_resumes_total",
+                    "runs that resumed from a checkpoint").inc()
+
+    def _iter_from_cursor():
+        """Fresh iterator fast-forwarded to the saved batch cursor."""
+        it = iter(loader)
+        for _ in range(batch):
+            try:
+                next(it)
+            except StopIteration:
+                return iter(loader)
+        return it
+
+    try:
+        _resume()
+        it = _iter_from_cursor()
+        while step < steps:
+            if faults.fires("sigterm", step):
+                signal.raise_signal(signal.SIGTERM)
+            if stop.signum is not None:
+                if manager is not None and step > 0:
+                    _save(manager, trainer, step - 1, epoch, batch)
+                    manager.wait_until_finished()
+                sig = stop.signum
+                return RunResult(
+                    exit_code=128 + sig,
+                    status="sigterm" if sig == signal.SIGTERM else "sigint",
+                    steps_done=step, last_step=step - 1,
+                    loss=last_loss, restarts=restarts,
+                    skipped_steps=trainer.skipped_steps(),
+                    restore_fallbacks=getattr(
+                        manager, "restore_fallbacks_total", 0))
+            if elastic is not None:
+                from ..distributed.fleet.elastic import ElasticStatus
+                st = elastic.watch()
+                if st == ElasticStatus.RESTART:
+                    if manager is not None and step > 0:
+                        _save(manager, trainer, step - 1, epoch, batch)
+                        manager.wait_until_finished()
+                    return RunResult(
+                        exit_code=EXIT_RESTART, status="restart",
+                        steps_done=step, last_step=step - 1,
+                        loss=last_loss, restarts=restarts,
+                        skipped_steps=trainer.skipped_steps(),
+                        restore_fallbacks=getattr(
+                            manager, "restore_fallbacks_total", 0))
+
+            def _fetch():
+                nonlocal it, epoch, batch
+                faults.maybe_raise("data_fetch", step=step,
+                                   msg=f"injected data_fetch at step {step}")
+                try:
+                    return next(it)
+                except StopIteration:
+                    epoch += 1
+                    batch = 0
+                    it = iter(loader)
+                    return next(it)
+
+            inputs, labels = call_with_retry(
+                _fetch, site="dataloader_fetch", tries=3, base_delay=0.01)
+
+            taint = float("nan") if faults.fires("nan_grad", step) else None
+            try:
+                last_loss = trainer.train_step(inputs, labels, lr=lr,
+                                               grad_taint=taint)
+                batch += 1
+                if manager is not None and (
+                        step % save_every == 0 or step == steps - 1):
+                    _save(manager, trainer, step, epoch, batch)
+                step += 1
+            except faults.SimulatedCrash:
+                restarts += 1
+                if tel:
+                    telemetry.counter(
+                        "resilience_restarts_total",
+                        "in-process crash recoveries").inc()
+                if restarts > max_restarts:
+                    raise
+                step, epoch, batch = 0, 0, 0
+                _resume()
+                it = _iter_from_cursor()
+
+        if manager is not None:
+            manager.wait_until_finished()
+        loss_val = None if last_loss is None else float(
+            jax.device_get(last_loss))
+        skipped = trainer.skipped_steps()
+        if tel:
+            telemetry.gauge(
+                "resilience_steps_skipped",
+                "steps the NaN guard skipped (from trainer state)"
+            ).set(skipped)
+        return RunResult(
+            exit_code=EXIT_OK, status="completed",
+            steps_done=step, last_step=step - 1, loss=loss_val,
+            restarts=restarts, skipped_steps=skipped,
+            restore_fallbacks=getattr(manager, "restore_fallbacks_total", 0))
+    finally:
+        if handle_signals:
+            stop.uninstall()

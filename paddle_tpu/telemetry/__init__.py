@@ -44,6 +44,14 @@ dataloader_fetch_seconds       histogram  io.DataLoader batch fetch
 checkpoint_save_seconds        histogram  distributed.checkpoint
 checkpoint_restore_seconds     histogram  distributed.checkpoint
 checkpoint_bytes_total         counter    distributed.checkpoint {op=...}
+retries_total                  counter    resilience.retry {site=...}
+retry_exhausted_total          counter    resilience.retry {site=...}
+ckpt_restore_fallbacks_total   counter    CheckpointManager.restore (torn
+                                          checkpoints skipped over)
+resilience_faults_injected_total counter  resilience.faults {kind=...}
+resilience_restarts_total      counter    run_resilient crash recoveries
+resilience_resumes_total       counter    run_resilient checkpoint resumes
+resilience_steps_skipped       gauge      run_resilient (NaN-guard skips)
 =============================  =========  =================================
 """
 from __future__ import annotations

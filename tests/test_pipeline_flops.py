@@ -51,7 +51,8 @@ def test_packed_1f1b_tick_count():
     from paddle_tpu.framework.random import get_rng_key
     jaxpr = jax.make_jaxpr(lambda *a: step(*a))(
         tr.state["params"], tr.state["buffers"], tr.state["opt"],
-        tr.state["comm_err"], get_rng_key(), 0.05, inputs, labels)
+        tr.state["comm_err"], tr.state["guard"], get_rng_key(),
+        0.05, 1.0, inputs, labels)
 
     lengths = []
 

@@ -371,7 +371,8 @@ class TestOneFOneBMemory:
                 jax.tree_util.tree_map(tr._leaf_spec, ys))
             comp = step.lower(tr.state["params"], tr.state["buffers"],
                               tr.state["opt"], tr.state["comm_err"],
-                              jax.random.PRNGKey(0), 0.05, xs, ys).compile()
+                              tr.state["guard"], jax.random.PRNGKey(0),
+                              0.05, 1.0, xs, ys).compile()
             return comp.memory_analysis().temp_size_in_bytes
 
         g8, g24 = temp_bytes("gpipe", 8), temp_bytes("gpipe", 24)

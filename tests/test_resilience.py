@@ -1,0 +1,767 @@
+"""Fault-resilient training runtime (ISSUE 4): fault injection, retry,
+crash-consistent checkpoints, the in-graph NaN step-guard, and the
+preemption-safe resilient runner — plus the chaos e2e acceptance loop."""
+import json
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, telemetry
+from paddle_tpu.distributed.checkpoint import (CheckpointManager,
+                                               MANIFEST_NAME,
+                                               verify_manifest,
+                                               write_manifest)
+from paddle_tpu.distributed.engine import ParallelTrainer
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.resilience import (RunResult, SimulatedCrash, all_finite,
+                                   all_finite_value, call_with_retry, faults,
+                                   retry, run_resilient)
+from paddle_tpu.telemetry.metrics import Registry
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# faults
+# ---------------------------------------------------------------------------
+
+class TestFaults:
+    def test_at_step_fires_exactly_once(self):
+        with faults.inject("nan_grad", at_step=3) as f:
+            assert not faults.fires("nan_grad", step=2)
+            assert faults.fires("nan_grad", step=3)
+            assert not faults.fires("nan_grad", step=3)  # times=1 spent
+            assert f.fired == 1
+
+    def test_kind_isolation_and_scope(self):
+        with faults.inject("ckpt_io", at_step=1):
+            assert not faults.fires("data_fetch", step=1)
+            assert faults.active("ckpt_io")
+            assert not faults.active("sigterm")
+        assert not faults.active()  # context exit disarms
+
+    def test_prob_draw_is_deterministic(self):
+        def draw():
+            with faults.inject("data_fetch", prob=0.5, seed=11, times=100):
+                return [faults.fires("data_fetch") for _ in range(20)]
+        assert draw() == draw()
+        assert any(draw())
+        assert not all(draw())
+
+    def test_unconditional_and_times(self):
+        with faults.inject("ckpt_io", times=2) as f:
+            assert faults.fires("ckpt_io")
+            assert faults.fires("ckpt_io", step=99)  # step irrelevant here
+            assert not faults.fires("ckpt_io")
+            assert f.fired == 2
+
+    def test_maybe_raise(self):
+        with faults.inject("ckpt_io", at_step=0):
+            with pytest.raises(IOError, match="injected fault: ckpt_io"):
+                faults.maybe_raise("ckpt_io", step=0)
+        faults.maybe_raise("ckpt_io", step=0)  # disarmed: no-op
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            with faults.inject("meteor_strike"):
+                pass
+
+    def test_fired_faults_counted(self):
+        prev = telemetry.get_registry()
+        reg = Registry()
+        telemetry._set_registry(reg)
+        telemetry.enable()
+        try:
+            with faults.inject("nan_grad", at_step=0):
+                faults.fires("nan_grad", step=0)
+            assert reg.get("resilience_faults_injected_total").value(
+                kind="nan_grad") == 1
+        finally:
+            telemetry.disable()
+            telemetry._set_registry(prev)
+
+
+# ---------------------------------------------------------------------------
+# retry
+# ---------------------------------------------------------------------------
+
+class TestRetry:
+    def test_absorbs_then_succeeds(self):
+        delays = []
+        calls = {"n": 0}
+
+        @retry(tries=3, base_delay=0.01, sleep=delays.append, site="t")
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise IOError("transient")
+            return "ok"
+
+        assert flaky() == "ok"
+        assert calls["n"] == 3
+        assert len(delays) == 2
+        assert delays[1] > delays[0]  # exponential
+
+    def test_exhausted_reraises_last(self):
+        @retry(tries=2, base_delay=0.001, sleep=lambda _: None)
+        def dead():
+            raise IOError("perm")
+
+        with pytest.raises(IOError, match="perm"):
+            dead()
+
+    def test_only_listed_exceptions_retried(self):
+        calls = {"n": 0}
+
+        @retry(tries=5, sleep=lambda _: None)
+        def boom():
+            calls["n"] += 1
+            raise ValueError("not transient")
+
+        with pytest.raises(ValueError):
+            boom()
+        assert calls["n"] == 1
+
+    def test_simulated_crash_never_absorbed(self):
+        # the kill -9 analogue must punch through retry to the runner
+        calls = {"n": 0}
+
+        @retry(tries=5, sleep=lambda _: None,
+               retry_on=(OSError, RuntimeError))
+        def crash():
+            calls["n"] += 1
+            raise SimulatedCrash("kill -9")
+
+        with pytest.raises(SimulatedCrash):
+            crash()
+        # SimulatedCrash IS a RuntimeError; the protection is by
+        # convention: resilience sites list OSError only
+        assert not issubclass(SimulatedCrash, OSError)
+
+    def test_jitter_deterministic_per_site(self):
+        def schedule(site):
+            delays = []
+
+            @retry(tries=4, base_delay=0.01, site=site,
+                   sleep=delays.append)
+            def f():
+                raise IOError("x")
+
+            with pytest.raises(IOError):
+                f()
+            return delays
+
+        assert schedule("a") == schedule("a")
+        assert schedule("a") != schedule("b")
+
+    def test_timeout_cuts_retries(self):
+        calls = {"n": 0}
+
+        @retry(tries=50, base_delay=10.0, timeout=0.01,
+               sleep=lambda _: None)
+        def slow():
+            calls["n"] += 1
+            raise IOError("x")
+
+        with pytest.raises(IOError):
+            slow()
+        assert calls["n"] == 1  # first backoff would blow the deadline
+
+    def test_telemetry_counters(self):
+        prev = telemetry.get_registry()
+        reg = Registry()
+        telemetry._set_registry(reg)
+        telemetry.enable()
+        try:
+            with pytest.raises(IOError):
+                call_with_retry(lambda: (_ for _ in ()).throw(IOError("x")),
+                                site="s1", tries=3, base_delay=0.001,
+                                sleep=lambda _: None)
+            assert reg.get("retries_total").value(site="s1") == 2
+            assert reg.get("retry_exhausted_total").value(site="s1") == 1
+        finally:
+            telemetry.disable()
+            telemetry._set_registry(prev)
+
+
+# ---------------------------------------------------------------------------
+# guard
+# ---------------------------------------------------------------------------
+
+class TestGuard:
+    def test_all_finite_true_false(self):
+        good = {"a": jnp.ones((3,)), "b": {"c": jnp.zeros((2, 2))}}
+        assert bool(all_finite(good))
+        bad = {"a": jnp.ones((3,)), "b": {"c": jnp.array([1.0, jnp.nan])}}
+        assert not bool(all_finite(bad))
+        assert not bool(all_finite({"a": jnp.array([jnp.inf])}))
+
+    def test_ignores_non_inexact_leaves(self):
+        tree = {"ints": jnp.arange(3), "flag": jnp.array(True),
+                "f": jnp.ones(2)}
+        assert bool(all_finite(tree))
+        assert bool(all_finite({"ints": jnp.arange(3)}))  # vacuous
+        assert bool(all_finite({}))
+
+    def test_all_finite_value_host_bool(self):
+        assert all_finite_value({"x": jnp.ones(4)}) is True
+        assert all_finite_value({"x": jnp.array([jnp.nan])}) is False
+
+
+# ---------------------------------------------------------------------------
+# manifest + CheckpointManager crash consistency
+# ---------------------------------------------------------------------------
+
+def _tree(v=1.0):
+    return {"w": np.full((4, 3), v, np.float32),
+            "b": np.arange(3).astype(np.float32)}
+
+
+class TestManifest:
+    def test_roundtrip(self, tmp_path):
+        d = tmp_path / "step"
+        d.mkdir()
+        (d / "data.bin").write_bytes(b"hello" * 100)
+        (d / "sub").mkdir()
+        (d / "sub" / "x.bin").write_bytes(b"world")
+        m = write_manifest(str(d))
+        assert set(m["files"]) == {"data.bin", os.path.join("sub", "x.bin")}
+        assert verify_manifest(str(d)) is True
+
+    def test_corruption_detected(self, tmp_path):
+        d = tmp_path / "step"
+        d.mkdir()
+        (d / "data.bin").write_bytes(b"A" * 1000)
+        write_manifest(str(d))
+        (d / "data.bin").write_bytes(b"A" * 999)   # size change
+        assert verify_manifest(str(d)) is False
+        (d / "data.bin").write_bytes(b"A" * 999 + b"B")  # same size, bad crc
+        assert verify_manifest(str(d)) is False
+        (d / "data.bin").unlink()                  # missing file
+        assert verify_manifest(str(d)) is False
+
+    def test_no_manifest_is_unknown(self, tmp_path):
+        assert verify_manifest(str(tmp_path)) is None
+
+
+class TestCheckpointManagerResilience:
+    def test_save_writes_manifest_and_restores(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), use_async=False)
+        m.save(0, _tree(1.0))
+        assert os.path.exists(tmp_path / "0" / MANIFEST_NAME)
+        assert verify_manifest(str(tmp_path / "0")) is True
+        out = m.restore(template=_tree())
+        np.testing.assert_allclose(np.asarray(out["w"]), _tree(1.0)["w"])
+        assert m.last_restored_step == 0
+        assert m.restore_fallbacks_total == 0
+
+    def test_torn_commit_falls_back_to_newest_valid(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), use_async=False)
+        m.save(0, _tree(0.0))
+        m.save(1, _tree(1.0))
+        with faults.inject("ckpt_torn", at_step=2):
+            with pytest.raises(SimulatedCrash):
+                m.save(2, _tree(2.0))
+        # torn step present on disk but unverifiable
+        assert verify_manifest(str(tmp_path / "2")) is None
+        assert m.latest_valid_step() in (1, 2)  # 2 is "unknown", 1 verified
+        # a fresh manager (the restarted process) must restore step 1
+        m2 = CheckpointManager(str(tmp_path), use_async=False)
+        out = m2.restore(template=_tree())
+        assert m2.last_restored_step == 1
+        assert m2.restore_fallbacks_total == 1
+        np.testing.assert_allclose(np.asarray(out["w"]), _tree(1.0)["w"])
+
+    def test_manifested_corruption_counts_fallback(self, tmp_path):
+        prev = telemetry.get_registry()
+        reg = Registry()
+        telemetry._set_registry(reg)
+        telemetry.enable()
+        try:
+            m = CheckpointManager(str(tmp_path), use_async=False)
+            m.save(0, _tree(0.0))
+            m.save(1, _tree(1.0))
+            # bit-rot AFTER commit: manifest present, crc now wrong
+            sdir = tmp_path / "1"
+            victim = max((p for p in sdir.rglob("*")
+                          if p.is_file() and p.name != MANIFEST_NAME),
+                         key=lambda p: p.stat().st_size)
+            victim.write_bytes(b"\x00" * 10)
+            out = m.restore(template=_tree())
+            assert m.last_restored_step == 0
+            np.testing.assert_allclose(np.asarray(out["w"]), _tree(0.0)["w"])
+            assert reg.get("ckpt_restore_fallbacks_total").value() >= 1
+        finally:
+            telemetry.disable()
+            telemetry._set_registry(prev)
+
+    def test_explicit_step_restore_verifies(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), use_async=False)
+        m.save(0, _tree(0.0))
+        sdir = str(tmp_path / "0")
+        files = [os.path.join(r, n) for r, _, ns in os.walk(sdir)
+                 for n in ns if n != MANIFEST_NAME]
+        with open(max(files, key=os.path.getsize), "r+b") as f:
+            f.truncate(1)
+        with pytest.raises(OSError, match="manifest verification"):
+            m.restore(step=0, template=_tree())
+
+    def test_gc_keeps_retention_and_last_valid(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), max_to_keep=2, use_async=False)
+        for s in range(4):
+            m.save(s, _tree(float(s)))
+        assert sorted(m.all_steps()) == [2, 3]  # plain retention unchanged
+        # tear the newest, then save another: GC must NOT remove step 3's
+        # predecessor (2 stays the newest *valid* until 4 commits)
+        with faults.inject("ckpt_torn", at_step=4):
+            with pytest.raises(SimulatedCrash):
+                m.save(4, _tree(4.0))
+        m2 = CheckpointManager(str(tmp_path), max_to_keep=2, use_async=False)
+        assert m2.restore(template=_tree()) is not None
+        assert m2.last_restored_step == 3
+
+    def test_nothing_valid_means_no_gc(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), max_to_keep=1, use_async=False)
+        with faults.inject("ckpt_torn", at_step=0):
+            with pytest.raises(SimulatedCrash):
+                m.save(0, _tree(0.0))
+        # the torn step survives (never delete when nothing verifies)
+        m2 = CheckpointManager(str(tmp_path), max_to_keep=1, use_async=False)
+        assert m2.all_steps() == [0]
+
+    def test_ckpt_io_fault_absorbed_by_retry(self, tmp_path):
+        prev = telemetry.get_registry()
+        reg = Registry()
+        telemetry._set_registry(reg)
+        telemetry.enable()
+        try:
+            m = CheckpointManager(str(tmp_path), use_async=False)
+            with faults.inject("ckpt_io", at_step=0) as f:
+                assert m.save(0, _tree(0.0))
+            assert f.fired == 1
+            assert reg.get("retries_total").value(site="ckpt_save") == 1
+            assert m.restore(template=_tree()) is not None
+        finally:
+            telemetry.disable()
+            telemetry._set_registry(prev)
+
+    def test_resave_existing_step_after_restart(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), use_async=False)
+        m.save(0, _tree(1.0))
+        m.save(0, _tree(2.0))  # replayed step: delete-then-save
+        out = m.restore(step=0, template=_tree())
+        np.testing.assert_allclose(np.asarray(out["w"]), _tree(2.0)["w"])
+
+    def test_legacy_checkpoint_without_manifest_restores(self, tmp_path):
+        # regression (ROADMAP orbax item): bare StandardRestore() shim +
+        # pre-manifest checkpoints keep working
+        m = CheckpointManager(str(tmp_path), use_async=False)
+        m.save(0, _tree(3.0))
+        os.remove(tmp_path / "0" / MANIFEST_NAME)  # simulate legacy layout
+        m2 = CheckpointManager(str(tmp_path), use_async=False)
+        out = m2.restore()  # no template: exercises StandardRestore() path
+        np.testing.assert_allclose(np.asarray(out["w"]), _tree(3.0)["w"])
+        assert m2.restore_fallbacks_total == 0
+
+    def test_async_manager_commits_on_wait(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), use_async=True)
+        m.save(0, _tree(1.0))
+        m.wait_until_finished()
+        assert verify_manifest(str(tmp_path / "0")) is True
+        out = m.restore(template=_tree())
+        np.testing.assert_allclose(np.asarray(out["w"]), _tree(1.0)["w"])
+
+
+# ---------------------------------------------------------------------------
+# engine NaN guard
+# ---------------------------------------------------------------------------
+
+def _mlp_trainer(nan_guard=True, scaler=None, lr=0.05):
+    paddle.seed(7)
+    mesh = build_mesh({"data": 2})
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(8, 16)
+            self.l2 = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.l2(nn.functional.relu(self.l1(x)))
+
+    model = MLP()
+    opt = paddle.optimizer.Momentum(lr, momentum=0.9,
+                                    parameters=model.parameters())
+    return ParallelTrainer(model, opt,
+                           lambda out, y: jnp.mean((out - y) ** 2),
+                           mesh=mesh, nan_guard=nan_guard, scaler=scaler)
+
+
+def _xy(batch=8):
+    rng = np.random.RandomState(3)
+    return (rng.randn(batch, 8).astype(np.float32),
+            rng.randn(batch, 4).astype(np.float32))
+
+
+class TestNanGuard:
+    def test_poisoned_step_skips_update(self):
+        tr = _mlp_trainer()
+        x, y = _xy()
+        tr.train_step(x, y)
+        p0 = jax.device_get(tr.state["params"])
+        opt0 = jax.device_get(tr.state["opt"]["slots"])
+        loss = tr.train_step(x, y, grad_taint=float("nan"))
+        assert np.isfinite(float(loss))  # loss computed BEFORE the taint
+        p1 = jax.device_get(tr.state["params"])
+        for k in p0:
+            np.testing.assert_array_equal(p0[k], p1[k])
+        opt1 = jax.device_get(tr.state["opt"]["slots"])
+        jax.tree_util.tree_map(np.testing.assert_array_equal, opt0, opt1)
+        assert tr.skipped_steps() == 1
+        # and training continues cleanly afterwards
+        tr.train_step(x, y)
+        p2 = jax.device_get(tr.state["params"])
+        assert any(not np.array_equal(p1[k], p2[k]) for k in p1)
+        assert tr.skipped_steps() == 1
+
+    def test_taint_flip_does_not_recompile(self):
+        tr = _mlp_trainer()
+        x, y = _xy()
+        # two warmup steps: the 1st→2nd call transition recompiles once
+        # (donated-output layout), independent of the guard
+        tr.train_step(x, y)
+        tr.train_step(x, y)
+        step = tr._step_cache[tr._last_cache_key]
+        n0 = step._cache_size()
+        tr.train_step(x, y, grad_taint=float("nan"))
+        tr.train_step(x, y, grad_taint=1.0)
+        tr.train_step(x, y)
+        assert step._cache_size() == n0
+
+    def test_happy_path_has_no_host_syncs_in_jaxpr(self):
+        # the guard is pure lax: no callbacks / host round-trips traced in
+        tr = _mlp_trainer()
+        x, y = _xy()
+        tr.train_step(x, y)
+        from paddle_tpu.framework.random import get_rng_key
+        step = tr._step_cache[tr._last_cache_key]
+        jx = jax.make_jaxpr(lambda *a: step(*a))(
+            tr.state["params"], tr.state["buffers"], tr.state["opt"],
+            tr.state["comm_err"], tr.state["guard"], get_rng_key(),
+            0.05, 1.0, x.astype(np.float32), y.astype(np.float32))
+        s = str(jx)
+        for bad in ("callback", "io_callback", "debug_callback",
+                    "python_callback"):
+            assert bad not in s
+
+    def test_guard_disabled_lets_nan_through(self):
+        tr = _mlp_trainer(nan_guard=False)
+        x, y = _xy()
+        tr.train_step(x, y)
+        tr.train_step(x, y, grad_taint=float("nan"))
+        p = jax.device_get(tr.state["params"])
+        assert any(not np.isfinite(v).all() for v in p.values())
+        assert tr.skipped_steps() == 0
+
+    def test_check_nan_inf_flag_raises_on_poisoned_params(self):
+        # engine.train_step's FLAGS_check_nan_inf consumer: with the guard
+        # off, poisoned params must trip check_numerics at step granularity
+        from paddle_tpu.framework import flags
+        tr = _mlp_trainer(nan_guard=False)
+        x, y = _xy()
+        tr.train_step(x, y)
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            with pytest.raises(FloatingPointError):
+                tr.train_step(x, y, grad_taint=float("nan"))
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_check_nan_inf_flag_quiet_when_guard_on(self):
+        # the guard skips the poisoned update, so the flag's scan stays
+        # happy: loss finite, params finite
+        tr = _mlp_trainer(nan_guard=True)
+        x, y = _xy()
+        tr.train_step(x, y)
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            loss = tr.train_step(x, y, grad_taint=float("nan"))
+            assert np.isfinite(float(loss))
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+# ---------------------------------------------------------------------------
+# AmpScaler integration (satellite: fused finite check + shared policy)
+# ---------------------------------------------------------------------------
+
+class TestAmpScalerGuard:
+    def test_unscale_optimizer_single_fused_check(self):
+        from paddle_tpu.amp import GradScaler
+
+        class P:
+            def __init__(self, g):
+                self.grad = g
+
+        sc = GradScaler(enable=True, init_loss_scaling=4.0)
+        params = [P(jnp.ones(3) * 4.0), P(jnp.ones(2) * 8.0), P(None)]
+
+        class Opt:
+            _parameter_list = params
+
+        assert sc.unscale_(Opt()) is False
+        np.testing.assert_allclose(np.asarray(params[0].grad), 1.0)
+        np.testing.assert_allclose(np.asarray(params[1].grad), 2.0)
+        sc2 = GradScaler(enable=True, init_loss_scaling=4.0)
+        params[0].grad = jnp.array([1.0, jnp.nan, 1.0])
+        sc2._already_unscaled = False
+        assert sc2.unscale_(Opt()) is True
+
+    def test_update_scale_state_policy(self):
+        from paddle_tpu.amp import GradScaler
+        sc = GradScaler(enable=True, init_loss_scaling=16.0,
+                        incr_every_n_steps=2, decr_every_n_nan_or_inf=2)
+        st = sc.init_scale_state()
+        # two bad steps → halve
+        st = sc.update_scale_state(st, jnp.asarray(True))
+        assert float(st["scale"]) == 16.0
+        st = sc.update_scale_state(st, jnp.asarray(True))
+        assert float(st["scale"]) == 8.0
+        # two good steps → double
+        st = sc.update_scale_state(st, jnp.asarray(False))
+        st = sc.update_scale_state(st, jnp.asarray(False))
+        assert float(st["scale"]) == 16.0
+
+    def test_trainer_with_scaler_decrements_on_nan(self):
+        from paddle_tpu.amp import GradScaler
+        sc = GradScaler(enable=True, init_loss_scaling=16.0,
+                        incr_every_n_steps=1000, decr_every_n_nan_or_inf=1)
+        tr = _mlp_trainer(scaler=sc)
+        x, y = _xy()
+        tr.train_step(x, y)
+        assert float(tr.state["guard"]["amp"]["scale"]) == 16.0
+        tr.train_step(x, y, grad_taint=float("nan"))
+        assert float(tr.state["guard"]["amp"]["scale"]) == 8.0
+        assert tr.skipped_steps() == 1
+
+    def test_scaled_loss_reported_unscaled(self):
+        from paddle_tpu.amp import GradScaler
+        tr_plain = _mlp_trainer()
+        sc = GradScaler(enable=True, init_loss_scaling=256.0)
+        tr_amp = _mlp_trainer(scaler=sc)
+        x, y = _xy()
+        l0 = float(tr_plain.train_step(x, y))
+        l1 = float(tr_amp.train_step(x, y))
+        assert abs(l0 - l1) < 1e-4 * max(1.0, abs(l0))
+
+
+# ---------------------------------------------------------------------------
+# dataloader fetch retry
+# ---------------------------------------------------------------------------
+
+class TestDataloaderRetry:
+    def test_fetch_fault_absorbed(self):
+        from paddle_tpu.io import DataLoader
+
+        class DS:
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return np.float32([i])
+
+        prev = telemetry.get_registry()
+        reg = Registry()
+        telemetry._set_registry(reg)
+        telemetry.enable()
+        try:
+            dl = DataLoader(DS(), batch_size=2, shuffle=False,
+                            num_workers=0)
+            with faults.inject("data_fetch", at_step=1) as f:
+                batches = [np.asarray(b) for b in dl]
+            assert f.fired == 1
+            assert len(batches) == 4  # nothing lost
+            assert reg.get("retries_total").value(
+                site="dataloader_fetch") == 1
+        finally:
+            telemetry.disable()
+            telemetry._set_registry(prev)
+
+
+# ---------------------------------------------------------------------------
+# resilient runner
+# ---------------------------------------------------------------------------
+
+def _loader(n=4, batch=8):
+    rng = np.random.RandomState(0)
+    return [(rng.randn(batch, 8).astype(np.float32),
+             rng.randn(batch, 4).astype(np.float32)) for _ in range(n)]
+
+
+class TestRunner:
+    def test_plain_run_completes(self, tmp_path):
+        tr = _mlp_trainer()
+        res = run_resilient(tr, _loader(), steps=5,
+                            manager=CheckpointManager(str(tmp_path),
+                                                      use_async=False))
+        assert isinstance(res, RunResult)
+        assert (res.exit_code, res.status) == (0, "completed")
+        assert res.steps_done == 5 and res.last_step == 4
+        assert res.skipped_steps == 0 and res.restarts == 0
+
+    def test_auto_resume_continues_from_checkpoint(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), use_async=False)
+        tr = _mlp_trainer()
+        run_resilient(tr, _loader(), steps=3, manager=mgr)
+        w_after3 = np.asarray(jax.device_get(tr.state["params"]["l1.weight"]))
+        # a "new process": fresh trainer, same ckpt dir
+        tr2 = _mlp_trainer()
+        res = run_resilient(tr2, _loader(), steps=6, manager=mgr)
+        assert mgr.last_restored_step == 2  # resumed, not retrained, 0-2
+        assert res.steps_done == 6 and res.last_step == 5
+        w2 = np.asarray(jax.device_get(tr2.state["params"]["l1.weight"]))
+        assert not np.array_equal(w_after3, w2)  # it actually trained on
+
+    def test_resume_restores_rng_and_cursor(self, tmp_path):
+        from paddle_tpu.framework import random as frandom
+        mgr = CheckpointManager(str(tmp_path), use_async=False)
+        tr = _mlp_trainer()
+        run_resilient(tr, _loader(), steps=2, manager=mgr)
+        key_after = np.asarray(jax.random.key_data(frandom._state.key))
+        paddle.seed(12345)  # clobber the stream
+        tr2 = _mlp_trainer()
+        run_resilient(tr2, _loader(), steps=3, manager=mgr)
+        # the restored stream continued from the checkpointed key, not from
+        # seed(12345)'s — replaying from key_after must match
+        assert not np.array_equal(
+            key_after, np.asarray(jax.random.key_data(frandom._state.key)))
+
+    def test_nan_grad_fault_skips_one_step(self, tmp_path):
+        tr = _mlp_trainer()
+        with faults.inject("nan_grad", at_step=2) as f:
+            res = run_resilient(tr, _loader(), steps=5,
+                                manager=CheckpointManager(str(tmp_path),
+                                                          use_async=False))
+        assert f.fired == 1
+        assert res.skipped_steps == 1
+        assert res.steps_done == 5  # the step advanced, only its update skipped
+
+    def test_simulated_crash_restarts_in_process(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), use_async=False)
+        tr = _mlp_trainer()
+        with faults.inject("ckpt_torn", at_step=2) as f:
+            res = run_resilient(tr, _loader(), steps=5, manager=mgr)
+        assert f.fired == 1
+        assert res.exit_code == 0
+        assert res.restarts == 1
+        assert res.steps_done >= 5
+        assert mgr.restore_fallbacks_total >= 1  # torn 2 → fell back to 1
+
+    def test_max_restarts_bounds_crash_loop(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), use_async=False)
+        tr = _mlp_trainer()
+        # unconditional torn fault: every save crashes
+        with faults.inject("ckpt_torn", times=100):
+            with pytest.raises(SimulatedCrash):
+                run_resilient(tr, _loader(), steps=5, manager=mgr,
+                              max_restarts=2)
+
+    def test_sigterm_fault_drains_gracefully(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), use_async=False)
+        tr = _mlp_trainer()
+        with faults.inject("sigterm", at_step=3) as f:
+            res = run_resilient(tr, _loader(), steps=10, manager=mgr)
+        assert f.fired == 1
+        assert res.exit_code == 128 + signal.SIGTERM  # 143
+        assert res.status == "sigterm"
+        assert res.last_step == 2
+        assert mgr.latest_valid_step() == 2
+        # handlers restored after the run
+        h = signal.getsignal(signal.SIGTERM)
+        assert getattr(h, "__name__", "") != "_handler"
+
+    def test_sigterm_then_rerun_completes(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), use_async=False)
+        tr = _mlp_trainer()
+        with faults.inject("sigterm", at_step=2):
+            res1 = run_resilient(tr, _loader(), steps=5, manager=mgr)
+        assert res1.exit_code == 143
+        res2 = run_resilient(tr, _loader(), steps=5, manager=mgr)
+        assert res2.exit_code == 0
+        assert res2.last_step == 4
+
+    def test_elastic_restart_propagates_as_exit_75(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import ElasticStatus
+
+        class FakeElastic:
+            def __init__(self):
+                self.calls = 0
+
+            def watch(self, proc_alive=lambda: True):
+                self.calls += 1
+                return (ElasticStatus.RESTART if self.calls > 2
+                        else ElasticStatus.HOLD)
+
+        mgr = CheckpointManager(str(tmp_path), use_async=False)
+        tr = _mlp_trainer()
+        res = run_resilient(tr, _loader(), steps=10, manager=mgr,
+                            elastic=FakeElastic())
+        assert res.exit_code == 75
+        assert res.status == "restart"
+        assert res.steps_done == 2
+        assert mgr.latest_valid_step() == 1  # checkpointed before exiting
+
+    def test_data_fetch_fault_retried_in_runner(self, tmp_path):
+        tr = _mlp_trainer()
+        with faults.inject("data_fetch", at_step=1) as f:
+            res = run_resilient(tr, _loader(), steps=4,
+                                manager=CheckpointManager(str(tmp_path),
+                                                          use_async=False))
+        assert f.fired == 1
+        assert res.exit_code == 0 and res.steps_done == 4
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e (the ISSUE acceptance loop)
+# ---------------------------------------------------------------------------
+
+class TestChaosE2E:
+    def test_chaos_gpt_loop(self, tmp_path):
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                        os.pardir, "tools"))
+        try:
+            import chaos_smoke
+        finally:
+            sys.path.pop(0)
+        run_dir = tmp_path / "run"
+        out = chaos_smoke.run_chaos(10, str(tmp_path / "chaos"),
+                                    run_dir=str(run_dir))
+        ref = chaos_smoke.run_plain(10, str(tmp_path / "plain"))
+        # finishes after auto-resume
+        assert out["exit_code"] == 0
+        assert out["steps_done"] == 10
+        # every fault fired; exactly one skipped step; >=1 restore fallback
+        assert out["faults_injected"] == 3
+        assert out["steps_skipped"] == 1
+        assert out["restore_fallbacks"] >= 1
+        # loss lands within tolerance of the fault-free twin (one skipped
+        # update on a tiny GPT moves the loss only marginally)
+        assert ref["exit_code"] == 0
+        assert abs(out["loss"] - ref["loss"]) < 0.35 * abs(ref["loss"])
+        # resilience_* counters exported
+        prom = (run_dir / "metrics.prom").read_text()
+        assert "resilience_faults_injected_total" in prom
+        assert "ckpt_restore_fallbacks_total" in prom
+        assert "resilience_restarts_total" in prom
+        assert json.dumps(out)  # JSON-serializable summary

@@ -1,0 +1,137 @@
+"""Chaos smoke: a short CPU-mesh GPT training loop under injected faults.
+
+Drives the whole resilience stack end-to-end on virtual host devices:
+
+- ``ckpt_torn``  at step 3 — a simulated kill -9 mid-checkpoint-commit;
+  the runner restarts in-process and the restore FALLS BACK past the torn
+  step to the newest valid one.
+- ``nan_grad``   at step 5 — the in-graph guard skips exactly that
+  update (no host sync, no recompile).
+- ``sigterm``    at step 7 — graceful drain: final checkpoint, exit 143;
+  the driver re-invokes and the run auto-resumes to completion.
+
+Prints ONE line of JSON::
+
+    {"faults_injected": 3, "steps_skipped": 1, "restore_fallbacks": 1, ...}
+
+Run: ``python tools/chaos_smoke.py [--steps 10] [--ckpt-dir DIR]``
+(also wired as a ``-m 'not slow'`` pytest in tests/test_resilience.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+from _mesh_setup import ensure_repo_on_path, force_host_devices
+
+ensure_repo_on_path()
+force_host_devices(8)
+
+
+def build_trainer(seed: int = 0):
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.engine import ParallelTrainer
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.text.models import GPTForPretraining
+
+    paddle.seed(seed)
+    mesh = build_mesh({"data": 2})
+    model = GPTForPretraining(
+        tensor_parallel=False, vocab_size=128, hidden_size=32,
+        num_layers=1, num_heads=2, max_position_embeddings=16,
+        attn_dropout=0.0, hidden_dropout=0.0)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    return ParallelTrainer(
+        model, opt,
+        lambda logits, lbl: nn.functional.cross_entropy(logits, lbl),
+        mesh=mesh, grad_sync="int8", grad_sync_block=64), jnp
+
+
+def make_loader(n_batches: int = 4, batch: int = 4, seq: int = 16,
+                vocab: int = 128, seed: int = 0):
+    """Re-iterable deterministic toy corpus (list of (ids, labels))."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(0, vocab, (batch, seq)).astype("int32"),
+             rng.randint(0, vocab, (batch, seq)).astype("int32"))
+            for _ in range(n_batches)]
+
+
+def run_chaos(steps: int, ckpt_dir: str, run_dir: str | None = None):
+    """The chaos loop; returns the summary dict that main() prints."""
+    import contextlib
+
+    from paddle_tpu import telemetry
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+    from paddle_tpu.resilience import faults, run_resilient
+
+    trainer, _ = build_trainer()
+    loader = make_loader()
+    manager = CheckpointManager(ckpt_dir, max_to_keep=3, use_async=False)
+
+    scope = telemetry.scope(run_dir) if run_dir else contextlib.nullcontext()
+    with scope:
+        with faults.inject("ckpt_torn", at_step=3) as f_torn, \
+                faults.inject("nan_grad", at_step=5) as f_nan, \
+                faults.inject("sigterm", at_step=7) as f_term:
+            res = run_resilient(trainer, loader, steps,
+                                manager=manager, save_every=1)
+            reruns, restarts = 0, res.restarts
+            # the scheduler's role: re-invoke drained/restarted workers
+            while res.exit_code != 0 and reruns < 3:
+                reruns += 1
+                res = run_resilient(trainer, loader, steps,
+                                    manager=manager, save_every=1)
+                restarts += res.restarts
+    return {
+        "faults_injected": f_torn.fired + f_nan.fired + f_term.fired,
+        "steps_skipped": res.skipped_steps,
+        "restore_fallbacks": manager.restore_fallbacks_total,
+        "steps_done": res.last_step + 1,
+        "restarts": restarts,
+        "reruns": reruns,
+        "exit_code": res.exit_code,
+        "loss": res.loss,
+    }
+
+
+def run_plain(steps: int, ckpt_dir: str):
+    """Fault-free twin of run_chaos (same seed/data) for loss comparison."""
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+    from paddle_tpu.resilience import run_resilient
+
+    trainer, _ = build_trainer()
+    manager = CheckpointManager(ckpt_dir, max_to_keep=3, use_async=False)
+    res = run_resilient(trainer, make_loader(), steps,
+                        manager=manager, save_every=1)
+    return {"steps_done": res.last_step + 1, "loss": res.loss,
+            "exit_code": res.exit_code}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--ckpt-dir", default=None,
+                   help="checkpoint directory (default: a fresh tmp dir)")
+    p.add_argument("--run-dir", default=None,
+                   help="telemetry run dir (metrics.prom / events.jsonl)")
+    p.add_argument("--plain", action="store_true",
+                   help="fault-free reference run instead of the chaos loop")
+    args = p.parse_args(argv)
+    ckpt = args.ckpt_dir or tempfile.mkdtemp(prefix="chaos_ckpt_")
+    if args.plain:
+        out = run_plain(args.steps, ckpt)
+    else:
+        out = run_chaos(args.steps, ckpt, run_dir=args.run_dir)
+    print(json.dumps(out))
+    return 0 if out["exit_code"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

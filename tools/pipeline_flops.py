@@ -88,8 +88,9 @@ def _step_flops(trainer, x, y):
         lambda *a: step(*a))(trainer.state["params"],
                              trainer.state["buffers"],
                              trainer.state["opt"],
-                             trainer.state["comm_err"], get_rng_key(),
-                             0.05, inputs, labels)
+                             trainer.state["comm_err"],
+                             trainer.state["guard"], get_rng_key(),
+                             0.05, 1.0, inputs, labels)
     return matmul_flops(jaxpr.jaxpr)
 
 
