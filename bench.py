@@ -152,7 +152,7 @@ def _gpt_variant(jax, on_tpu, batch, seq, vocab, cfg, fused, chunk=8192,
     fused=True routes through GPTForPretraining.fused_head_loss
     (ops/chunked_ce.py) so the (B*S, vocab) logits never materialize;
     fused=False is the dense-logits + lse-gather CE path. grad_sync
-    ("int8"/"bf16") compresses the DP gradient exchange
+    ("int8"/"int4"/"bf16") compresses the DP gradient exchange
     (distributed/compressed.py) — over all local devices on TPU, a
     single-device mesh otherwise (measures the quantize overhead)."""
     import numpy as np
@@ -219,6 +219,7 @@ def _harvest_telemetry(reg):
         "mfu": round(val("mfu", 0.0), 6),
         "recompiles": int(val("recompiles_total", 0)),
         "wire_bytes": val("grad_sync_bytes_total", 0.0),
+        "compression_x": round(val("grad_sync_compression_x", 0.0), 3),
         "step_time_avg_s": round(val("step_time_seconds", 0.0), 6),
     }
 
@@ -244,14 +245,19 @@ def bench_gpt(jax, on_tpu):
                                           remat=True)),
                  ("dense_b32", dict(batch=32, fused=False)),
                  # compressed DP grad exchange over all chips (per-chip
-                 # batch 8): same model, 4x fewer gradient bytes on wire
+                 # batch 8): same model, 4x (int8) / 7x (int4) fewer
+                 # gradient bytes on wire
                  ("fused_b8_int8dp", dict(batch=8, fused=True,
-                                          grad_sync="int8"))]
+                                          grad_sync="int8")),
+                 ("fused_b8_int4dp", dict(batch=8, fused=True,
+                                          grad_sync="int4"))]
                 if on_tpu else
                 [("fused_b4", dict(batch=4, fused=True)),
                  ("dense_b4", dict(batch=4, fused=False)),
                  ("fused_b4_int8dp", dict(batch=4, fused=True,
-                                          grad_sync="int8"))])
+                                          grad_sync="int8")),
+                 ("fused_b4_int4dp", dict(batch=4, fused=True,
+                                          grad_sync="int4"))])
     sweep, best, best_name = {}, None, None
     out = None
     for name, kw in variants:

@@ -42,6 +42,9 @@ class AnalysisConfig:
     while_trips: float = 1.0               # assumed while-loop trip count
     top_k: int = 10                        # cost-table length
     check_fp64: bool = True
+    # link-mismatch: fp32 payloads below this cross DCN without a finding
+    # (per-block scale exchanges are tiny and legitimately uncompressed)
+    dcn_uncompressed_min_bytes: float = 1 << 20
     disabled_rules: frozenset = frozenset()
 
 
@@ -381,6 +384,94 @@ def dead_equation(ctx):
                 f"{first.primitive} output is never used (no "
                 f"effects){extra}; dead compute or a value that was "
                 "meant to be returned")
+
+
+_INT16_MAX = 2 ** 15 - 1
+
+
+@register_rule("int4-grad-sync-overflow", "error")
+def int4_grad_sync_overflow(ctx):
+    """An int16 sum-reduction over n elements with n*7 > int16 range —
+    the int4 grad-sync accumulation pattern (values in [-7, 7] summed
+    over the axis size) with an accumulator too narrow for the rank
+    count. compressed.int4_accum_dtype auto-widens to int32; a hand-
+    rolled exchange that kept int16 silently wraps at ~4682 ranks."""
+    for site in ctx.sites:
+        if site.primitive != "reduce_sum":
+            continue
+        eqn = site.eqn
+        in_dt = getattr(getattr(eqn.invars[0], "aval", None), "dtype", None)
+        out_dt = getattr(getattr(eqn.outvars[0], "aval", None), "dtype",
+                         None)
+        if getattr(in_dt, "name", "") != "int16" or \
+                getattr(out_dt, "name", "") != "int16":
+            continue
+        shape = getattr(eqn.invars[0].aval, "shape", ())
+        axes = eqn.params.get("axes", ())
+        n = 1
+        for a in axes:
+            if isinstance(a, int) and a < len(shape):
+                n *= int(shape[a])
+        if n * 7 > _INT16_MAX:
+            yield ctx.finding(
+                site, f"int16 sum over {n} elements: int4-range values "
+                      f"(|q| <= 7) can reach {n * 7} > {_INT16_MAX} and "
+                      "wrap — widen the accumulation to int32 "
+                      "(compressed.int4_accum_dtype does this "
+                      f"automatically past {_INT16_MAX // 7} ranks)")
+
+
+_COMPRESSED_WIRE_DTYPES = ("int8", "uint8", "int4", "uint4")
+_LINK_CHECK_PRIMS = ("psum", "all_to_all", "all_gather", "psum_scatter",
+                     "reduce_scatter")
+
+
+@register_rule("compressed-collective-link-mismatch", "warning")
+def compressed_collective_link_mismatch(ctx):
+    """Compressed (int8/int4-wire) collectives bound to ICI-only axes —
+    where quantize overhead loses against the fast intra-slice links —
+    and large uncompressed fp32 collectives crossing a DCN axis, using
+    the mesh-axis -> link-type map (distributed/mesh.axis_links). Only
+    active when the mesh's links were set explicitly or inference found
+    a DCN axis: on a single-slice mesh every axis is trivially ICI and
+    the gating question does not arise."""
+    if ctx.mesh is None:
+        return
+    try:
+        from ..distributed.mesh import axis_links, explicit_axis_links
+        explicit = explicit_axis_links(ctx.mesh)
+        links = axis_links(ctx.mesh)
+    except Exception:
+        return
+    if explicit is None and "dcn" not in links.values():
+        return
+    min_bytes = ctx.config.dcn_uncompressed_min_bytes
+    for site in ctx.sites:
+        if site.primitive not in _LINK_CHECK_PRIMS:
+            continue
+        axes = [ax for ax in collective_axes(site.eqn) if ax in links]
+        if not axes:
+            continue
+        dtname = getattr(
+            getattr(getattr(site.eqn.invars[0], "aval", None), "dtype",
+                    None), "name", "")
+        nbytes = sum(_aval_nbytes(v) for v in site.eqn.invars)
+        if dtname in _COMPRESSED_WIRE_DTYPES:
+            if all(links[ax] == "ici" for ax in axes):
+                yield ctx.finding(
+                    site, f"compressed ({dtname}-wire) {site.primitive} "
+                          f"over ICI-only axes {axes!r}: quantize overhead "
+                          "loses on intra-slice links — gate the policy to "
+                          "DCN axes (grad_sync_dcn_only / per-axis policy)")
+        elif dtname == "float32" and nbytes >= min_bytes:
+            dcn = [ax for ax in axes if links[ax] == "dcn"]
+            if dcn:
+                yield ctx.finding(
+                    site, f"uncompressed fp32 {site.primitive} "
+                          f"({_human_bytes(nbytes)}) crosses DCN axis "
+                          f"{dcn[0]!r}: cross-slice bandwidth is ~10-100x "
+                          "scarcer than ICI — use the compressed exchange "
+                          "(grad_sync=\"int8\"/\"int4\") on this axis")
 
 
 @register_rule("oversized-allgather", "warning")

@@ -24,6 +24,22 @@ returned to the caller and added to the NEXT step's gradient before
 quantizing — the DGC local-accumulation idiom (optimizer/optimizer.py
 DGCMomentum slot "v"): compression error is carried forward, not lost.
 
+The int4 policy halves the wire again: two values packed per byte,
+per-64-element blocks (4-bit steps are coarse, so blocks shrink to keep
+the shared scale local), and scales crossing the wire as bf16 (a 4-bit
+payload does not deserve 4-byte scales — and halving scale traffic is
+what keeps the per-64 blocks above the 7x bytes win vs fp32). The local
+accumulation of n quantized values lives in int16 while n * 7 < 2**15
+and auto-widens to int32 above that (``int4_accum_dtype``).
+
+``policy`` may also be a per-axis mapping ({axis: policy}): on
+multi-slice topologies the ICI hops are fast enough that quantize
+overhead loses, so ``grad_sync="int8"/"int4"`` should gate to the DCN
+(cross-slice) axes only — the mesh-axis -> link-type map lives in
+distributed/mesh.py. Lossless axis groups exchange FIRST (the cheap
+ICI pre-reduction conditions the quantizer's input), quantized groups
+after.
+
 Everything here is plain traced jax: called inside a shard_map region the
 collectives lower to XLA ICI/DCN ops and the latency-hiding scheduler
 overlaps the per-bucket exchanges with backward compute (the bucket-size
@@ -31,22 +47,48 @@ knob exists exactly to give the scheduler multiple chunks to pipeline).
 """
 from __future__ import annotations
 
-from typing import Optional
+import math
+from typing import Mapping, Optional, Union
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 __all__ = [
-    "GRAD_SYNC_POLICIES", "DEFAULT_BLOCK", "DEFAULT_BUCKET_BYTES",
+    "GRAD_SYNC_POLICIES", "QUANTIZED_POLICIES", "DEFAULT_BLOCK",
+    "DEFAULT_INT4_BLOCK", "DEFAULT_BUCKET_BYTES", "INT16_SAFE_RANKS",
+    "resolve_block", "int4_accum_dtype",
     "quantize_int8_blocks", "dequantize_int8_blocks",
-    "compressed_tree_mean", "init_residuals", "wire_bytes_per_rank",
-    "tree_wire_bytes", "residual_norm",
+    "quantize_int4_blocks", "dequantize_int4_blocks",
+    "pack_int4", "unpack_int4",
+    "compressed_tree_mean", "compressed_psum_scatter", "init_residuals",
+    "normalize_axis_policies", "wire_bytes_per_rank", "tree_wire_bytes",
+    "residual_norm",
 ]
 
-GRAD_SYNC_POLICIES = ("fp32", "bf16", "int8")
-DEFAULT_BLOCK = 256
+GRAD_SYNC_POLICIES = ("fp32", "bf16", "int8", "int4")
+QUANTIZED_POLICIES = ("int8", "int4")
+DEFAULT_BLOCK = 256          # int8 quantization block
+DEFAULT_INT4_BLOCK = 64      # int4: 4-bit steps are coarse -> smaller blocks
 DEFAULT_BUCKET_BYTES = 4 << 20  # 4 MiB of fp32 per collective chunk
+
+# int16 can hold a sum of n int4-range (|q| <= 7) values while n*7 fits:
+INT16_SAFE_RANKS = (2 ** 15 - 1) // 7   # 4681
+
+
+def resolve_block(policy: str, block: Optional[int]) -> int:
+    """Per-policy default quantization block (block=None)."""
+    if block is not None:
+        return int(block)
+    return DEFAULT_INT4_BLOCK if policy == "int4" else DEFAULT_BLOCK
+
+
+def int4_accum_dtype(n: int):
+    """Accumulation dtype for a sum of ``n`` int4-range values: int16
+    while n*7 < 2**15, auto-widened to int32 above (and asserted sane —
+    2**31/7 ranks is not a real machine)."""
+    assert n * 7 < 2 ** 31, f"int4 accumulation over n={n} ranks overflows int32"
+    return jnp.int16 if n <= INT16_SAFE_RANKS else jnp.int32
 
 
 # --------------------------------------------------------------------------
@@ -70,6 +112,42 @@ def quantize_int8_blocks(x, block: int = DEFAULT_BLOCK, scale=None):
 def dequantize_int8_blocks(q, scale, block: int = DEFAULT_BLOCK):
     xb = q.astype(jnp.float32).reshape(-1, block) * scale[:, None]
     return xb.reshape(q.shape)
+
+
+def quantize_int4_blocks(x, block: int = DEFAULT_INT4_BLOCK, scale=None):
+    """Per-block symmetric int4 quantization: values in [-7, 7], carried
+    in an int8 array (``pack_int4`` packs two per byte for the wire).
+    Returns ``(q, scale)`` like :func:`quantize_int8_blocks`."""
+    xb = x.reshape(-1, block)
+    if scale is None:
+        amax = jnp.max(jnp.abs(xb), axis=1)
+        scale = jnp.where(amax > 0, amax / 7.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(xb / scale[:, None]), -7, 7).astype(jnp.int8)
+    return q.reshape(x.shape), scale
+
+
+def dequantize_int4_blocks(q, scale, block: int = DEFAULT_INT4_BLOCK):
+    """Dequantize int4-range values (any integer dtype — the accumulation
+    path hands int16/int32 sums straight in)."""
+    xb = q.astype(jnp.float32).reshape(-1, block) * scale[:, None]
+    return xb.reshape(q.shape)
+
+
+def pack_int4(q):
+    """Pack a flat even-length int8 array of int4-range values two per
+    byte (uint8): element 2i rides the low nibble, 2i+1 the high one."""
+    pairs = q.reshape(-1, 2).astype(jnp.uint8)
+    return (pairs[:, 0] & 0x0F) | ((pairs[:, 1] & 0x0F) << 4)
+
+
+def unpack_int4(p):
+    """Invert :func:`pack_int4`: uint8 bytes -> flat int8 values (sign-
+    extended from the nibbles)."""
+    lo = (p & 0x0F).astype(jnp.int8)
+    hi = ((p >> 4) & 0x0F).astype(jnp.int8)
+    lo = jnp.where(lo >= 8, lo - 16, lo)
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    return jnp.stack([lo, hi], axis=-1).reshape(-1)
 
 
 # --------------------------------------------------------------------------
@@ -132,17 +210,89 @@ def _int8_bucket_sum(flat, axis, n: int, block: int):
     return dequantize_int8_blocks(full_q, full_s, block), recon
 
 
-def _bucket_mean(flat, axis, n: int, policy: str, block: int):
-    """Mean over the axis of one flat fp32 bucket. Returns (mean, recon)
-    where recon is this rank's decompressed contribution (== flat for the
-    lossless-on-send policies)."""
-    if policy == "int8":
-        s, recon = _int8_bucket_sum(flat, axis, n, block)
-        return s / n, recon
-    if policy == "bf16":
-        m = lax.pmean(flat.astype(jnp.bfloat16), axis).astype(flat.dtype)
-        return m, flat
-    return lax.pmean(flat, axis), flat
+def _int4_bucket_sum(flat, axis, n: int, block: int):
+    """All-reduce-SUM of one flat fp32 bucket through the packed int4
+    exchange (size % (n*block) == 0, block even). Same two-phase shape as
+    :func:`_int8_bucket_sum`, except: values pack two per byte for every
+    wire move, scales cross as bf16 (half the scale traffic — what keeps
+    per-64 blocks above 7x vs fp32), and the local accumulation dtype
+    widens from int16 to int32 once n * 7 leaves the int16 range."""
+    # phase 0: shared per-block scale; the amax all-reduce rides bf16
+    _, local_scale = quantize_int4_blocks(flat, block)
+    amax = lax.pmax((local_scale * 7.0).astype(jnp.bfloat16), axis)
+    scale = jnp.maximum(amax.astype(jnp.float32), 1e-30) / 7.0
+    q, _ = quantize_int4_blocks(flat, block, scale=scale)
+    recon = dequantize_int4_blocks(q, scale, block)
+    if n == 1:
+        return recon, recon
+    c = flat.size // n
+    # phase 1: decomposed reduce-scatter — nibble-packed uint8 on the
+    # wire, int16 (int32 past INT16_SAFE_RANKS ranks) local accumulation
+    packed = pack_int4(q).reshape(n, c // 2)
+    recv = lax.all_to_all(packed, axis, split_axis=0, concat_axis=0,
+                          tiled=False)
+    vals = unpack_int4(recv.reshape(-1)).reshape(n, c)
+    acc = jnp.sum(vals.astype(int4_accum_dtype(n)), axis=0)       # exact
+    idx = lax.axis_index(axis)
+    my_scales = lax.dynamic_slice_in_dim(scale, idx * (c // block),
+                                         c // block, axis=0)
+    red = dequantize_int4_blocks(acc, my_scales, block)           # (c,)
+    # phase 2: fresh local scale, rounded to its bf16 wire format BEFORE
+    # quantizing so q2 * gathered-scale is self-consistent
+    _, s2 = quantize_int4_blocks(red, block)
+    s2 = s2.astype(jnp.bfloat16)
+    q2, _ = quantize_int4_blocks(red, block, scale=s2.astype(jnp.float32))
+    full_q = lax.all_gather(pack_int4(q2), axis, axis=0, tiled=True)
+    full_s = lax.all_gather(s2, axis, axis=0, tiled=True)
+    out = dequantize_int4_blocks(unpack_int4(full_q),
+                                 full_s.astype(jnp.float32), block)
+    return out, recon
+
+
+def _bucket_mean(flat, groups, sizes, blocks):
+    """Mean of one flat fp32 bucket over every (axes, policy) group,
+    exchanged sequentially (lossless groups first — see
+    ``normalize_axis_policies``). Returns ``(mean, err)`` where err is
+    this rank's total quantization error (None when no group quantizes):
+    the error-feedback residual the caller carries to the next step."""
+    x, err = flat, None
+    for (axes, pol), n, blk in zip(groups, sizes, blocks):
+        if pol in QUANTIZED_POLICIES:
+            fn = _int8_bucket_sum if pol == "int8" else _int4_bucket_sum
+            s, recon = fn(x, axes, n, blk)
+            e = x - recon
+            err = e if err is None else err + e
+            x = s / n
+        elif n > 1:
+            if pol == "bf16":
+                x = lax.pmean(x.astype(jnp.bfloat16), axes).astype(x.dtype)
+            else:
+                x = lax.pmean(x, axes)
+    return x, err
+
+
+def normalize_axis_policies(axis, policy):
+    """Resolve ``policy`` — one name for all axes, or a per-axis mapping
+    ({axis: policy}, unlisted axes exact) — into ordered exchange groups
+    ``[(axes_tuple, policy)]``. Lossless groups come first: the cheap
+    exact pre-reduction (ICI hops under DCN gating) runs before the
+    quantizer sees the data, so the compressed group quantizes the
+    already-averaged gradient."""
+    axes = _axis_tuple(axis)
+    if isinstance(policy, str):
+        per = {ax: policy for ax in axes}
+    else:
+        per = {ax: policy.get(ax, "fp32") for ax in axes}
+    for ax, p in per.items():
+        if p not in GRAD_SYNC_POLICIES:
+            raise ValueError(f"grad_sync policy {p!r} for axis {ax!r} "
+                             f"not in {GRAD_SYNC_POLICIES}")
+    groups = []
+    for p in GRAD_SYNC_POLICIES:    # fp32, bf16, int8, int4: lossless first
+        g = tuple(ax for ax in axes if per[ax] == p)
+        if g:
+            groups.append((g, p))
+    return groups
 
 
 # --------------------------------------------------------------------------
@@ -175,32 +325,45 @@ def bucket_sizes(total: int, bucket_numel: int, align: int):
     return sizes
 
 
-def compressed_tree_mean(tree, axis, policy: str = "int8",
-                         block: int = DEFAULT_BLOCK,
+def compressed_tree_mean(tree, axis,
+                         policy: Union[str, Mapping] = "int8",
+                         block: Optional[int] = None,
                          bucket_bytes: int = DEFAULT_BUCKET_BYTES,
                          residuals=None):
     """Mean-reduce a gradient pytree over ``axis`` through the bucketed
     compressed exchange.
 
+    ``policy`` is one name for every axis, or a per-axis mapping
+    ({axis: policy}, unlisted axes fp32) — the DCN-gating path: quantized
+    groups ride only the axes the caller marked, lossless groups
+    pre-reduce first. ``block=None`` picks the per-policy default (256
+    for int8, 64 for int4).
+
     Returns ``(mean_tree, new_residuals)``. ``residuals`` is the
-    error-feedback state (same treedef, fp32 leaves) consumed for the int8
-    policy: the effective gradient is ``g + residual`` and the new residual
-    is the part the quantizer dropped. For fp32/bf16 it is passed through
-    untouched. Outside a traced region (axis unbound) this is identity —
-    the single-card fast path, matching collective.py conventions.
+    error-feedback state (same treedef, fp32 leaves) consumed whenever
+    any group quantizes (int8/int4): the effective gradient is
+    ``g + residual`` and the new residual is the part the quantizers
+    dropped. For fp32/bf16 it is passed through untouched. Outside a
+    traced region (axis unbound) this is identity — the single-card fast
+    path, matching collective.py conventions.
     """
-    if policy not in GRAD_SYNC_POLICIES:
-        raise ValueError(f"grad_sync policy {policy!r} not in "
-                         f"{GRAD_SYNC_POLICIES}")
+    groups = normalize_axis_policies(axis, policy)   # also validates
     if not _axes_bound(axis):
         return tree, residuals
-    n = _axis_size(axis)
-    align = n * block
+    sizes = [_axis_size(axes) for axes, _ in groups]
+    blocks = [resolve_block(p, block) for _, p in groups]
+    align = 1
+    for (_, p), n_g, blk in zip(groups, sizes, blocks):
+        if p in QUANTIZED_POLICIES:
+            if p == "int4" and blk % 2:
+                raise ValueError(f"int4 block must be even, got {blk}")
+            align = math.lcm(align, n_g * blk)
+    quantized = any(p in QUANTIZED_POLICIES for _, p in groups)
 
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     res_leaves = (jax.tree_util.tree_flatten(residuals)[0]
                   if residuals is not None else None)
-    use_ef = policy == "int8" and res_leaves is not None
+    use_ef = quantized and res_leaves is not None
     out_leaves = [None] * len(leaves)
     new_res = list(res_leaves) if res_leaves is not None else None
 
@@ -218,18 +381,16 @@ def compressed_tree_mean(tree, axis, policy: str = "int8",
         if total != flat.size:
             flat = jnp.concatenate(
                 [flat, jnp.zeros(total - flat.size, jnp.float32)])
-        means, recons = [], []
+        means, errs = [], []
         off = 0
         for s in bucket_sizes(total, max(bucket_bytes // 4, align), align):
-            m, r = _bucket_mean(flat[off:off + s], axis, n, policy, block)
+            m, e = _bucket_mean(flat[off:off + s], groups, sizes, blocks)
             means.append(m)
-            recons.append(r)
+            errs.append(e)
             off += s
         mean = means[0] if len(means) == 1 else jnp.concatenate(means)
         if use_ef:
-            recon = (recons[0] if len(recons) == 1
-                     else jnp.concatenate(recons))
-            err = flat - recon
+            err = errs[0] if len(errs) == 1 else jnp.concatenate(errs)
         off = 0
         for i in idxs:
             sz = leaves[i].size
@@ -246,6 +407,84 @@ def compressed_tree_mean(tree, axis, policy: str = "int8",
     return out, res_out
 
 
+def compressed_psum_scatter(x, axis, scatter_dim: int = 0,
+                            policy: str = "int8",
+                            block: Optional[int] = None):
+    """Block-quantized reduce-scatter SUM over ``axis`` — phase 1 of the
+    two-phase exchange with NO gather: the wire-compressed drop-in for
+    ``lax.psum_scatter(x, axis, scatter_dimension=scatter_dim,
+    tiled=True)`` on the engine's ZeRO-2/3 sharded-grad leaves (each rank
+    keeps only its own chunk, so gathering back would waste the win).
+
+    Returns the SUM like psum_scatter; callers divide by the axis size
+    themselves. Stateless — sharded leaves carry no error-feedback
+    residual (their quantization error is fresh per step). Lossless
+    policies fall back to the plain (bf16-cast for "bf16") psum_scatter.
+    """
+    if policy not in GRAD_SYNC_POLICIES:
+        raise ValueError(f"grad_sync policy {policy!r} not in "
+                         f"{GRAD_SYNC_POLICIES}")
+    if policy not in QUANTIZED_POLICIES:
+        if policy == "bf16" and x.dtype == jnp.float32:
+            return lax.psum_scatter(
+                x.astype(jnp.bfloat16), axis,
+                scatter_dimension=scatter_dim, tiled=True).astype(x.dtype)
+        return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim,
+                                tiled=True)
+    n = _axis_size(axis)
+    blk = resolve_block(policy, block)
+    if policy == "int4":
+        if blk % 2:
+            raise ValueError(f"int4 block must be even, got {blk}")
+        quant, dequant, levels = (quantize_int4_blocks,
+                                  dequantize_int4_blocks, 7.0)
+    else:
+        quant, dequant, levels = (quantize_int8_blocks,
+                                  dequantize_int8_blocks, 127.0)
+    xm = jnp.moveaxis(x, scatter_dim, 0)
+    d0 = xm.shape[0]
+    if d0 % n:
+        raise ValueError(f"scatter dim size {d0} not divisible by axis "
+                         f"size {n}")
+    chunk_shape = (d0 // n,) + xm.shape[1:]
+    m = math.prod(chunk_shape)
+    m_pad = _round_up(max(m, 1), blk)
+    rows = xm.astype(jnp.float32).reshape(n, m)
+    if m_pad != m:
+        rows = jnp.concatenate(
+            [rows, jnp.zeros((n, m_pad - m), jnp.float32)], axis=1)
+    flat = rows.reshape(-1)
+    # shared per-block scale so the reduction is a pure integer sum;
+    # int4's scale traffic rides bf16 like the all-reduce path
+    _, local_scale = quant(flat, blk)
+    amax = local_scale * levels
+    if policy == "int4":
+        amax = lax.pmax(amax.astype(jnp.bfloat16), axis).astype(jnp.float32)
+    else:
+        amax = lax.pmax(amax, axis)
+    scale = jnp.maximum(amax, 1e-30) / levels
+    q, _ = quant(flat, blk, scale=scale)
+    if n > 1:
+        if policy == "int4":
+            packed = pack_int4(q).reshape(n, m_pad // 2)
+            recv = lax.all_to_all(packed, axis, split_axis=0,
+                                  concat_axis=0, tiled=False)
+            vals = unpack_int4(recv.reshape(-1)).reshape(n, m_pad)
+            acc = jnp.sum(vals.astype(int4_accum_dtype(n)), axis=0)
+        else:
+            recv = lax.all_to_all(q.reshape(n, m_pad), axis, split_axis=0,
+                                  concat_axis=0, tiled=False)
+            acc = jnp.sum(recv.astype(jnp.int32), axis=0)
+        idx = lax.axis_index(axis)
+        nsc = m_pad // blk
+        my_scales = lax.dynamic_slice_in_dim(scale, idx * nsc, nsc, axis=0)
+    else:
+        acc, my_scales = q, scale
+    red = dequant(acc, my_scales, blk)
+    out = red[:m].reshape(chunk_shape)
+    return jnp.moveaxis(out, 0, scatter_dim).astype(x.dtype)
+
+
 def init_residuals(tree):
     """Zero error-feedback state for a gradient pytree (fp32 leaves)."""
     return jax.tree_util.tree_map(
@@ -257,17 +496,18 @@ def init_residuals(tree):
 # --------------------------------------------------------------------------
 
 def wire_bytes_per_rank(numel: int, n: int, policy: str,
-                        block: int = DEFAULT_BLOCK,
+                        block: Optional[int] = None,
                         dtype_bytes: int = 4) -> float:
     """Bytes each rank moves for one mean over ``numel`` elements, ring
     algorithms: all-reduce = 2(n-1)/n payloads, reduce-scatter/all-gather =
-    (n-1)/n each. The int8 figure counts both phases plus every scale
+    (n-1)/n each. The quantized figures count both phases plus every scale
     exchange (the pmax all-reduce of per-block scales and the phase-2
-    gathered scales)."""
+    gathered scales); int4 moves half a byte per value and 2-byte bf16
+    scales."""
     if n <= 1:
         return 0.0
     ring = (n - 1) / n
-    nscales = numel / block
+    nscales = numel / resolve_block(policy, block)
     if policy == "fp32":
         return 2 * ring * numel * dtype_bytes
     if policy == "bf16":
@@ -276,11 +516,15 @@ def wire_bytes_per_rank(numel: int, n: int, policy: str,
         return (2 * ring * nscales * 4        # phase 0: scale pmax
                 + ring * numel * 1            # phase 1: int8 all_to_all
                 + ring * (numel * 1 + nscales * 4))  # phase 2: all_gather
+    if policy == "int4":
+        return (2 * ring * nscales * 2        # phase 0: bf16 scale pmax
+                + ring * numel * 0.5          # phase 1: packed all_to_all
+                + ring * (numel * 0.5 + nscales * 2))  # phase 2: all_gather
     raise ValueError(f"unknown policy {policy!r}")
 
 
 def tree_wire_bytes(tree, n: int, policy: str,
-                    block: int = DEFAULT_BLOCK) -> float:
+                    block: Optional[int] = None) -> float:
     """Logical bytes ONE ``compressed_tree_mean`` over ``n`` ranks moves
     per rank for this pytree — the telemetry counterpart of
     ``wire_bytes_per_rank``, applying the exchange's actual grouping:
@@ -288,8 +532,9 @@ def tree_wire_bytes(tree, n: int, policy: str,
     ``n*block``; non-float leaves go through a per-leaf pmean."""
     if n <= 1:
         return 0.0
+    blk = resolve_block(policy, block)
     leaves = jax.tree_util.tree_leaves(tree)
-    align = n * block
+    align = n * blk
     total = 0.0
     for dtype, idxs in _dtype_groups(leaves).items():
         sizes = [int(jnp.asarray(leaves[i]).size) for i in idxs]
@@ -298,7 +543,7 @@ def tree_wire_bytes(tree, n: int, policy: str,
             total += sum(2 * (n - 1) / n * s * itemsize for s in sizes)
             continue
         padded = _round_up(sum(sizes), align)
-        total += wire_bytes_per_rank(padded, n, policy, block)
+        total += wire_bytes_per_rank(padded, n, policy, blk)
     return total
 
 

@@ -38,8 +38,9 @@ from .. import telemetry as _telemetry
 from ..framework.random import get_rng_key
 from ..jit.functionalization import functional_call, state_of
 from ..resilience.guard import all_finite
-from .compressed import compressed_tree_mean
-from .mesh import require_mesh
+from .compressed import (QUANTIZED_POLICIES, compressed_psum_scatter,
+                         compressed_tree_mean, normalize_axis_policies)
+from .mesh import axis_links, require_mesh
 from .meta_parallel.pipeline_parallel import PipelineParallel
 from .meta_parallel.sharding_parallel import shard_spec_for
 
@@ -71,8 +72,9 @@ class ParallelTrainer:
                  zero_stage: int = 0, accumulate_steps: int = 1,
                  fp16_allreduce: bool = False,
                  grad_sync: Optional[str] = None,
-                 grad_sync_block: int = 256,
+                 grad_sync_block: Optional[int] = None,
                  grad_sync_bucket_bytes: int = 4 << 20,
+                 grad_sync_dcn_only: Optional[bool] = None,
                  nan_guard: bool = True,
                  scaler=None):
         self.model = model
@@ -99,9 +101,12 @@ class ParallelTrainer:
         # sync is a bucketed flat exchange — "fp32" exact, "bf16" half the
         # wire bytes (reference fp16_allreduce_optimizer.py), "int8" the
         # EQuARX-style two-phase block-scaled exchange with error feedback
-        # (~4x fewer bytes). Resolution: explicit arg > the wrapper model's
-        # grad_sync attribute (DataParallel / ShardingParallel strategy) >
-        # the legacy fp16_allreduce flag.
+        # (~4x fewer bytes), "int4" its nibble-packed sibling (~7x).
+        # grad_sync_dcn_only gates the quantized policy to the mesh axes
+        # whose link type is "dcn" (mesh.axis_links): ICI hops stay fp32.
+        # Resolution: explicit arg > the wrapper model's grad_sync
+        # attribute (DataParallel / ShardingParallel strategy) > the
+        # legacy fp16_allreduce flag.
         if grad_sync is None:
             grad_sync = getattr(model, "grad_sync", None)
             if grad_sync is not None:
@@ -109,11 +114,15 @@ class ParallelTrainer:
                                           grad_sync_block)
                 grad_sync_bucket_bytes = getattr(
                     model, "grad_sync_bucket_bytes", grad_sync_bucket_bytes)
+        if grad_sync_dcn_only is None:
+            grad_sync_dcn_only = bool(getattr(model, "grad_sync_dcn_only",
+                                              False))
         if grad_sync is None:
             grad_sync = "bf16" if fp16_allreduce else "fp32"
         self.grad_sync = grad_sync
         self.grad_sync_block = grad_sync_block
         self.grad_sync_bucket_bytes = grad_sync_bucket_bytes
+        self.grad_sync_dcn_only = grad_sync_dcn_only
         self.fp16_allreduce = fp16_allreduce or grad_sync == "bf16"
         # GradientMerge (reference: fleet/meta_optimizers
         # gradient_merge_optimizer + DistributedStrategy.gradient_merge):
@@ -211,9 +220,23 @@ class ParallelTrainer:
         axes = DATA_AXES + ("sep",) if sep else DATA_AXES
         # hand-built meshes may omit axes (build_mesh always has all five)
         self.reduce_axes = tuple(ax for ax in axes if ax in self.mesh.shape)
+        # per-axis exchange policy: under DCN gating the quantized policy
+        # rides only the axes whose link type is "dcn" (explicit
+        # mesh.set_axis_links override, else inferred from slice
+        # structure); ICI axes pre-reduce losslessly in fp32.
+        if self.grad_sync_dcn_only and self.grad_sync in QUANTIZED_POLICIES:
+            links = axis_links(self.mesh)
+            self._axis_policy = {
+                ax: (self.grad_sync if links.get(ax) == "dcn" else "fp32")
+                for ax in self.reduce_axes}
+            self._any_quantized = any(
+                p in QUANTIZED_POLICIES for p in self._axis_policy.values())
+        else:
+            self._axis_policy = self.grad_sync
+            self._any_quantized = self.grad_sync in QUANTIZED_POLICIES
         self.comm_err_specs = {}
         comm_err = {}
-        if self.grad_sync == "int8":
+        if self._any_quantized:
             R = 1
             for ax in self.reduce_axes:
                 R *= self.mesh.shape.get(ax, 1)
@@ -317,6 +340,22 @@ class ParallelTrainer:
         zero3_dims = self.zero3_dims
         zero2_dims = self.zero2_dims
         n_shard = mesh.shape.get("sharding", 1)
+        # ZeRO-2/3 sharded-grad leaves: block-quantized reduce-scatter
+        # (phase 1 of the exchange, no gather) when the sharding axis's
+        # policy quantizes; lossless policies keep the plain psum_scatter.
+        rs_policy = (self._axis_policy.get("sharding", "fp32")
+                     if isinstance(self._axis_policy, dict)
+                     else self._axis_policy)
+        if rs_policy not in QUANTIZED_POLICIES:
+            rs_policy = None
+
+        def _reduce_scatter(g, d):
+            if rs_policy is not None:
+                return compressed_psum_scatter(
+                    g, "sharding", scatter_dim=d, policy=rs_policy,
+                    block=self.grad_sync_block) / n_shard
+            return lax.psum_scatter(g, "sharding", scatter_dimension=d,
+                                    tiled=True) / n_shard
         pipe_n = mesh.shape.get("pipe", 1)
         # params NOT sharded over the pipe axis (embedding/norm/head under
         # PP, i.e. everything outside the _StackedStage bodies) are
@@ -336,10 +375,11 @@ class ParallelTrainer:
         sync_axes = tuple(ax for ax in reduce_axes if ax in mesh.shape)
         live_axes = tuple(ax for ax in sync_axes
                           if mesh.shape.get(ax, 1) > 1)
-        if self.grad_sync != "int8":
-            # fp32/bf16: size-1 axes are pure no-ops, skip them; int8 keeps
-            # the full tuple so the quantize->dequantize (and the residual
-            # update) runs identically at any device count
+        if not self._any_quantized:
+            # fp32/bf16: size-1 axes are pure no-ops, skip them; the
+            # quantized policies keep the full tuple so the
+            # quantize->dequantize (and the residual update) runs
+            # identically at any device count
             sync_axes = live_axes
 
         # loss scaling (scaler attached): the loss is scaled BEFORE the
@@ -426,10 +466,7 @@ class ParallelTrainer:
                     if pp_grads is not None:
                         # manual grads are wrt the GATHERED param: explicit
                         # reduce-scatter (mean) back onto the storage shard
-                        grads[k] = lax.psum_scatter(
-                            grads[k], "sharding",
-                            scatter_dimension=zero3_dims[k],
-                            tiled=True) / n_shard
+                        grads[k] = _reduce_scatter(grads[k], zero3_dims[k])
                     else:
                         grads[k] = grads[k] / n_shard
                     for ax in ("data", "sep"):
@@ -437,10 +474,7 @@ class ParallelTrainer:
                             grads[k] = _pmean(grads[k], ax)
                 elif k in zero2_dims:
                     # reduce-scatter (mean) over sharding; pmean over data
-                    grads[k] = lax.psum_scatter(
-                        grads[k], "sharding",
-                        scatter_dimension=zero2_dims[k],
-                        tiled=True) / n_shard
+                    grads[k] = _reduce_scatter(grads[k], zero2_dims[k])
                     for ax in ("data", "sep"):
                         if ax in reduce_axes and mesh.shape.get(ax, 1) > 1:
                             grads[k] = _pmean(grads[k], ax)
@@ -457,7 +491,7 @@ class ParallelTrainer:
                 res = ({k: comm_err[k][0] for k in plain}
                        if comm_err else None)
                 mean, res = compressed_tree_mean(
-                    plain, sync_axes, policy=self.grad_sync,
+                    plain, sync_axes, policy=self._axis_policy,
                     block=self.grad_sync_block,
                     bucket_bytes=self.grad_sync_bucket_bytes,
                     residuals=res)
@@ -599,25 +633,39 @@ class ParallelTrainer:
         self._last_cache_key = None
 
         # Telemetry wire accounting: logical bytes one train_step's
-        # bucketed DP exchange moves per rank. Static per trainer (the
-        # exchange is shape-independent of the batch); ZeRO-2/3 leaves go
-        # through per-tensor psum_scatter and are not counted here.
+        # bucketed DP exchange moves per rank, split per (policy, link)
+        # exchange group so the Prometheus path shows ICI vs DCN bytes
+        # separately. Static per trainer (the exchange is
+        # shape-independent of the batch); ZeRO-2/3 leaves go through
+        # per-tensor (possibly compressed) psum_scatter and are not
+        # counted here.
         n_sync = 1
         for ax in sync_axes:
             n_sync *= mesh.shape.get(ax, 1)
         plain_params = {k: v for k, v in self.state["params"].items()
                         if self.trainable[k] and k not in zero2_dims
                         and k not in zero3_dims}
+        self._wire_parts = []      # [(policy, link, bytes_per_step)]
+        self._wire_bytes_per_step = 0.0
+        self._wire_fp32_per_step = 0.0
         if plain_params and n_sync > 1:
             from .compressed import tree_wire_bytes
-            self._wire_bytes_per_step = K * tree_wire_bytes(
-                plain_params, n_sync, self.grad_sync,
-                block=self.grad_sync_block)
+            links = axis_links(mesh)
+            for axes_g, pol in normalize_axis_policies(sync_axes,
+                                                       self._axis_policy):
+                n_g = 1
+                for ax in axes_g:
+                    n_g *= mesh.shape.get(ax, 1)
+                if n_g <= 1:
+                    continue
+                link = ("dcn" if any(links.get(ax) == "dcn"
+                                     for ax in axes_g) else "ici")
+                b = K * tree_wire_bytes(plain_params, n_g, pol,
+                                        block=self.grad_sync_block)
+                self._wire_parts.append((pol, link, b))
+            self._wire_bytes_per_step = sum(p[2] for p in self._wire_parts)
             self._wire_fp32_per_step = K * tree_wire_bytes(
                 plain_params, n_sync, "fp32", block=self.grad_sync_block)
-        else:
-            self._wire_bytes_per_step = 0.0
-            self._wire_fp32_per_step = 0.0
 
     def _leaf_spec(self, x):
         """Per-leaf data PartitionSpec (see make_step docstring)."""
@@ -847,11 +895,13 @@ class ParallelTrainer:
                 "donated_bytes", "bytes of donated state "
                 "(params + opt + comm_err)").set(cost["donated_bytes"])
         if self._wire_bytes_per_step:
-            _telemetry.counter(
+            wire = _telemetry.counter(
                 "grad_sync_bytes_total",
                 "logical wire bytes per rank of the bucketed grad "
-                "exchange").inc(self._wire_bytes_per_step,
-                                policy=self.grad_sync)
+                "exchange, per exchange group")
+            for pol, link, b in self._wire_parts:
+                if b:
+                    wire.inc(b, policy=pol, link=link)
             if self._wire_bytes_per_step > 0:
                 _telemetry.gauge(
                     "grad_sync_compression_x",

@@ -62,7 +62,7 @@ def recompute(function: Callable, *args, preserve_rng_state: bool = True,
 
 
 def fused_allreduce_gradients(grads, hcg=None, axes=("data", "sharding"),
-                              grad_sync: str = "fp32", block: int = 256,
+                              grad_sync="fp32", block=None,
                               bucket_bytes: int = 4 << 20, residuals=None):
     """Average a gradient pytree over the data-parallel axes. Valid inside
     shard_map/pmap where the axes are bound; outside (single device or pure
@@ -72,8 +72,9 @@ def fused_allreduce_gradients(grads, hcg=None, axes=("data", "sharding"),
     segments and exchanged over ONE axis tuple via
     ``distributed/compressed.py`` — one collective per bucket instead of one
     per tensor (the reference hybrid_parallel_util.py:117 bucketing).
-    ``grad_sync`` picks the wire format ("fp32" | "bf16" | "int8"); the int8
-    policy takes and returns an error-feedback ``residuals`` pytree, in
+    ``grad_sync`` picks the wire format ("fp32" | "bf16" | "int8" | "int4",
+    or a per-axis {axis: policy} mapping for DCN gating); the quantized
+    policies take and return an error-feedback ``residuals`` pytree, in
     which case the return is ``(grads, new_residuals)``."""
     from ..compressed import compressed_tree_mean
     live = []

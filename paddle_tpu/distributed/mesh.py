@@ -9,6 +9,17 @@ every subgroup collective from shardings/axis names.
 
 An extra "sep" (sequence/context-parallel) axis is supported beyond the
 reference — used by ring attention (SURVEY.md §5 long-context gap).
+
+Axis link types: every mesh axis rides one of two physical link classes —
+"ici" (intra-slice torus, ~100s of GB/s per chip) or "dcn" (the
+data-center network between slices, ~10s of Gb/s per host). The
+compressed gradient exchange (distributed/compressed.py) gates its
+quantization per axis on this map: quantize overhead LOSES on ICI hops
+and wins on DCN. ``axis_links`` infers the map from the devices' slice
+structure (``slice_index`` on multi-slice TPU, ``process_index``
+otherwise); ``set_axis_links`` / ``build_mesh(axis_links=...)`` override
+it explicitly (the only way a forced-host CPU test mesh can model a
+multi-slice topology).
 """
 from __future__ import annotations
 
@@ -22,22 +33,36 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: F401
 
 AXES_ORDER = ("data", "pipe", "sharding", "sep", "model")
 
+LINK_TYPES = ("ici", "dcn")
+
 
 class _MeshState(threading.local):
     def __init__(self):
         self.mesh: Optional[Mesh] = None
+        # _mesh_key(mesh) -> {axis: "ici"|"dcn"} explicit overrides
+        self.links: Dict[tuple, Dict[str, str]] = {}
 
 
 _state = _MeshState()
 
 
-def build_mesh(degrees: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+def _mesh_key(mesh: Mesh) -> tuple:
+    return (tuple(mesh.axis_names), tuple(mesh.devices.shape),
+            tuple(int(getattr(d, "id", i))
+                  for i, d in enumerate(mesh.devices.flat)))
+
+
+def build_mesh(degrees: Dict[str, int], devices: Optional[Sequence] = None,
+               axis_links: Optional[Dict[str, str]] = None) -> Mesh:
     """Create (and set current) a named mesh from per-axis degrees.
 
     Axis order follows the reference's topology.py ordering so that
     neighboring ranks in the fastest-varying axis ("model") are
     ICI-adjacent — TP traffic rides the fastest links, DP the slowest, the
     same locality reasoning as the reference's ring assignment.
+
+    ``axis_links`` optionally pins each axis's link type ("ici"/"dcn")
+    instead of inferring it from the devices' slice structure.
     """
     devices = list(devices if devices is not None else jax.devices())
     shape = [int(degrees.get(a, 1)) for a in AXES_ORDER]
@@ -51,6 +76,8 @@ def build_mesh(degrees: Dict[str, int], devices: Optional[Sequence] = None) -> M
     arr = np.asarray(devices).reshape(shape)
     mesh = Mesh(arr, AXES_ORDER)
     _state.mesh = mesh
+    if axis_links is not None:
+        set_axis_links(axis_links, mesh=mesh)
     return mesh
 
 
@@ -79,6 +106,89 @@ def axis_size(axis: str) -> int:
     if mesh is None or axis not in mesh.axis_names:
         return 1
     return mesh.shape[axis]
+
+
+# ---------------------------------------------------------------------------
+# mesh-axis -> link-type map (ICI vs DCN)
+# ---------------------------------------------------------------------------
+
+def set_axis_links(links: Dict[str, str], mesh: Optional[Mesh] = None
+                   ) -> Dict[str, str]:
+    """Explicitly declare each axis's link type for ``mesh`` (current mesh
+    when None). Unlisted axes default to "ici" at query time. Overrides
+    inference — the knob for CPU test meshes modeling multi-slice
+    topologies and for operators who know better than the heuristic."""
+    mesh = mesh if mesh is not None else require_mesh()
+    links = {str(k): str(v) for k, v in links.items()}
+    for ax, link in links.items():
+        if link not in LINK_TYPES:
+            raise ValueError(f"link type for axis {ax!r} must be one of "
+                             f"{LINK_TYPES}, got {link!r}")
+        if ax not in mesh.axis_names:
+            raise ValueError(f"axis {ax!r} not in mesh axes "
+                             f"{tuple(mesh.axis_names)}")
+    _state.links[_mesh_key(mesh)] = links
+    return links
+
+
+def explicit_axis_links(mesh: Optional[Mesh] = None
+                        ) -> Optional[Dict[str, str]]:
+    """The explicitly-set link map for ``mesh``, or None if none was set
+    (callers that must not guess — e.g. the link-mismatch lint on
+    single-slice hosts — check this before falling back to inference)."""
+    mesh = mesh if mesh is not None else get_mesh()
+    if mesh is None:
+        return None
+    links = _state.links.get(_mesh_key(mesh))
+    return dict(links) if links is not None else None
+
+
+def _slice_id(device) -> int:
+    """The failure/link domain a device lives in: TPU slice when the
+    platform exposes it, else the owning process (multi-host CPU/GPU)."""
+    for attr in ("slice_index",):
+        v = getattr(device, attr, None)
+        if v is not None:
+            return int(v)
+    return int(getattr(device, "process_index", 0))
+
+
+def infer_axis_links(mesh: Optional[Mesh] = None) -> Dict[str, str]:
+    """Infer each axis's link type from the device array: an axis is
+    "dcn" iff stepping along it ever crosses a slice (or process)
+    boundary — those hops leave the ICI torus. Size-1 axes are "ici"
+    (they move no data)."""
+    mesh = mesh if mesh is not None else require_mesh()
+    devs = mesh.devices
+    ids = np.empty(devs.shape, dtype=np.int64)
+    for idx, d in np.ndenumerate(devs):
+        ids[idx] = _slice_id(d)
+    links = {}
+    for i, ax in enumerate(mesh.axis_names):
+        if devs.shape[i] <= 1:
+            links[ax] = "ici"
+            continue
+        lo = np.take(ids, range(devs.shape[i] - 1), axis=i)
+        hi = np.take(ids, range(1, devs.shape[i]), axis=i)
+        links[ax] = "dcn" if bool((lo != hi).any()) else "ici"
+    return links
+
+
+def axis_links(mesh: Optional[Mesh] = None) -> Dict[str, str]:
+    """The axis -> link-type map: explicit override when set, else
+    inferred from slice structure."""
+    mesh = mesh if mesh is not None else require_mesh()
+    explicit = explicit_axis_links(mesh)
+    if explicit is not None:
+        out = {ax: "ici" for ax in mesh.axis_names}
+        out.update(explicit)
+        return out
+    return infer_axis_links(mesh)
+
+
+def axis_link(axis: str, mesh: Optional[Mesh] = None) -> str:
+    """Link type of one axis ("ici" when the axis is unknown)."""
+    return axis_links(mesh).get(axis, "ici")
 
 
 class CommunicateTopology:

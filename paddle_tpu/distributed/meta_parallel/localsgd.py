@@ -29,8 +29,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...framework.random import get_rng_key
 from ...jit.functionalization import functional_call, state_of
-from ..compressed import (DEFAULT_BLOCK, DEFAULT_BUCKET_BYTES,
-                          GRAD_SYNC_POLICIES, compressed_tree_mean)
+from ..compressed import (DEFAULT_BUCKET_BYTES, GRAD_SYNC_POLICIES,
+                          QUANTIZED_POLICIES, compressed_tree_mean)
 from ..mesh import require_mesh
 
 shard_map = jax.shard_map
@@ -45,17 +45,25 @@ class LocalSGDTrainer:
     ``param_sync`` compresses the periodic parameter exchange
     (distributed/compressed.py): what crosses the wire is each replica's
     DELTA from the shared anchor (the last-synced params) — deltas are
-    update-sized, so block-scaled int8 keeps its resolution on them, where
-    quantizing absolute parameter values would drown the local progress in
-    rounding. The int8 policy carries a per-replica error-feedback
-    residual; optimizer moments always average exactly (they are not
-    wire-critical: same bytes, but no compounding)."""
+    update-sized, so block-scaled int8/int4 keeps its resolution on them,
+    where quantizing absolute parameter values would drown the local
+    progress in rounding. The quantized policies carry a per-replica
+    error-feedback residual; optimizer moments always average exactly
+    (they are not wire-critical: same bytes, but no compounding).
+
+    The step is a TWO-PROGRAM cache keyed like engine's ``_step_cache``
+    (program kind × data shapes): the sync program issues the averaging
+    collectives, the no-sync program contains NONE — XLA cannot skip a
+    collective data-dependently, so the old ``jnp.where(do_sync, ...)``
+    still paid the full exchange on every step. The sync decision is a
+    host-side modulo (``step_no % k``), so AdaptiveLocalSGD's k schedule
+    still never recompiles — it only picks which cached program runs."""
 
     def __init__(self, model, optimizer, loss_fn: Callable, mesh=None,
                  k_steps: int = 1, adaptive: bool = False,
                  init_k_steps: int = 1, max_k_steps: int = 16,
                  param_sync: str = "fp32",
-                 param_sync_block: int = DEFAULT_BLOCK,
+                 param_sync_block=None,
                  param_sync_bucket_bytes: int = DEFAULT_BUCKET_BYTES):
         self.model = model
         self.optimizer = optimizer
@@ -117,7 +125,7 @@ class LocalSGDTrainer:
         self.state["sync_err"] = (
             OrderedDict((k, rep(jnp.zeros(jnp.shape(v), jnp.float32)))
                         for k, v in tparams.items())
-            if self.param_sync == "int8" else OrderedDict())
+            if self.param_sync in QUANTIZED_POLICIES else OrderedDict())
 
     def _build(self):
         mesh = self.mesh
@@ -139,23 +147,25 @@ class LocalSGDTrainer:
                 return loss_fn(out, labels)
 
             loss, grads = jax.value_and_grad(lf)(p)
-            # NO grad pmean — that is the whole point of LocalSGD
-            rep_loss = jax.lax.pmean(loss, "data")  # reporting only
-            return rep_loss, {k: g[None] for k, g in grads.items()}
+            # NO grad pmean — that is the whole point of LocalSGD. The
+            # loss leaves PER-REPLICA ((D,) outside) and is averaged on
+            # the host: a reporting pmean here would put a collective in
+            # the no-sync program, which must contain none.
+            return loss[None], {k: g[None] for k, g in grads.items()}
 
         pspec = {k: P("data", *([None] * (v.ndim - 1)))
                  for k, v in self.state["params"].items()}
         sharded_grads = shard_map(
             grads_fn, mesh=mesh,
             in_specs=(pspec, P(), P(), P(), P(("data",)), P(("data",))),
-            out_specs=(P(), pspec),
+            out_specs=(P(("data",)), pspec),
             check_vma=False)
 
         sharded_sync = None
         if self.param_sync != "fp32":
             err_spec = {k: pspec[k] for k in self.state["sync_err"]}
 
-            def sync_fn(new_p, anchor, sync_err, do_sync):
+            def sync_fn(new_p, anchor, sync_err):
                 # local views: params (1, *shape); anchor shared (*shape).
                 # Exchange the per-replica DELTA from the anchor — the
                 # compressed mean of deltas IS the mean param minus anchor
@@ -168,65 +178,101 @@ class LocalSGDTrainer:
                     bucket_bytes=self.param_sync_bucket_bytes,
                     residuals=res)
                 synced = {k: anchor[k] + mean_d[k] for k in deltas}
-                out_p = {k: jnp.where(do_sync, synced[k],
-                                      new_p[k][0])[None] for k in new_p}
-                new_anchor = {k: jnp.where(do_sync, synced[k], anchor[k])
-                              for k in anchor}
-                new_err = ({k: jnp.where(do_sync, res[k],
-                                         sync_err[k][0])[None]
-                            for k in sync_err} if sync_err else sync_err)
-                return out_p, new_anchor, new_err
+                out_p = {k: synced[k][None] for k in new_p}
+                new_err = ({k: res[k][None] for k in sync_err}
+                           if sync_err else sync_err)
+                return out_p, dict(synced), new_err
 
             anchor_spec = {k: P() for k in self.state["anchor"]}
             sharded_sync = shard_map(
                 sync_fn, mesh=mesh,
-                in_specs=(pspec, anchor_spec, err_spec, P()),
+                in_specs=(pspec, anchor_spec, err_spec),
                 out_specs=(pspec, anchor_spec, err_spec),
                 check_vma=False)
 
-        def train_step(params, frozen, buffers, opt_state, anchor,
-                       sync_err, key, lr, step_no, k_arr, inputs, labels):
-            loss, grads = sharded_grads(dict(params), dict(frozen),
-                                        dict(buffers), key, inputs, labels)
-            new_p, new_opt = opt.apply_gradients(dict(params), grads,
-                                                 opt_state, lr=lr)
-            # sync step: average params (and moments) over replicas —
-            # XLA inserts the cross-replica all-reduce here
-            do_sync = (step_no % k_arr) == 0
+        def make_train_step(do_sync: bool):
+            """One of the two programs: with the collectives (sync) or
+            with NONE (the truly communication-free local step)."""
 
-            def avg(v):
-                m = jnp.broadcast_to(jnp.mean(v, axis=0, keepdims=True),
-                                     v.shape)
-                return jnp.where(do_sync, m, v)
+            def train_step(params, frozen, buffers, opt_state, anchor,
+                           sync_err, key, lr, inputs, labels):
+                loss, grads = sharded_grads(dict(params), dict(frozen),
+                                            dict(buffers), key, inputs,
+                                            labels)
+                new_p, new_opt = opt.apply_gradients(dict(params), grads,
+                                                     opt_state, lr=lr)
+                if do_sync:
+                    # average params (and moments) over replicas — XLA
+                    # inserts the cross-replica all-reduce here
+                    def avg(v):
+                        return jnp.broadcast_to(
+                            jnp.mean(v, axis=0, keepdims=True), v.shape)
 
-            if sharded_sync is not None:
-                new_p, anchor, sync_err = sharded_sync(
-                    dict(new_p), dict(anchor), dict(sync_err), do_sync)
-            else:
-                new_p = {k: avg(v) for k, v in new_p.items()}
-            new_opt = dict(new_opt)
-            new_opt["slots"] = jax.tree_util.tree_map(
-                avg, new_opt.get("slots", {}))
-            return loss, new_p, new_opt, anchor, sync_err
+                    if sharded_sync is not None:
+                        new_p, anchor, sync_err = sharded_sync(
+                            dict(new_p), dict(anchor), dict(sync_err))
+                    else:
+                        new_p = {k: avg(v) for k, v in new_p.items()}
+                    new_opt = dict(new_opt)
+                    new_opt["slots"] = jax.tree_util.tree_map(
+                        avg, new_opt.get("slots", {}))
+                return loss, new_p, new_opt, anchor, sync_err
 
-        self._step = jax.jit(train_step, donate_argnums=(0, 3))
+            return train_step
+
+        self._program_fns = {True: make_train_step(True),
+                             False: make_train_step(False)}
+        self._step_cache = {}    # (do_sync, data shapes) -> jitted program
+        self._cache_hits = 0
+
+    def _cache_key(self, do_sync: bool, inputs, labels):
+        shapes = tuple(
+            (tuple(jnp.shape(x)), str(jnp.asarray(x).dtype))
+            for x in jax.tree_util.tree_leaves((inputs, labels)))
+        return (bool(do_sync),) + shapes
+
+    def _get_step(self, do_sync: bool, inputs, labels):
+        key = self._cache_key(do_sync, inputs, labels)
+        step = self._step_cache.get(key)
+        if step is not None:
+            self._cache_hits += 1
+            return step
+        step = jax.jit(self._program_fns[bool(do_sync)],
+                       donate_argnums=(0, 3))
+        self._step_cache[key] = step
+        return step
+
+    def step_jaxpr(self, do_sync: bool, inputs, labels):
+        """The jaxpr of the (sync | no-sync) program for the current state
+        and these data shapes — the hook tests/analysis use to assert the
+        no-sync program carries zero collective primitives."""
+        return jax.make_jaxpr(self._program_fns[bool(do_sync)])(
+            dict(self.state["params"]), dict(self.state["frozen"]),
+            dict(self.state["buffers"]), self.state["opt"],
+            dict(self.state["anchor"]), dict(self.state["sync_err"]),
+            get_rng_key(), jnp.float32(0.1), jnp.asarray(inputs),
+            jnp.asarray(labels))
 
     def train_step(self, inputs, labels, lr=None):
         lr = self.optimizer.get_lr() if lr is None else lr
         self._step_no += 1
+        # host-side sync decision: picks WHICH cached program runs (the
+        # adaptive k schedule changes no traced operand, so no recompile)
+        do_sync = (self._step_no % self.k_steps) == 0
         data_sh = NamedSharding(self.mesh, P(("data",)))
         inputs = jax.device_put(jnp.asarray(inputs), data_sh)
         labels = jax.device_put(jnp.asarray(labels), data_sh)
-        loss, new_p, new_opt, new_anchor, new_err = self._step(
+        step = self._get_step(do_sync, inputs, labels)
+        loss, new_p, new_opt, new_anchor, new_err = step(
             self.state["params"], self.state["frozen"],
             self.state["buffers"], self.state["opt"],
             self.state["anchor"], self.state["sync_err"], get_rng_key(),
-            lr, jnp.asarray(self._step_no), jnp.asarray(self.k_steps),
-            inputs, labels)
+            lr, inputs, labels)
         self.state["params"] = new_p
         self.state["opt"] = new_opt
         self.state["anchor"] = new_anchor
         self.state["sync_err"] = new_err
+        loss = jnp.mean(loss)    # per-replica losses -> reported mean
         lv = float(loss)
         if self.adaptive:
             # reference localsgd_optimizer.py:425 communicate_avg_loss:

@@ -46,9 +46,12 @@ def opt_state_shardings(opt_state, n_shards, axis=SHARDING_AXIS):
 class ShardingParallel(Layer):
     """Wraps a model for ZeRO sharding. ``strategy.sharding_configs`` also
     carries the gradient-exchange policy consumed by the training engine
-    (distributed/compressed.py): ``grad_sync`` ("fp32" | "bf16" | "int8"),
-    ``grad_sync_block`` (quantization block), ``grad_sync_bucket_bytes``
-    (flat-bucket size — the reference Reducer's bucket MB knob)."""
+    (distributed/compressed.py): ``grad_sync``
+    ("fp32" | "bf16" | "int8" | "int4"), ``grad_sync_block``
+    (quantization block; None = per-policy default), ``grad_sync_dcn_only``
+    (gate the quantized policy to DCN mesh axes only), and
+    ``grad_sync_bucket_bytes`` (flat-bucket size — the reference Reducer's
+    bucket MB knob)."""
 
     def __init__(self, layers, hcg, strategy=None):
         super().__init__()
@@ -56,15 +59,19 @@ class ShardingParallel(Layer):
         self._hcg = hcg
         self.stage = 1
         self.grad_sync = "fp32"
-        self.grad_sync_block = 256
+        self.grad_sync_block = None
         self.grad_sync_bucket_bytes = 4 << 20
+        self.grad_sync_dcn_only = False
         if strategy is not None:
             cfg = strategy.sharding_configs
             self.stage = int(cfg.get("stage", 1))
             self.grad_sync = cfg.get("grad_sync", "fp32")
-            self.grad_sync_block = int(cfg.get("grad_sync_block", 256))
+            blk = cfg.get("grad_sync_block", None)
+            self.grad_sync_block = int(blk) if blk is not None else None
             self.grad_sync_bucket_bytes = int(
                 cfg.get("grad_sync_bucket_bytes", 4 << 20))
+            self.grad_sync_dcn_only = bool(
+                cfg.get("grad_sync_dcn_only", False))
         n = hcg.get_sharding_parallel_world_size()
         if self.stage >= 3:
             # stage 3: parameters themselves sharded

@@ -11,7 +11,9 @@ per-tensor grads coalesce into a few flat dtype-bucketed segments, and the
   "bf16"  grads cross the wire as bf16 (half the bytes — reference
           fp16_allreduce_optimizer.py);
   "int8"  EQuARX-style two-phase block-scaled int8 exchange with an
-          error-feedback residual (~4x fewer bytes).
+          error-feedback residual (~4x fewer bytes);
+  "int4"  the nibble-packed variant: two values per byte, per-64 blocks,
+          bf16 scales (~7x fewer bytes), same error feedback.
 
 ``comm_buffer_size`` (MB) is honored as the bucket size knob — the same
 meaning as the reference Reducer's bucket MB. ``DataParallel`` otherwise
@@ -25,15 +27,14 @@ import contextlib
 from jax import lax
 
 from ..nn.layer import Layer
-from .compressed import (DEFAULT_BLOCK, GRAD_SYNC_POLICIES,
-                         compressed_tree_mean, init_residuals)
+from .compressed import (GRAD_SYNC_POLICIES, compressed_tree_mean,
+                         init_residuals)
 
 
 class DataParallel(Layer):
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False,
-                 group=None, grad_sync="fp32",
-                 grad_sync_block=DEFAULT_BLOCK):
+                 group=None, grad_sync="fp32", grad_sync_block=None):
         super().__init__()
         if grad_sync not in GRAD_SYNC_POLICIES:
             raise ValueError(f"grad_sync {grad_sync!r} not in "

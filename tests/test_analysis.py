@@ -290,6 +290,102 @@ class TestRules:
                         jnp.zeros((8, 4), jnp.bfloat16))
         assert not rule_hits(clean, "amp-fp32-leak")
 
+    def test_int4_overflow_fires_on_narrow_accumulator(self):
+        """An int16 sum over 8192 int4-range values can reach 8192*7 >
+        int16 max — the hand-rolled-exchange overflow shape."""
+        x = jnp.zeros((8192, 4), jnp.int16)
+        cj = jax.make_jaxpr(
+            lambda v: jnp.sum(v, axis=0, dtype=jnp.int16))(x)
+        rep = analyze_jaxpr(cj)
+        assert len(rule_hits(rep, "int4-grad-sync-overflow")) == 1
+        assert not rep.ok   # severity "error" gates CI
+
+    def test_int4_overflow_silent_when_safe_or_widened(self):
+        # 64 * 7 fits int16: silent
+        small = jax.make_jaxpr(
+            lambda v: jnp.sum(v, axis=0, dtype=jnp.int16))(
+                jnp.zeros((64, 4), jnp.int16))
+        assert not rule_hits(analyze_jaxpr(small),
+                             "int4-grad-sync-overflow")
+        # the int4_accum_dtype fix — widen to int32 — is also silent
+        wide = jax.make_jaxpr(
+            lambda v: jnp.sum(v, axis=0, dtype=jnp.int32))(
+                jnp.zeros((8192, 4), jnp.int16))
+        assert not rule_hits(analyze_jaxpr(wide),
+                             "int4-grad-sync-overflow")
+
+    def _linked_mesh(self, links):
+        from paddle_tpu.distributed import mesh as mesh_mod
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("data", "model"))
+        mesh_mod.set_axis_links(links, mesh=mesh)
+        return mesh, mesh_mod
+
+    def test_link_mismatch_fires_on_int8_over_ici_only(self):
+        mesh, mesh_mod = self._linked_mesh({"data": "dcn",
+                                            "model": "ici"})
+        try:
+            f = jax.shard_map(
+                lambda v: lax.all_to_all(v.astype(jnp.int8), "model",
+                                         split_axis=0, concat_axis=0,
+                                         tiled=False),
+                mesh=mesh, in_specs=P(("data", "model"), None),
+                out_specs=P(("data", "model"), None), check_vma=False)
+            cj = jax.make_jaxpr(f)(jnp.zeros((8, 8), jnp.float32))
+            rep = analyze_jaxpr(cj, mesh=mesh)
+            hits = rule_hits(rep, "compressed-collective-link-mismatch")
+            assert len(hits) == 1
+            assert "ICI-only" in hits[0].message
+        finally:
+            mesh_mod._state.links.clear()
+
+    def test_link_mismatch_fires_on_large_fp32_over_dcn(self):
+        mesh, mesh_mod = self._linked_mesh({"data": "dcn"})
+        try:
+            f = jax.shard_map(
+                lambda v: lax.psum(v, "data"), mesh=mesh,
+                in_specs=P(("data", "model"), None),
+                out_specs=P(("data", "model"), None), check_vma=False)
+            cj = jax.make_jaxpr(f)(jnp.zeros((4, 64), jnp.float32))
+            cfg = AnalysisConfig(dcn_uncompressed_min_bytes=64.0)
+            rep = analyze_jaxpr(cj, mesh=mesh, config=cfg)
+            hits = rule_hits(rep, "compressed-collective-link-mismatch")
+            assert len(hits) == 1
+            assert "DCN" in hits[0].message
+            # default 1 MiB threshold: this tiny psum is clean
+            assert not rule_hits(analyze_jaxpr(cj, mesh=mesh),
+                                 "compressed-collective-link-mismatch")
+        finally:
+            mesh_mod._state.links.clear()
+
+    def test_link_mismatch_silent_without_links(self):
+        """Single-slice CPU meshes (no explicit map, inference says all
+        ICI) must not spam: the gating question does not arise."""
+        mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+        f = jax.shard_map(
+            lambda v: lax.psum(v.astype(jnp.int8), "data"), mesh=mesh,
+            in_specs=P("data"), out_specs=P(), check_vma=False)
+        cj = jax.make_jaxpr(f)(jnp.zeros(256, jnp.float32))
+        rep = analyze_jaxpr(cj, mesh=mesh)
+        assert not rule_hits(rep, "compressed-collective-link-mismatch")
+
+    def test_link_mismatch_silent_on_int8_over_dcn(self):
+        """Compressed traffic on the DCN axis is the WANTED deployment —
+        must stay silent."""
+        mesh, mesh_mod = self._linked_mesh({"data": "dcn"})
+        try:
+            f = jax.shard_map(
+                lambda v: lax.psum(v.astype(jnp.int8)
+                                   .astype(jnp.int8), "data"),
+                mesh=mesh, in_specs=P(("data", "model"), None),
+                out_specs=P(None, None), check_vma=False)
+            cj = jax.make_jaxpr(f)(jnp.zeros((4, 8), jnp.float32))
+            rep = analyze_jaxpr(cj, mesh=mesh)
+            assert not rule_hits(rep,
+                                 "compressed-collective-link-mismatch")
+        finally:
+            mesh_mod._state.links.clear()
+
     def test_register_rule_plugs_in_and_rejects_dupes(self):
         from paddle_tpu.analysis import rules as arules
         rid = "test-always-fires"

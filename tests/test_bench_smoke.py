@@ -51,7 +51,8 @@ def test_bench_parent_orchestration_all_configs_cpu():
     assert res["extra"]["heter_ctr"]["speedup_x"] > 0
     # the sweep recorded every CPU variant and picked a best
     sweep = res["extra"]["gpt_base"]["sweep"]
-    assert set(sweep) == {"fused_b4", "dense_b4", "fused_b4_int8dp"}
+    assert set(sweep) == {"fused_b4", "dense_b4", "fused_b4_int8dp",
+                          "fused_b4_int4dp"}
     assert res["extra"]["gpt_base"]["variant"] in sweep
     # telemetry harvested from the winning variant's scoped registry
     tel = res["extra"]["gpt_base"]["telemetry"]

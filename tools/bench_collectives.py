@@ -1,4 +1,4 @@
-"""Collective-exchange micro-benchmark: fp32 vs bf16 vs int8 gradient sync.
+"""Collective-exchange micro-benchmark: fp32 / bf16 / int8 / int4 grad sync.
 
 Measures the bucketed compressed exchange (distributed/compressed.py) over
 a forced-host-device mesh (or real TPU devices when present) and prints ONE
@@ -6,19 +6,24 @@ JSON line:
 
     {"metric": "int8_vs_fp32_bytes_x", "value": ..., "unit": "x",
      "extra": {per-policy: {wire_bytes_per_rank, ms_per_exchange,
-                            buckets, rel_err}}}
+                            buckets, rel_err},
+               "int4_vs_fp32_bytes_x": ...,          # expect >= 7
+               "per_axis_int4_dcn": {...}}}          # DCN-gated case
 
 Bytes-on-wire come from the analytic ring model in
 ``compressed.wire_bytes_per_rank`` (what each rank moves for one mean:
-all-reduce counts 2(n-1)/n payloads, the int8 figure counts both phases
-plus every scale exchange). Latency is wall-clock on whatever backend runs
-— on forced host devices it measures the code path, not ICI; on TPUs it is
-the real exchange time.
+all-reduce counts 2(n-1)/n payloads, the quantized figures count both
+phases plus every scale exchange — int4 moves nibbles plus bf16 scales).
+The per-axis case splits the devices into a 2-axis mesh, marks the outer
+axis "dcn" and runs int4 there with an exact fp32 pre-reduction on the
+inner ("ici") axis — the DCN-gating deployment shape. Latency is
+wall-clock on whatever backend runs — on forced host devices it measures
+the code path, not ICI; on TPUs it is the real exchange time.
 
 Usage:
     python tools/bench_collectives.py                     # defaults
     python tools/bench_collectives.py --numel 4194304 --devices 4 \
-        --block 256 --bucket-mb 4 --iters 20
+        --block 256 --int4-block 64 --bucket-mb 4 --iters 20
     python tools/bench_collectives.py --smoke   # tiny shapes + telemetry
                                                 # self-check (CI)
 """
@@ -37,6 +42,9 @@ def main():
                     help="forced host device count when no accelerator")
     ap.add_argument("--block", type=int, default=256,
                     help="int8 quantization block")
+    ap.add_argument("--int4-block", type=int, default=64,
+                    help="int4 quantization block (smaller: 4-bit steps "
+                         "are coarse)")
     ap.add_argument("--bucket-mb", type=int, default=4,
                     help="flat bucket size in MiB")
     ap.add_argument("--iters", type=int, default=10)
@@ -47,6 +55,7 @@ def main():
     args = ap.parse_args()
     if args.smoke:
         args.numel, args.devices, args.block = 4096, 2, 64
+        args.int4_block = 64
         args.iters, args.warmup = 2, 1
 
     from _mesh_setup import (data_mesh, ensure_repo_on_path,
@@ -54,20 +63,25 @@ def main():
     force_host_devices(args.devices)
     ensure_repo_on_path()
 
+    import math
+
     import numpy as np
     import jax
     import jax.numpy as jnp
 
     from paddle_tpu import telemetry
     from paddle_tpu.distributed.compressed import (
-        bucket_sizes, compressed_tree_mean, init_residuals,
-        wire_bytes_per_rank)
-    from jax.sharding import NamedSharding, PartitionSpec as P
+        QUANTIZED_POLICIES, bucket_sizes, compressed_tree_mean,
+        init_residuals, wire_bytes_per_rank)
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     mesh = data_mesh(args.devices)
     n = mesh.devices.size
     bucket_bytes = args.bucket_mb << 20
-    align = n * args.block
+    blocks = {"fp32": args.block, "bf16": args.block, "int8": args.block,
+              "int4": args.int4_block}
+    # one numel for every policy: align to the lcm of the block sizes
+    align = n * math.lcm(args.block, args.int4_block)
     numel = ((args.numel + align - 1) // align) * align
     nbuckets = len(bucket_sizes(numel, max(bucket_bytes // 4, align), align))
 
@@ -82,44 +96,53 @@ def main():
     tel = tel_cm.__enter__()
     reg = tel.registry
     extra = {}
-    for policy in ("fp32", "bf16", "int8"):
-        residuals = {"g": jnp.zeros((n, numel), jnp.float32)} \
-            if policy == "int8" else None
+
+    def run_case(run_mesh, axis, policy, block):
+        residuals = ({"g": jnp.zeros((n, numel), jnp.float32)}
+                     if (policy in QUANTIZED_POLICIES
+                         or (isinstance(policy, dict)
+                             and any(p in QUANTIZED_POLICIES
+                                     for p in policy.values())))
+                     else None)
+        dspec = P(tuple(a for a in run_mesh.axis_names), None) \
+            if len(run_mesh.axis_names) > 1 else P("data", None)
 
         def exchange(x, res):
             def f(xs, rs):
                 tree = {"g": xs[0]}
                 r = {"g": rs["g"][0]} if rs else None
                 mean, r = compressed_tree_mean(
-                    tree, "data", policy=policy, block=args.block,
+                    tree, axis, policy=policy, block=block,
                     bucket_bytes=bucket_bytes, residuals=r)
                 out_r = {"g": r["g"][None]} if rs else {}
                 return mean["g"][None], out_r
 
             return jax.shard_map(
-                f, mesh=mesh,
-                in_specs=(P("data", None),
-                          {"g": P("data", None)} if res else {}),
-                out_specs=(P("data", None),
-                           {"g": P("data", None)} if res else {}),
+                f, mesh=run_mesh,
+                in_specs=(dspec, {"g": dspec} if res else {}),
+                out_specs=(dspec, {"g": dspec} if res else {}),
                 check_vma=False)(x, res if res else {})
 
         jfn = jax.jit(exchange)
         res_in = residuals if residuals is not None else {}
-        out, _ = jfn(g_dev, res_in)
+        gd = jax.device_put(jnp.asarray(g), NamedSharding(run_mesh, dspec))
+        out, _ = jfn(gd, res_in)
         for _ in range(args.warmup):
-            out, _ = jfn(g_dev, res_in)
+            out, _ = jfn(gd, res_in)
         np.asarray(out)  # sync
         t0 = time.perf_counter()
         for _ in range(args.iters):
-            out, _ = jfn(g_dev, res_in)
+            out, _ = jfn(gd, res_in)
         np.asarray(out)
         dt = (time.perf_counter() - t0) / args.iters
-
         got = np.asarray(out)[0]
         rel = float(np.abs(got - exact).max() /
                     (np.abs(exact).max() + 1e-12))
-        wire = wire_bytes_per_rank(numel, n, policy, block=args.block)
+        return dt, rel
+
+    for policy in ("fp32", "bf16", "int8", "int4"):
+        dt, rel = run_case(mesh, "data", policy, blocks[policy])
+        wire = wire_bytes_per_rank(numel, n, policy, block=blocks[policy])
         telemetry.counter(
             "grad_sync_bytes_total",
             "logical wire bytes per rank of the bucketed grad "
@@ -135,11 +158,32 @@ def main():
             "rel_err": rel,
         }
 
+    # per-axis policy (DCN gating): outer "data" axis quantizes int4 (the
+    # slow cross-slice hop), inner "model" axis pre-reduces exact fp32
+    # (the fast ICI hop). Wire model = the two sequential group exchanges.
+    if n >= 4 and n % 2 == 0:
+        mesh2 = Mesh(np.asarray(jax.devices()[:n]).reshape(n // 2, 2),
+                     ("data", "model"))
+        per_axis = {"data": "int4", "model": "fp32"}
+        dt, rel = run_case(mesh2, ("data", "model"), per_axis, None)
+        wire = (wire_bytes_per_rank(numel, n // 2, "int4",
+                                    block=args.int4_block)
+                + wire_bytes_per_rank(numel, 2, "fp32"))
+        extra["per_axis_int4_dcn"] = {
+            "policy": per_axis,
+            "wire_bytes_per_rank": wire,
+            "ms_per_exchange": round(dt * 1e3, 3),
+            "rel_err": rel,
+        }
+
     ratio = (extra["fp32"]["wire_bytes_per_rank"] /
              max(extra["int8"]["wire_bytes_per_rank"], 1e-9))
+    ratio4 = (extra["fp32"]["wire_bytes_per_rank"] /
+              max(extra["int4"]["wire_bytes_per_rank"], 1e-9))
+    extra["int4_vs_fp32_bytes_x"] = round(ratio4, 3)
     extra["telemetry"] = {
         "wire_bytes": {p: reg.get("grad_sync_bytes_total").value(policy=p)
-                       for p in ("fp32", "bf16", "int8")},
+                       for p in ("fp32", "bf16", "int8", "int4")},
         "prometheus_bytes": len(telemetry.prometheus_text(reg)),
     }
     tel_cm.__exit__(None, None, None)
@@ -148,12 +192,15 @@ def main():
         wb = extra["telemetry"]["wire_bytes"]
         assert "grad_sync_bytes_total" in prom, "telemetry missing metric"
         assert wb["int8"] > 0 and wb["fp32"] > wb["int8"], wb
+        assert wb["int4"] > 0 and wb["int8"] > wb["int4"], wb
+        assert ratio4 >= 7.0, f"int4 must beat fp32 by >=7x, got {ratio4}"
     print(json.dumps({
         "metric": "int8_vs_fp32_bytes_x",
         "value": round(ratio, 3),
         "unit": "x",
         "vs_baseline": 1.0,
         "extra": {"numel": numel, "devices": n, "block": args.block,
+                  "int4_block": args.int4_block,
                   "bucket_mb": args.bucket_mb, "smoke": bool(args.smoke),
                   **extra},
     }))
