@@ -43,16 +43,16 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 
 # per-config child wall-clock budgets (compile + warmup + timed iters);
 # the sweep configs compile several step variants
-CHILD_TIMEOUT = {"probe": 150, "numerics": 300, "gpt_base": 1200,
-                 "gpt_1p3b": 900, "heter_ctr": 600}
+CHILD_TIMEOUT = {"probe": 150, "numerics": 300, "op_pallas": 420,
+                 "gpt_base": 1200, "gpt_1p3b": 900, "heter_ctr": 600}
 CHILD_TIMEOUT_DEFAULT = 600
 GLOBAL_BUDGET_S = 2700  # stop launching new configs past this
 
 # numerics first: the on-chip kernel-vs-dense validation (r3 item 10) is
 # cheap and must not be starved by the budget; heter_ctr last (r3 item
 # 2's 10x A/B — informative, not the headline)
-CONFIG_ORDER = ("numerics", "gpt_base", "resnet50", "bert_base_amp",
-                "widedeep_ctr", "gpt_1p3b", "heter_ctr")
+CONFIG_ORDER = ("numerics", "op_pallas", "gpt_base", "resnet50",
+                "bert_base_amp", "widedeep_ctr", "gpt_1p3b", "heter_ctr")
 
 
 # --------------------------------------------------------------------------
@@ -127,10 +127,12 @@ def _hbm_peak_gb(jax):
         return None
 
 
-def _make_fused_loss(inner, chunk):
+def _make_fused_loss(inner, chunk, ce_kernel="chunked"):
     """Wrap a model exposing fused_head_loss as a (ids, labels) -> loss
-    Layer, so ParallelTrainer drives the chunked-CE path (the (B*S,
-    vocab) logits never materialize; ops/chunked_ce.py)."""
+    Layer, so ParallelTrainer drives a fused head+CE path (the (B*S,
+    vocab) logits never materialize). ce_kernel: "chunked" =
+    ops/chunked_ce.py jnp scan, "pallas" = the Mosaic kernel in
+    ops/pallas/fused_ce.py (interpret mode off-TPU)."""
     from paddle_tpu import nn
 
     class FusedLoss(nn.Layer):
@@ -140,13 +142,14 @@ def _make_fused_loss(inner, chunk):
 
         def forward(self, batch_):
             ids, lbl = batch_
-            return self.inner.fused_head_loss(ids, lbl, chunk=chunk)
+            return self.inner.fused_head_loss(ids, lbl, chunk=chunk,
+                                              ce_kernel=ce_kernel)
 
     return FusedLoss(inner)
 
 
 def _gpt_variant(jax, on_tpu, batch, seq, vocab, cfg, fused, chunk=8192,
-                 remat=False, grad_sync=None):
+                 remat=False, grad_sync=None, ce_kernel="chunked"):
     """Measure one (batch, loss-path, remat, grad-sync) GPT-base variant.
 
     fused=True routes through GPTForPretraining.fused_head_loss
@@ -179,9 +182,9 @@ def _gpt_variant(jax, on_tpu, batch, seq, vocab, cfg, fused, chunk=8192,
 
         sync_kw = dict(grad_sync=grad_sync) if grad_sync else {}
         if fused:
-            trainer = ParallelTrainer(_make_fused_loss(model, chunk), opt,
-                                      lambda out, _lbl: out, remat=remat,
-                                      **sync_kw)
+            trainer = ParallelTrainer(
+                _make_fused_loss(model, chunk, ce_kernel), opt,
+                lambda out, _lbl: out, remat=remat, **sync_kw)
         else:
             trainer = ParallelTrainer(
                 model, opt,
@@ -250,14 +253,22 @@ def bench_gpt(jax, on_tpu):
                  ("fused_b8_int8dp", dict(batch=8, fused=True,
                                           grad_sync="int8")),
                  ("fused_b8_int4dp", dict(batch=8, fused=True,
-                                          grad_sync="int4"))]
+                                          grad_sync="int4")),
+                 # Pallas fused-CE kernel (ops/pallas/fused_ce.py):
+                 # head matmul + softmax-CE in one Mosaic kernel, block
+                 # configs from the tuning DB
+                 ("fused_b8_pallas_ce", dict(batch=8, fused=True,
+                                             ce_kernel="pallas"))]
                 if on_tpu else
                 [("fused_b4", dict(batch=4, fused=True)),
                  ("dense_b4", dict(batch=4, fused=False)),
                  ("fused_b4_int8dp", dict(batch=4, fused=True,
                                           grad_sync="int8")),
                  ("fused_b4_int4dp", dict(batch=4, fused=True,
-                                          grad_sync="int4"))])
+                                          grad_sync="int4")),
+                 # interpret-mode on CPU: correctness + plumbing only
+                 ("fused_b4_pallas_ce", dict(batch=4, fused=True,
+                                             ce_kernel="pallas"))])
     sweep, best, best_name = {}, None, None
     out = None
     for name, kw in variants:
@@ -533,10 +544,33 @@ def bench_heter_ctr(jax, on_tpu):
     return out
 
 
+def bench_op_pallas(jax, on_tpu):
+    """Pallas kernel tier via tools/op_bench.py's pallas suite: tuned-vs-
+    default block configs for flash attention + fused CE and the
+    chunked-CE baseline. On TPU this is the autotuner's perf surface
+    (run `python -m paddle_tpu.ops.pallas.tuner --suite bench` first to
+    refresh the DB); on CPU the kernels run in interpret mode, so the
+    value is plumbing + config-resolution coverage, not perf."""
+    from paddle_tpu import telemetry
+    from tools.op_bench import pallas_suite
+
+    with telemetry.scope(profile=False) as tel:
+        recs = pallas_suite(iters=20 if on_tpu else 2, smoke=not on_tpu)
+    reg = tel.registry
+    resolved = {}
+    m = reg.get("pallas_config_resolved_total")
+    if m is not None:
+        for key, v in m.series().items():
+            resolved[",".join(f"{k}={val}" for k, val in key)] = int(v)
+    return {"ops": {r["op"]: {k: v for k, v in r.items() if k != "op"}
+                    for r in recs},
+            "config_resolutions": resolved}
+
+
 CHILD_FNS = {"gpt_base": bench_gpt, "resnet50": bench_resnet50,
              "bert_base_amp": bench_bert_amp, "widedeep_ctr": bench_widedeep,
              "gpt_1p3b": bench_gpt_1p3b, "numerics": bench_numerics,
-             "heter_ctr": bench_heter_ctr}
+             "heter_ctr": bench_heter_ctr, "op_pallas": bench_op_pallas}
 
 
 def child_main(name: str) -> int:

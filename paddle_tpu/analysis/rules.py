@@ -492,3 +492,37 @@ def oversized_allgather(ctx):
                       f"{_human_bytes(thresh)}); consider keeping the "
                       "tensor sharded (psum_scatter / rechunk the "
                       "computation)")
+
+
+@register_rule("pallas-config-untuned", "warning")
+def pallas_config_untuned(ctx):
+    """A Pallas kernel traced for a (shape-bucket, dtype, device) with no
+    tuning-DB entry — it runs on compiled-in default blocks, the silent
+    perf loss the autotuner (ops/pallas/tuner.py) exists to close. Run
+    ``python -m paddle_tpu.ops.pallas.tuner`` on the target device (or
+    ship a generic interpret-validated entry) to clear it."""
+    from ..ops.pallas.tuner import entry_for_traced_call
+    seen = set()
+    for site in ctx.sites:
+        if site.primitive != "pallas_call":
+            continue
+        info = site.eqn.params.get("name_and_src_info")
+        kernel_name = getattr(info, "name", "")
+        # forward kernels only: the paired backward kernels of the same
+        # call would re-report the identical missing entry
+        if kernel_name not in ("_fwd_kernel", "_ce_fwd_kernel"):
+            continue
+        grid = getattr(site.eqn.params.get("grid_mapping"), "grid", ())
+        avals = [getattr(v, "aval", None) for v in site.eqn.invars]
+        try:
+            key, entry = entry_for_traced_call(kernel_name, avals, grid)
+        except Exception:
+            continue
+        if key is None or entry is not None or key in seen:
+            continue
+        seen.add(key)
+        yield ctx.finding(
+            site, f"Pallas kernel {kernel_name.lstrip('_')} runs with "
+                  f"default block configs: no tuning-DB entry for "
+                  f"{key!r} (python -m paddle_tpu.ops.pallas.tuner "
+                  "persists one)")

@@ -26,12 +26,23 @@ as committed. ``restore()`` verifies the manifest and falls back to the
 newest step that checks out (counting ``ckpt_restore_fallbacks_total``);
 retention GC runs only after a verified commit and never removes the last
 valid step.
+
+Byte budget: save retries are additionally bounded by bytes moved
+(``CKPT_RETRY_BYTE_BUDGET_X`` × state size) — a flaky remote fs re-uploads
+the full state every attempt, so past the budget the save DEGRADES to
+local-disk staging (``PADDLE_TPU_CKPT_STAGING`` or a tempdir; counted in
+``ckpt_retry_bytes_abandoned_total``) instead of burning the link.
+``restore()`` falls back to the newest verified staged step when no
+primary step restores.
 """
 from __future__ import annotations
 
 import json
 import os
+import shutil
+import tempfile
 import time
+import warnings
 import zlib
 from typing import Any, List, Optional
 
@@ -39,9 +50,49 @@ import jax
 
 __all__ = ["save_checkpoint", "load_checkpoint", "CheckpointManager",
            "TrainEpochRange", "train_epoch_range",
-           "write_manifest", "verify_manifest", "MANIFEST_NAME"]
+           "write_manifest", "verify_manifest", "MANIFEST_NAME",
+           "CKPT_RETRY_BYTE_BUDGET_X", "staging_root"]
 
 MANIFEST_NAME = "MANIFEST.json"
+
+# retries may move at most this multiple of the state size before the
+# save degrades to local staging (first attempt always runs)
+CKPT_RETRY_BYTE_BUDGET_X = 3.0
+
+
+def staging_root() -> str:
+    """Local-disk home for degraded saves: ``PADDLE_TPU_CKPT_STAGING``
+    or a tempdir fallback. Must be a genuinely local path — it is where
+    saves land when the REMOTE fs is the thing failing."""
+    env = os.environ.get("PADDLE_TPU_CKPT_STAGING")
+    if env:
+        return env
+    return os.path.join(tempfile.gettempdir(), "paddle_tpu_ckpt_staging")
+
+
+def _state_nbytes(state: Any) -> float:
+    return float(sum(getattr(v, "nbytes", 0) or 0
+                     for v in jax.tree_util.tree_leaves(state)))
+
+
+def _save_retry_kwargs(nbytes: float) -> dict:
+    """Retry policy for checkpoint saves. With a known state size the byte
+    budget binds first — floor(3×/1×) = 3 upload attempts, then degrade —
+    and the try count is only a backstop; a zero-byte state keeps the
+    plain 3-try policy."""
+    if not nbytes:
+        return {"tries": 3}
+    return {"tries": 6, "attempt_bytes": nbytes,
+            "byte_budget": CKPT_RETRY_BYTE_BUDGET_X * nbytes}
+
+
+def _count_staged(nbytes: float):
+    from .. import telemetry
+    if telemetry.enabled():
+        telemetry.counter(
+            "ckpt_retry_bytes_abandoned_total",
+            "checkpoint bytes NOT re-uploaded because the retry byte "
+            "budget degraded the save to local staging").inc(nbytes)
 
 
 _cached = {}  # one checkpointer per mode: async saves barrier on reuse
@@ -171,17 +222,44 @@ def _corrupt_one_file(step_dir: str):
             f.truncate(max(1, size // 2))
 
 
+def _stage_save(dest: str, state: Any, nbytes: float,
+                err: BaseException) -> str:
+    """Degraded save path: a plain sync orbax write onto local disk, no
+    fault hooks and no retry — if LOCAL disk is failing too there is
+    nothing left to degrade to. Manifested like any committed step so
+    restore can verify it."""
+    import orbax.checkpoint as ocp
+    dest = os.path.abspath(dest)
+    if os.path.isdir(dest):
+        shutil.rmtree(dest)
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    ocp.Checkpointer(ocp.StandardCheckpointHandler()).save(
+        dest, args=ocp.args.StandardSave(state), force=True)
+    write_manifest(dest)
+    _count_staged(nbytes)
+    warnings.warn(
+        f"checkpoint save exceeded its retry byte budget ({err}); "
+        f"staged to local disk at {dest}", RuntimeWarning)
+    return dest
+
+
 def save_checkpoint(path: str, state: Any, overwrite: bool = True,
-                    use_async: bool = False):
+                    use_async: bool = False,
+                    staging_dir: Optional[str] = None):
     """Save a pytree of (possibly sharded) jax arrays. Each host writes only
     the shards it owns. With ``use_async`` the write overlaps training; the
     module keeps ONE async checkpointer, so a subsequent save waits for the
     in-flight one (no torn writes) — call ``wait_until_finished`` on the
-    returned checkpointer before process exit."""
+    returned checkpointer before process exit.
+
+    Retries are byte-budgeted (``CKPT_RETRY_BYTE_BUDGET_X`` × state size);
+    past the budget the save lands in ``staging_dir`` (default
+    ``staging_root()/<basename(path)>``) instead of re-uploading."""
     import orbax.checkpoint as ocp
     from ..resilience import faults
-    from ..resilience.retry import call_with_retry
+    from ..resilience.retry import RetryBytesExhausted, call_with_retry
     ckptr = _checkpointer(use_async)
+    nbytes = _state_nbytes(state)
     t0 = time.perf_counter()
 
     def _write():
@@ -189,7 +267,15 @@ def save_checkpoint(path: str, state: Any, overwrite: bool = True,
         ckptr.save(os.path.abspath(path), args=ocp.args.StandardSave(state),
                    force=overwrite)
 
-    call_with_retry(_write, site="ckpt_save", tries=3, base_delay=0.01)
+    try:
+        # with a byte budget armed, IT is the binding limit (3× the state
+        # = 3 uploads), so the try count is just a backstop
+        call_with_retry(_write, site="ckpt_save", base_delay=0.01,
+                        **_save_retry_kwargs(nbytes))
+    except RetryBytesExhausted as e:
+        dest = staging_dir or os.path.join(
+            staging_root(), os.path.basename(os.path.abspath(path)))
+        _stage_save(dest, state, nbytes, e)
     _record("save", time.perf_counter() - t0, state)
     return ckptr
 
@@ -240,9 +326,12 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 save_interval_steps: int = 1, use_async: bool = True):
+                 save_interval_steps: int = 1, use_async: bool = True,
+                 staging_dir: Optional[str] = None):
         import orbax.checkpoint as ocp
         self._dir = os.path.abspath(directory)
+        self._staging = staging_dir or os.path.join(
+            staging_root(), os.path.basename(self._dir))
         self._max_to_keep = max_to_keep
         self._use_async = use_async
         # retention is OURS (post-commit, validity-aware): orbax counting
@@ -260,6 +349,20 @@ class CheckpointManager:
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self._dir, str(step))
+
+    def _staged_step_dir(self, step: int) -> str:
+        return os.path.join(self._staging, str(step))
+
+    def staged_steps(self) -> List[int]:
+        """Steps that degraded to local staging (newest last)."""
+        if not os.path.isdir(self._staging):
+            return []
+        out = []
+        for n in os.listdir(self._staging):
+            if n.isdigit() and os.path.isdir(
+                    os.path.join(self._staging, n)):
+                out.append(int(n))
+        return sorted(out)
 
     def _verify(self, step: int) -> Optional[bool]:
         if step not in self._vcache:
@@ -312,7 +415,7 @@ class CheckpointManager:
         import numpy as np
         import orbax.checkpoint as ocp
         from ..resilience import faults
-        from ..resilience.retry import call_with_retry
+        from ..resilience.retry import RetryBytesExhausted, call_with_retry
         # numpy scalars (np.int32(3) etc.) are not in orbax's supported
         # leaf types — promote them to 0-d ndarrays
         state = jax.tree_util.tree_map(
@@ -330,9 +433,21 @@ class CheckpointManager:
                                msg=f"injected ckpt_io at step {step}")
             return self._mngr.save(step, args=ocp.args.StandardSave(state))
 
+        nbytes = _state_nbytes(state)
         t0 = time.perf_counter()
-        saved = call_with_retry(_write, site="ckpt_save", tries=3,
-                                base_delay=0.01)
+        try:
+            saved = call_with_retry(
+                _write, site="ckpt_save", base_delay=0.01,
+                **_save_retry_kwargs(nbytes))
+        except RetryBytesExhausted as e:
+            # budget blown: the primary dir (likely remote) is too
+            # expensive to keep re-uploading — stage locally instead.
+            # Staged steps live OUTSIDE orbax's step tracking (no
+            # pending/GC) and are picked up by restore() only when no
+            # primary step verifies.
+            _stage_save(self._staged_step_dir(step), state, nbytes, e)
+            _record("save", time.perf_counter() - t0, state)
+            return True
         if saved:  # interval-skipped saves shouldn't pollute the histogram
             self._pending.append(step)
             if not self._use_async:
@@ -400,6 +515,22 @@ class CheckpointManager:
                 fallbacks += 1
                 continue
             _record("restore", time.perf_counter() - t0, out)
+            self._count_fallbacks(fallbacks)
+            self.last_restored_step = s
+            return out
+        # no primary step restored: fall back to locally staged saves
+        # (degraded by the retry byte budget), newest first
+        for s in sorted(self.staged_steps(), reverse=True):
+            sdir = self._staged_step_dir(s)
+            if verify_manifest(sdir) is False:
+                fallbacks += 1
+                continue
+            try:
+                t0 = time.perf_counter()
+                out = load_checkpoint(sdir, template=template)
+            except Exception:
+                fallbacks += 1
+                continue
             self._count_fallbacks(fallbacks)
             self.last_restored_step = s
             return out

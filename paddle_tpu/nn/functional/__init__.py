@@ -15,7 +15,7 @@ from .extension import diag_embed, gather_tree, temporal_shift  # noqa: F401
 from .loss import (  # noqa: F401
     binary_cross_entropy, binary_cross_entropy_with_logits,
     cosine_embedding_loss, cross_entropy, ctc_loss, dice_loss,
-    hinge_embedding_loss, hsigmoid_loss,
+    fused_linear_cross_entropy, hinge_embedding_loss, hsigmoid_loss,
     kl_div, l1_loss, log_loss, margin_ranking_loss, mse_loss, nll_loss,
     npair_loss, sigmoid_focal_loss, smooth_l1_loss, softmax_with_cross_entropy,
     square_error_cost, triplet_margin_loss)

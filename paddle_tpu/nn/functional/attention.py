@@ -54,6 +54,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     # the kernel; only dense attn_mask tensors force the XLA path.
     use_flash = (attn_mask is None and
                  flash_supported(query, key, min_seq=512))
+    if not use_flash:
+        from ...ops.pallas.tuner import record_fallback
+        record_fallback("flash_attention")
     if use_flash:
         try:
             rate, seed = 0.0, None
@@ -67,7 +70,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                    kv_lens=kv_lens, dropout_rate=rate,
                                    dropout_seed=seed)
         except Exception:
-            pass
+            from ...ops.pallas.tuner import record_fallback
+            record_fallback("flash_attention")
     if kv_lens is not None:
         t = key.shape[1]
         lens_mask = (jnp.arange(t)[None, None, None, :] <

@@ -300,3 +300,41 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
     sign = 2.0 * codes - 1.0
     loss = -jax.nn.log_sigmoid(sign * logits) * mask
     return jnp.sum(loss, axis=1, keepdims=True)
+
+
+def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
+                               chunk=8192, kernel="auto", interpret=None,
+                               name=None):
+    """LM-head projection + softmax cross entropy WITHOUT materializing
+    the (tokens, vocab) logits tensor — mean fp32 loss over labels !=
+    ``ignore_index``, gradients to hidden and weight.
+
+    hidden: (..., H); weight: (H, V); labels: (...,) int targets.
+
+    kernel selects the implementation:
+      - ``"pallas"``: the fused Mosaic kernel
+        (``ops/pallas/fused_ce.py``; interpret mode auto-selected
+        off-TPU unless ``interpret`` says otherwise),
+      - ``"chunked"``: the jnp online-logsumexp scan
+        (``ops/chunked_ce.py``, ``chunk`` classes per step),
+      - ``"auto"``: pallas on TPU, chunked elsewhere — the chunked
+        route is counted as ``pallas_config_resolved_total{
+        kernel="fused_ce", source="fallback"}``.
+    """
+    from ...ops.chunked_ce import chunked_lm_ce
+    from ...ops.pallas.fused_ce import fused_ce_supported, fused_lm_ce
+    if kernel == "auto":
+        if fused_ce_supported():
+            kernel = "pallas"
+        else:
+            from ...ops.pallas.tuner import record_fallback
+            record_fallback("fused_ce")
+            kernel = "chunked"
+    if kernel == "pallas":
+        return fused_lm_ce(hidden, weight, labels,
+                           ignore_index=ignore_index, interpret=interpret)
+    if kernel == "chunked":
+        return chunked_lm_ce(hidden, weight, labels, chunk=chunk,
+                             ignore_index=ignore_index)
+    raise ValueError(
+        f"kernel must be 'auto', 'pallas' or 'chunked', got {kernel!r}")

@@ -1,1 +1,3 @@
-from .flash_attention import flash_attention  # noqa: F401
+from . import tuner  # noqa: F401
+from .flash_attention import flash_attention, flash_supported  # noqa: F401
+from .fused_ce import fused_ce_supported, fused_lm_ce  # noqa: F401

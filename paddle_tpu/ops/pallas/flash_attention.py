@@ -417,8 +417,7 @@ def _pad_seq(x, to_len):
 
 def flash_attention(q, k, v, causal=False, sm_scale=None, kv_lens=None,
                     dropout_rate=0.0, dropout_seed=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    interpret=False):
+                    block_q=None, block_k=None, interpret=False):
     """q/k/v: (batch, seq, num_heads, head_dim) → same-shaped output.
 
     kv_lens: optional (batch,) int32 — per-row count of VALID key/value
@@ -426,9 +425,21 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, kv_lens=None,
     ``attn_mask`` of padded batches in O(B) form). dropout_rate/seed:
     attention-probability dropout inside the kernel (seed is an int or
     0-d array; vary it per step).
+
+    block_q/block_k: ``None`` resolves from the tuning DB
+    (``ops/pallas/tuner.py``: tuned entry → those blocks, miss → the
+    swept DEFAULT_BLOCK_Q/K, counted in
+    ``pallas_config_resolved_total``); explicit values bypass the DB.
     """
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    if block_q is None or block_k is None:
+        from .tuner import flash_dims, resolve
+        cfg, _ = resolve("flash_attention", q.dtype, flash_dims(d, sq, sk),
+                         {"block_q": DEFAULT_BLOCK_Q,
+                          "block_k": DEFAULT_BLOCK_K})
+        block_q = block_q or cfg["block_q"]
+        block_k = block_k or cfg["block_k"]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     if dropout_rate >= 1.0:

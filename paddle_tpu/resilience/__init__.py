@@ -16,9 +16,9 @@ Crash-consistent checkpoint commits live with the checkpoint code itself
 from . import faults  # noqa: F401
 from .faults import SimulatedCrash, inject  # noqa: F401
 from .guard import all_finite, all_finite_value  # noqa: F401
-from .retry import call_with_retry, retry  # noqa: F401
+from .retry import RetryBytesExhausted, call_with_retry, retry  # noqa: F401
 from .runner import RunResult, run_resilient  # noqa: F401
 
 __all__ = ["faults", "SimulatedCrash", "inject", "all_finite",
            "all_finite_value", "retry", "call_with_retry",
-           "RunResult", "run_resilient"]
+           "RetryBytesExhausted", "RunResult", "run_resilient"]

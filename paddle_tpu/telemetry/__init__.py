@@ -46,8 +46,15 @@ dataloader_fetch_seconds       histogram  io.DataLoader batch fetch
 checkpoint_save_seconds        histogram  distributed.checkpoint
 checkpoint_restore_seconds     histogram  distributed.checkpoint
 checkpoint_bytes_total         counter    distributed.checkpoint {op=...}
+pallas_config_resolved_total   counter    ops.pallas.tuner.resolve, trace
+                                          time {kernel=...,
+                                          source=db|default|fallback}
 retries_total                  counter    resilience.retry {site=...}
 retry_exhausted_total          counter    resilience.retry {site=...}
+retry_bytes_abandoned_total    counter    resilience.retry byte budget
+                                          {site=...}
+ckpt_retry_bytes_abandoned_total counter  checkpoint saves degraded to
+                                          local staging
 ckpt_restore_fallbacks_total   counter    CheckpointManager.restore (torn
                                           checkpoints skipped over)
 resilience_faults_injected_total counter  resilience.faults {kind=...}

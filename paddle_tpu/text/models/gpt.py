@@ -226,26 +226,30 @@ class GPTForPretraining(Layer):
         return jnp.mean(per_tok)
 
     def fused_head_loss(self, input_ids, labels, chunk: int = 8192,
-                        attn_mask=None):
-        """Trunk -> chunked head+CE (ops/chunked_ce.py): the (B, S, vocab)
-        logits are never materialized — the vocab is scanned in chunks
-        with an online logsumexp, and the backward recomputes each
-        chunk's logits. Single-device / DP path (the TP path keeps the
-        vocab-sharded head + ParallelCrossEntropy, which already splits
-        the logits tensor over "model")."""
+                        attn_mask=None, ce_kernel: str = "chunked"):
+        """Trunk -> fused head+CE: the (B, S, vocab) logits are never
+        materialized. ce_kernel picks the implementation —
+        ``"chunked"`` (ops/chunked_ce.py jnp online-logsumexp scan,
+        ``chunk`` classes per step), ``"pallas"`` (the Mosaic kernel in
+        ops/pallas/fused_ce.py, interpret mode auto-selected off-TPU),
+        or ``"auto"`` (pallas on TPU, chunked elsewhere). Single-device
+        / DP path (the TP path keeps the vocab-sharded head +
+        ParallelCrossEntropy, which already splits the logits tensor
+        over "model")."""
         from ...distributed.meta_parallel.parallel_layers.mp_layers import (
             _in_shard_map)
-        from ...ops.chunked_ce import chunked_lm_ce
+        from ...nn.functional.loss import fused_linear_cross_entropy
         if self.tensor_parallel and _in_shard_map():
             # vocab-sharded head: local weight covers only V/mp columns —
-            # the chunked op would silently miss every off-shard label.
+            # the fused ops would silently miss every off-shard label.
             raise RuntimeError(
                 "fused_head_loss is the single-device/DP path; under "
                 "tensor parallelism use forward() + the vocab-sharded "
                 "ParallelCrossEntropy loss")
         h = self.gpt(input_ids, attn_mask)
         w = jnp.swapaxes(self.lm_head.weight.value, 0, 1)   # (H, V)
-        return chunked_lm_ce(h, w, labels, chunk)
+        return fused_linear_cross_entropy(h, w, labels, chunk=chunk,
+                                          kernel=ce_kernel)
 
 
 # -- pipeline variant --------------------------------------------------------

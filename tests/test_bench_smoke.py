@@ -42,17 +42,19 @@ def test_bench_parent_orchestration_all_configs_cpu():
         f"stderr tail: {proc.stderr[-2000:]}")
     assert res["value"] > 0
     assert res["backend"] == "cpu"
-    for name in ("numerics", "gpt_base", "resnet50", "bert_base_amp",
-                 "widedeep_ctr", "gpt_1p3b", "heter_ctr"):
+    for name in ("numerics", "op_pallas", "gpt_base", "resnet50",
+                 "bert_base_amp", "widedeep_ctr", "gpt_1p3b", "heter_ctr"):
         cfg = res["extra"][name]
         assert "error" not in cfg, f"{name} failed: {cfg}"
         assert not cfg.get("partial"), f"{name} stuck partial: {cfg}"
     assert res["extra"]["numerics"]["numerics_ok"] is True
     assert res["extra"]["heter_ctr"]["speedup_x"] > 0
+    # the pallas kernel suite ran and resolved configs from the DB
+    assert res["extra"]["op_pallas"]["config_resolutions"]
     # the sweep recorded every CPU variant and picked a best
     sweep = res["extra"]["gpt_base"]["sweep"]
     assert set(sweep) == {"fused_b4", "dense_b4", "fused_b4_int8dp",
-                          "fused_b4_int4dp"}
+                          "fused_b4_int4dp", "fused_b4_pallas_ce"}
     assert res["extra"]["gpt_base"]["variant"] in sweep
     # telemetry harvested from the winning variant's scoped registry
     tel = res["extra"]["gpt_base"]["telemetry"]

@@ -314,6 +314,9 @@ F_CONFIGS = {
         x, jnp.asarray(_x((2, 3)))), _x((2, 3))),
     "cross_entropy": lambda: (lambda x: F.cross_entropy(
         x, jnp.asarray([1, 2])), _x((2, 4))),
+    "fused_linear_cross_entropy": lambda: (
+        lambda x: F.fused_linear_cross_entropy(
+            x, jnp.asarray(_x((3, 8))), jnp.asarray([1, 5])), _x((2, 3))),
     "ctc_loss": lambda: (lambda x: F.ctc_loss(
         jax.nn.log_softmax(x, -1), jnp.asarray([[1, 2]]),
         jnp.asarray([6]), jnp.asarray([2])), _x((6, 1, 4))),

@@ -765,3 +765,156 @@ class TestChaosE2E:
         assert "ckpt_restore_fallbacks_total" in prom
         assert "resilience_restarts_total" in prom
         assert json.dumps(out)  # JSON-serializable summary
+
+
+# ---------------------------------------------------------------------------
+# retry byte budget + checkpoint staging degrade (ISSUE 6 satellite)
+# ---------------------------------------------------------------------------
+
+class TestRetryByteBudget:
+    def _flaky(self, calls):
+        def fn():
+            calls.append(1)
+            raise OSError("remote fs down")
+        return fn
+
+    def test_budget_caps_attempts_not_tries(self):
+        from paddle_tpu.resilience import RetryBytesExhausted
+        calls = []
+        with pytest.raises(RetryBytesExhausted) as ei:
+            call_with_retry(self._flaky(calls), site="s", tries=10,
+                            base_delay=0.0, jitter=0.0,
+                            sleep=lambda d: None,
+                            attempt_bytes=100, byte_budget=250)
+        # floor(250/100) = 2 attempts run, the 3rd would blow the budget
+        assert len(calls) == 2
+        assert ei.value.bytes_spent == 200
+        assert ei.value.byte_budget == 250
+        assert isinstance(ei.value.last, OSError)
+
+    def test_first_attempt_always_runs(self):
+        from paddle_tpu.resilience import RetryBytesExhausted
+        calls = []
+        with pytest.raises(RetryBytesExhausted):
+            call_with_retry(self._flaky(calls), site="s", tries=5,
+                            base_delay=0.0, jitter=0.0,
+                            sleep=lambda d: None,
+                            attempt_bytes=100, byte_budget=0)
+        assert len(calls) == 1
+
+    def test_success_within_budget(self):
+        state = {"n": 0}
+
+        def flaky_then_ok():
+            state["n"] += 1
+            if state["n"] < 2:
+                raise OSError("hiccup")
+            return "ok"
+
+        assert call_with_retry(flaky_then_ok, site="s", tries=5,
+                               base_delay=0.0, jitter=0.0,
+                               sleep=lambda d: None,
+                               attempt_bytes=100, byte_budget=300) == "ok"
+
+    def test_no_budget_keeps_plain_exhaustion(self):
+        calls = []
+        with pytest.raises(OSError):
+            call_with_retry(self._flaky(calls), site="s", tries=3,
+                            base_delay=0.0, jitter=0.0,
+                            sleep=lambda d: None)
+        assert len(calls) == 3
+
+    def test_abandon_counter(self):
+        from paddle_tpu.resilience import RetryBytesExhausted
+        prev = telemetry.get_registry()
+        reg = Registry()
+        telemetry._set_registry(reg)
+        telemetry.enable()
+        try:
+            with pytest.raises(RetryBytesExhausted):
+                call_with_retry(self._flaky([]), site="budgeted", tries=9,
+                                base_delay=0.0, jitter=0.0,
+                                sleep=lambda d: None,
+                                attempt_bytes=10, byte_budget=15)
+            assert reg.get("retry_bytes_abandoned_total").value(
+                site="budgeted") == 1
+        finally:
+            telemetry.disable()
+            telemetry._set_registry(prev)
+
+
+class TestCheckpointStagingDegrade:
+    def _state(self):
+        return {"w": np.arange(64, dtype=np.float32),
+                "step": np.asarray(7)}
+
+    def test_save_degrades_to_staging_and_restore_falls_back(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import staging_root  # noqa: F401
+        prev = telemetry.get_registry()
+        reg = Registry()
+        telemetry._set_registry(reg)
+        telemetry.enable()
+        staging = str(tmp_path / "staging")
+        m = CheckpointManager(str(tmp_path / "ckpt"), use_async=False,
+                              staging_dir=staging)
+        state = self._state()
+        try:
+            with faults.inject("ckpt_io", times=50):
+                with pytest.warns(RuntimeWarning, match="staged to local"):
+                    assert m.save(0, state) is True
+            # nothing committed to the primary dir, step staged locally
+            assert not (m.all_steps() or [])
+            assert m.staged_steps() == [0]
+            assert os.path.isfile(os.path.join(staging, "0", MANIFEST_NAME))
+            out = m.restore(template=state)
+            assert out is not None and m.last_restored_step == 0
+            np.testing.assert_array_equal(np.asarray(out["w"]), state["w"])
+            # both the retry-layer and ckpt-layer counters fired
+            assert reg.get("retry_bytes_abandoned_total").value(
+                site="ckpt_save") == 1
+            assert reg.get("ckpt_retry_bytes_abandoned_total").value() == \
+                sum(v.nbytes for v in state.values())
+        finally:
+            telemetry.disable()
+            telemetry._set_registry(prev)
+            m.close()
+
+    def test_transient_fault_still_lands_in_primary(self, tmp_path):
+        m = CheckpointManager(str(tmp_path / "ckpt"), use_async=False,
+                              staging_dir=str(tmp_path / "staging"))
+        try:
+            with faults.inject("ckpt_io", times=1):
+                assert m.save(0, self._state()) is True
+            assert 0 in (m.all_steps() or [])
+            assert m.staged_steps() == []
+        finally:
+            m.close()
+
+    def test_primary_step_preferred_over_staged(self, tmp_path):
+        m = CheckpointManager(str(tmp_path / "ckpt"), use_async=False,
+                              staging_dir=str(tmp_path / "staging"))
+        state = self._state()
+        try:
+            assert m.save(0, state) is True
+            with faults.inject("ckpt_io", times=50):
+                with pytest.warns(RuntimeWarning):
+                    m.save(1, state)
+            assert m.staged_steps() == [1]
+            m.restore(template=state)
+            # a verified primary step wins over a newer staged one
+            assert m.last_restored_step == 0
+        finally:
+            m.close()
+
+    def test_save_checkpoint_degrades_too(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import (load_checkpoint,
+                                                       save_checkpoint)
+        state = self._state()
+        staged = str(tmp_path / "staging" / "ck")
+        with faults.inject("ckpt_io", times=50):
+            with pytest.warns(RuntimeWarning, match="staged to local"):
+                save_checkpoint(str(tmp_path / "remote" / "ck"), state,
+                                staging_dir=staged)
+        assert os.path.isfile(os.path.join(staged, MANIFEST_NAME))
+        out = load_checkpoint(staged, template=state)
+        np.testing.assert_array_equal(np.asarray(out["w"]), state["w"])
