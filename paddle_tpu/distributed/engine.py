@@ -1003,3 +1003,25 @@ class ParallelTrainer:
         from .checkpoint import load_checkpoint as _load
         self.state = _load(path, template=self.state)
         return self.state
+
+    # -- elastic remesh -----------------------------------------------------
+    def remesh(self, mesh):
+        """Rebuild specs, state placement, and the jitted step programs on
+        a new mesh (elastic scale-up/down: the healthy host set changed and
+        build_mesh produced a different device array). State re-initializes
+        FRESH from the model/optimizer — carrying trained state across
+        meshes is the caller's job via the sharded checkpoint
+        (resilience.elastic.reshard_trainer: save on the old mesh, restore
+        on the new one, remap the comm_err residuals whose replica
+        dimension follows the mesh)."""
+        from .mesh import set_mesh
+        self.mesh = mesh
+        set_mesh(mesh)
+        self._init_state()
+        self._build()
+        if hasattr(self.model, "named_sublayers"):
+            for _, sub in self.model.named_sublayers(include_self=True):
+                hook = getattr(sub, "_on_trainer_built", None)
+                if hook is not None:
+                    hook(self)
+        return self

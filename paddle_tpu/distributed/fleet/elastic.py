@@ -52,6 +52,7 @@ class ElasticManager:
         self.timeout = timeout
         self.enable = bool(self.server) and self.np_min > 0
         self._registered = False
+        self._prev_handlers = {}
         if self.enable:
             os.makedirs(self._dir(), exist_ok=True)
             # Chain (don't clobber) existing handlers; signal.signal only
@@ -112,6 +113,29 @@ class ElasticManager:
                 pass
             self._registered = False
 
+    def _reap_stale(self):
+        """Remove members whose heartbeat exceeded the staleness bound
+        (reference :171-204 relies on etcd lease expiry; file-KV leases
+        are mtimes, so the watcher garbage-collects them). ``hosts()``
+        already filters stale entries — reaping just keeps the KV dir
+        converged for every observer and for restart decisions."""
+        if not self.enable:
+            return
+        now = time.time()
+        try:
+            names = os.listdir(self._dir())
+        except OSError:
+            return
+        for fn in names:
+            if not fn.endswith(".alive"):
+                continue
+            full = os.path.join(self._dir(), fn)
+            try:
+                if now - os.path.getmtime(full) > self.timeout:
+                    os.remove(full)
+            except OSError:
+                pass
+
     def hosts(self) -> List[str]:
         """Live members (heartbeat within timeout). The directory scan is
         retried (site ``elastic_kv``) — a transient listdir failure must
@@ -158,6 +182,7 @@ class ElasticManager:
             return ElasticStatus.COMPLETED if not proc_alive() \
                 else ElasticStatus.HOLD
         self.heartbeat()
+        self._reap_stale()
         if not proc_alive():
             return ElasticStatus.COMPLETED
         n = len(self.hosts())
@@ -171,6 +196,20 @@ class ElasticManager:
         """reference :220."""
         self.deregister()
         return ElasticStatus.COMPLETED if completed else ElasticStatus.EXIT
+
+    def close(self):
+        """Deregister and restore the chained signal handlers, so a
+        manager created in tests or short-lived tools does not leave its
+        handler installed (and its member file advertised) after use."""
+        self.deregister()
+        if self._prev_handlers and \
+                threading.current_thread() is threading.main_thread():
+            for sig, h in self._prev_handlers.items():
+                try:
+                    signal.signal(sig, signal.SIG_DFL if h is None else h)
+                except (ValueError, TypeError):
+                    pass
+        self._prev_handlers = {}
 
     def signal_handler(self, sigint, frame):
         """reference :343 — deregister, chain the previous handler, die."""

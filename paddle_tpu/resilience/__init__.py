@@ -1,6 +1,6 @@
 """Fault-resilient training runtime.
 
-Four small parts compose the recovery story (see each module's docstring):
+Five small parts compose the recovery story (see each module's docstring):
 
 - ``faults``  — deterministic fault injection (every recovery path has a
   reproducible trigger)
@@ -9,16 +9,28 @@ Four small parts compose the recovery story (see each module's docstring):
   (wired into distributed.engine + amp.GradScaler)
 - ``runner``  — ``run_resilient``: auto-resume, graceful SIGTERM/SIGINT
   drain, elastic-restart and simulated-crash recovery
+- ``elastic`` — the elastic multi-host runtime: coordinated restore
+  barrier (min-reduced common step + barrier before the first train
+  step), scale-up/down remesh + reshard through the sharded checkpoint,
+  comm_err residual remapping; ``hostsim`` runs N subprocess "hosts"
+  over the file-KV so the whole thing is testable on one CPU box.
 
 Crash-consistent checkpoint commits live with the checkpoint code itself
 (``distributed.checkpoint``: manifest write/verify + fallback restore).
 """
 from . import faults  # noqa: F401
-from .faults import SimulatedCrash, inject  # noqa: F401
+from .elastic import (CoordinatorTimeout, ElasticRuntime,  # noqa: F401
+                      FileCoordinator, coordinated_restore,
+                      data_parallel_remesh_fn, remap_comm_err,
+                      reshard_trainer)
+from .faults import HostLost, SimulatedCrash, inject  # noqa: F401
 from .guard import all_finite, all_finite_value  # noqa: F401
 from .retry import RetryBytesExhausted, call_with_retry, retry  # noqa: F401
 from .runner import RunResult, run_resilient  # noqa: F401
 
-__all__ = ["faults", "SimulatedCrash", "inject", "all_finite",
+__all__ = ["faults", "SimulatedCrash", "HostLost", "inject", "all_finite",
            "all_finite_value", "retry", "call_with_retry",
-           "RetryBytesExhausted", "RunResult", "run_resilient"]
+           "RetryBytesExhausted", "RunResult", "run_resilient",
+           "CoordinatorTimeout", "FileCoordinator", "coordinated_restore",
+           "remap_comm_err", "reshard_trainer", "ElasticRuntime",
+           "data_parallel_remesh_fn"]

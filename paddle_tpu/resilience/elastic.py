@@ -1,0 +1,427 @@
+"""Elastic multi-host runtime: coordinated restore barrier + remesh.
+
+The fleet ``ElasticManager`` (file-KV membership, HOLD/RESTART/EXIT) only
+*decides*; this module makes the decision safe to act on:
+
+- ``FileCoordinator`` — allgather/barrier over the shared-filesystem KV
+  (the loopback stand-in for the jax.distributed coordinator), so hosts
+  can agree on anything without etcd.  Each collective round lives in a
+  numbered generation directory; participants touch their entry while
+  waiting, so an abandoned round (all writers stale) is skipped rather
+  than reused.
+- ``coordinated_restore`` — the restore barrier: every host reports its
+  local ``CheckpointManager.latest_valid_step()``, the values are
+  min-reduced to the newest step valid on *every* host, each host
+  restores exactly that step, and a barrier holds everyone until all
+  restores finished.  No host trains ahead on divergent state.
+  Counted in ``elastic_restore_barrier_total`` /
+  ``elastic_step_disagreements_total``.
+- ``reshard_trainer`` / ``remap_comm_err`` — scale-up/scale-down remesh:
+  params/opt/guard ride the sharded checkpoint (save on the old mesh,
+  restore on the new one — orbax reshards), while the EQuARX
+  error-feedback residuals (``state["comm_err"]``, replica-major with a
+  mesh-dependent leading dimension) are remapped host-side: surviving
+  rank rows are carried as a prefix, rows beyond the new rank count are
+  dropped (their L2 norm counted in
+  ``elastic_residual_dropped_norm_total``), new ranks start at zero.
+- ``ElasticRuntime`` — binds manager + coordinator + remesh policy into
+  the object ``run_resilient(elastic=...)`` re-enters through instead of
+  exiting 75: drain → commit → stabilize membership → (bounded) remesh →
+  coordinated restore barrier → continue.
+
+Retention caveat: the min-reduce can only roll back as far as every
+host's retention window (``CheckpointManager(max_to_keep=...)``) still
+holds the common step; divergence deeper than the window raises rather
+than silently training on mismatched state.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["CoordinatorTimeout", "FileCoordinator", "coordinated_restore",
+           "remap_comm_err", "reshard_trainer", "ElasticRuntime",
+           "data_parallel_remesh_fn"]
+
+RESHARD_STATE_KEYS = ("params", "buffers", "opt", "guard")
+
+
+class CoordinatorTimeout(RuntimeError):
+    """An allgather/barrier round did not complete before its deadline
+    (a participant died mid-round or never arrived)."""
+
+
+class FileCoordinator:
+    """Allgather/barrier over a shared directory — the loopback
+    counterpart of the jax.distributed coordinator, usable by N processes
+    (or threads) that share a filesystem.
+
+    Protocol: each named collective is a sequence of *generation*
+    directories ``<root>/<name>/<g>/``.  A participant joins the first
+    generation that is neither complete (every expected host present) nor
+    abandoned (incomplete with every entry stale), writes
+    ``<host>.json`` atomically, then polls — touching its own entry so
+    live rounds stay distinguishable from dead ones — until the expected
+    host set (re-read from ``hosts_fn`` every poll, so membership loss
+    mid-round shrinks the wait) is fully present.
+    """
+
+    def __init__(self, root: str, job_id: str = "job",
+                 host: Optional[str] = None, stale_after: float = 10.0,
+                 poll: float = 0.05):
+        self.root = os.path.join(root, job_id + ".coord")
+        self.host = host or f"pid-{os.getpid()}"
+        self.stale_after = float(stale_after)
+        self.poll = float(poll)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _entries(self, gen_dir: str) -> Dict[str, tuple]:
+        out = {}
+        try:
+            names = os.listdir(gen_dir)
+        except OSError:
+            return out
+        for fn in names:
+            if not fn.endswith(".json"):
+                continue
+            full = os.path.join(gen_dir, fn)
+            try:
+                mtime = os.path.getmtime(full)
+                with open(full) as f:
+                    payload = json.load(f)
+            except (OSError, ValueError):
+                continue  # mid-replace or vanished: next poll sees it
+            out[fn[:-len(".json")]] = (payload["v"], mtime)
+        return out
+
+    def _pick_generation(self, name: str, expected: set) -> int:
+        base = os.path.join(self.root, name)
+        try:
+            gens = sorted(int(g) for g in os.listdir(base) if g.isdigit())
+        except OSError:
+            gens = []
+        for g in gens:
+            entries = self._entries(os.path.join(base, str(g)))
+            if expected <= set(entries):
+                continue                      # completed round
+            if not entries:
+                # a peer ran makedirs but its entry hasn't landed yet —
+                # empty means joinable, NOT abandoned (classifying it as
+                # abandoned would split the round across two generations)
+                return g
+            now = time.time()
+            if any(now - m <= self.stale_after
+                   for _, m in entries.values()):
+                return g                      # live incomplete round: join
+            # incomplete with every writer stale: abandoned — skip
+        return (gens[-1] + 1) if gens else 0
+
+    def _write(self, gen_dir: str, value):
+        tmp = os.path.join(gen_dir, f".{self.host}.tmp")
+        with open(tmp, "w") as f:
+            json.dump({"v": value}, f)
+        os.replace(tmp, os.path.join(gen_dir, self.host + ".json"))
+
+    def allgather(self, name: str, value, hosts_fn: Callable[[], List[str]],
+                  timeout: float = 120.0) -> Dict[str, object]:
+        """Contribute ``value`` under ``name`` and return every expected
+        host's contribution as ``{host: value}``."""
+        expected = set(hosts_fn()) | {self.host}
+        gen_dir = os.path.join(
+            self.root, name, str(self._pick_generation(name, expected)))
+        os.makedirs(gen_dir, exist_ok=True)
+        self._write(gen_dir, value)
+        mine = os.path.join(gen_dir, self.host + ".json")
+        deadline = time.time() + timeout
+        while True:
+            try:
+                os.utime(mine)
+            except OSError:
+                self._write(gen_dir, value)
+            expected = set(hosts_fn()) | {self.host}
+            entries = self._entries(gen_dir)
+            if expected <= set(entries):
+                return {h: entries[h][0] for h in sorted(expected)}
+            if time.time() > deadline:
+                raise CoordinatorTimeout(
+                    f"allgather {name!r}: waited {timeout:.0f}s for "
+                    f"{sorted(expected - set(entries))} in {gen_dir}")
+            time.sleep(self.poll)
+
+    def barrier(self, name: str, hosts_fn: Callable[[], List[str]],
+                timeout: float = 120.0):
+        self.allgather(name, 1, hosts_fn, timeout=timeout)
+
+
+def coordinated_restore(manager, template, coordinator: FileCoordinator,
+                        hosts_fn: Callable[[], List[str]],
+                        timeout: float = 120.0):
+    """The restore barrier. Returns ``(restored, common_step)`` where
+    ``restored`` is the checkpoint payload (None on a coordinated fresh
+    start) and ``common_step`` the min-reduced step (-1 when any host has
+    no valid checkpoint at all)."""
+    from .. import telemetry
+    from . import faults
+    local = manager.latest_valid_step() if manager is not None else None
+    local = -1 if local is None else int(local)
+    if faults.fires("restore_divergence"):
+        # pretend our newest checkpoint is torn: report one step older
+        local = max(local - 1, -1)
+    steps = coordinator.allgather("restore_step", local, hosts_fn,
+                                  timeout=timeout)
+    values = [int(v) for v in steps.values()]
+    common = min(values)
+    tel = telemetry.enabled()
+    if tel and len(set(values)) > 1:
+        telemetry.counter(
+            "elastic_step_disagreements_total",
+            "restore barriers where hosts reported divergent steps").inc()
+    restored = None
+    if common >= 0:
+        if common not in set(manager.all_steps() or []):
+            raise RuntimeError(
+                f"common step {common} not in local retention "
+                f"{sorted(manager.all_steps() or [])}; divergence exceeds "
+                f"the checkpoint retention window")
+        restored = manager.restore(step=common, template=template)
+    coordinator.barrier("restore_barrier", hosts_fn, timeout=timeout)
+    if tel:
+        telemetry.counter(
+            "elastic_restore_barrier_total",
+            "coordinated restore barriers completed").inc()
+    return restored, common
+
+
+def remap_comm_err(old_host_arrays: Dict[str, np.ndarray], trainer):
+    """Remap replica-major error-feedback residuals onto the trainer's
+    CURRENT rank layout. Surviving ranks keep their rows as a prefix
+    (``min(R_old, R_new)``); dropped rows (scale-down, vanished keys,
+    shape changes) are re-zeroed with their L2 norm counted in
+    ``elastic_residual_dropped_norm_total``; new ranks start from zero.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+    from .. import telemetry
+
+    dropped_sq = 0.0
+    new = {}
+    current = trainer.state["comm_err"]
+    for k, spec in trainer.comm_err_specs.items():
+        fresh = current[k]
+        old = old_host_arrays.get(k)
+        if old is None:
+            new[k] = fresh
+            continue
+        old = np.asarray(old)
+        if old.shape[1:] != tuple(fresh.shape[1:]):
+            dropped_sq += float((old.astype(np.float64) ** 2).sum())
+            new[k] = fresh
+            continue
+        buf = np.zeros(tuple(fresh.shape), dtype=fresh.dtype)
+        rows = min(old.shape[0], buf.shape[0])
+        buf[:rows] = old[:rows]
+        if old.shape[0] > buf.shape[0]:
+            extra = old[buf.shape[0]:].astype(np.float64)
+            dropped_sq += float((extra ** 2).sum())
+        new[k] = jax.device_put(buf, NamedSharding(trainer.mesh, spec))
+    for k, old in old_host_arrays.items():
+        if k not in trainer.comm_err_specs:
+            dropped_sq += float((np.asarray(old).astype(np.float64) ** 2)
+                                .sum())
+    if dropped_sq > 0.0 and telemetry.enabled():
+        telemetry.counter(
+            "elastic_residual_dropped_norm_total",
+            "L2 norm of error-feedback residual rows dropped by remesh"
+        ).inc(float(np.sqrt(dropped_sq)))
+    trainer.state["comm_err"] = new
+    return new
+
+
+def reshard_trainer(trainer, new_mesh, reshard_dir: str):
+    """Carry a live trainer onto ``new_mesh``: params/buffers/opt/guard go
+    save-on-old-mesh → restore-on-new-mesh through the sharded checkpoint
+    (works when the meshes disagree — orbax reshards to the template),
+    comm_err residuals are remapped host-side (their leading replica
+    dimension follows the mesh, so they cannot ride the checkpoint)."""
+    import jax
+    from .. import telemetry
+    from ..distributed.checkpoint import load_checkpoint, save_checkpoint
+
+    old_comm = {k: np.asarray(jax.device_get(v))
+                for k, v in trainer.state["comm_err"].items()}
+    payload = {k: trainer.state[k] for k in RESHARD_STATE_KEYS}
+    save_checkpoint(reshard_dir, payload, overwrite=True, use_async=False)
+    trainer.remesh(new_mesh)
+    template = {k: trainer.state[k] for k in RESHARD_STATE_KEYS}
+    restored = load_checkpoint(reshard_dir, template=template)
+    for k in RESHARD_STATE_KEYS:
+        trainer.state[k] = restored[k]
+    remap_comm_err(old_comm, trainer)
+    if telemetry.enabled():
+        telemetry.counter("elastic_remesh_total",
+                          "trainer remesh/reshard operations").inc()
+    return trainer
+
+
+def data_parallel_remesh_fn(reshard_dir: str,
+                            degrees_fn: Optional[Callable] = None):
+    """A ``remesh_fn`` for ElasticRuntime that rebuilds a data-parallel
+    mesh sized to the healthy host set (``degrees_fn(hosts) -> degrees``
+    overrides the default one-data-axis policy) and reshards through
+    ``reshard_dir``."""
+    def _remesh(trainer, hosts: List[str]):
+        import jax
+        from ..distributed.mesh import build_mesh
+        if degrees_fn is not None:
+            degrees = degrees_fn(hosts)
+        else:
+            degrees = {"data": max(1, min(len(jax.devices()), len(hosts)))}
+        reshard_trainer(trainer, build_mesh(degrees), reshard_dir)
+    return _remesh
+
+
+class ElasticRuntime:
+    """Manager + coordinator + remesh policy, consumed by
+    ``run_resilient(elastic=...)``.  ``reenter=True`` tells the runner a
+    RESTART is handled in place (drain → ``on_restart`` → ``enter``)
+    instead of propagating exit 75; ``on_restart`` returning False (no
+    stable membership, remesh budget exhausted, remesh failed) falls back
+    to the relaunch path."""
+
+    reenter = True
+
+    def __init__(self, manager, coordinator: Optional[FileCoordinator] = None,
+                 remesh_fn: Optional[Callable] = None, max_remeshes: int = 2,
+                 poll: float = 0.25, stabilize_polls: int = 3,
+                 stabilize_timeout: float = 60.0,
+                 barrier_timeout: float = 120.0):
+        self.manager = manager
+        self.coordinator = coordinator
+        self.remesh_fn = remesh_fn
+        self.max_remeshes = max_remeshes
+        self.poll = poll
+        self.stabilize_polls = stabilize_polls
+        self.stabilize_timeout = stabilize_timeout
+        self.barrier_timeout = barrier_timeout
+        self.remeshes = 0
+        self.barrier_steps: List[int] = []   # common step of each entry
+        self._adopted: Optional[set] = None  # host set training started on
+        self._synthetic: List[str] = []      # host_join member files
+
+    # -- simulated membership (the host_join fault hook) ---------------------
+    def simulate_join(self) -> str:
+        """Materialize a synthetic member in the KV (deterministic
+        host_join fault); heartbeated by watch() until removed."""
+        name = f"sim-join-{len(self._synthetic)}"
+        path = self.manager._member_file(name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write("synthetic")
+        self._synthetic.append(path)
+        return path
+
+    def _synthetic_names(self) -> set:
+        return {os.path.basename(p)[:-len(".alive")]
+                for p in self._synthetic}
+
+    def _heartbeat_synthetic(self):
+        for p in list(self._synthetic):
+            try:
+                os.utime(p)
+            except OSError:
+                self._synthetic.remove(p)   # removed externally: it "left"
+
+    # -- membership ----------------------------------------------------------
+    def _coord_hosts(self) -> List[str]:
+        """Barrier participants: live KV members that are real processes
+        (synthetic host_join members cannot write barrier entries)."""
+        out = set(self.manager.hosts()) - self._synthetic_names()
+        out.add(self.coordinator.host if self.coordinator is not None
+                else self.manager.host)
+        return sorted(out)
+
+    def _stable_hosts(self) -> Optional[List[str]]:
+        """Wait for ``stabilize_polls`` consecutive identical host-set
+        observations inside the np range; None on timeout."""
+        deadline = time.time() + self.stabilize_timeout
+        last, streak = None, 0
+        while time.time() < deadline:
+            self.manager.heartbeat()
+            self._heartbeat_synthetic()
+            cur = tuple(self.manager.hosts())
+            streak = streak + 1 if cur == last else 1
+            last = cur
+            if (streak >= self.stabilize_polls
+                    and self.manager.np_min <= len(cur)
+                    <= self.manager.np_max):
+                return list(cur)
+            time.sleep(self.poll)
+        return None
+
+    def watch(self, proc_alive=lambda: True) -> str:
+        """Manager watch, plus: a host-set change *within* the np range
+        (which the manager reports as HOLD) is still a RESTART here — the
+        mesh was built for the adopted set."""
+        from ..distributed.fleet.elastic import ElasticStatus
+        self._heartbeat_synthetic()
+        st = self.manager.watch(proc_alive)
+        if st == ElasticStatus.HOLD and self._adopted is not None:
+            if set(self.manager.hosts()) != self._adopted:
+                return ElasticStatus.RESTART
+        return st
+
+    # -- restart / entry -----------------------------------------------------
+    def on_restart(self, trainer) -> bool:
+        """Handle a RESTART in place: wait for stable membership, remesh
+        if the healthy set changed (bounded by ``max_remeshes``). False
+        means give up and let the relaunch path (exit 75) take over."""
+        from .. import telemetry
+        hosts = self._stable_hosts()
+        if hosts is None:
+            return False
+        changed = (self._adopted is not None
+                   and set(hosts) != self._adopted)
+        if changed and self.remesh_fn is not None:
+            if self.remeshes >= self.max_remeshes:
+                return False
+            try:
+                self.remesh_fn(trainer, list(hosts))
+            except Exception:
+                if telemetry.enabled():
+                    telemetry.counter(
+                        "elastic_remesh_failed_total",
+                        "remesh attempts that fell back to relaunch").inc()
+                return False
+            self.remeshes += 1
+        self._adopted = set(hosts)
+        return True
+
+    def enter(self, ckpt_manager, template, timeout: Optional[float] = None):
+        """(Re)enter training through the restore barrier; returns the
+        restored payload (None = coordinated fresh start)."""
+        from .. import telemetry
+        timeout = self.barrier_timeout if timeout is None else timeout
+        if self._adopted is None:
+            hosts = self._stable_hosts()
+            self._adopted = set(hosts if hosts is not None
+                                else self.manager.hosts())
+        if self.coordinator is not None and ckpt_manager is not None:
+            restored, common = coordinated_restore(
+                ckpt_manager, template, self.coordinator,
+                self._coord_hosts, timeout=timeout)
+        else:
+            restored = (ckpt_manager.restore(template=template)
+                        if ckpt_manager is not None else None)
+            common = getattr(ckpt_manager, "last_restored_step", None) \
+                if restored is not None else None
+            common = -1 if common is None else int(common)
+            if telemetry.enabled():
+                telemetry.counter(
+                    "elastic_restore_barrier_total",
+                    "coordinated restore barriers completed").inc()
+        self.barrier_steps.append(common)
+        return restored

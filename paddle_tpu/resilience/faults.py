@@ -23,6 +23,14 @@ kind            effect at the instrumented site
                 (via the step's ``grad_taint`` operand)
 ``data_fetch``  the dataloader / runner batch fetch raises ``IOError``
 ``sigterm``     the runner delivers a real ``SIGTERM`` to this process
+``host_loss``   the runner raises ``HostLost`` — an abrupt host death
+                that only a supervisor (hostsim / the scheduler) handles;
+                the in-process restart path must NOT absorb it
+``host_join``   an ElasticRuntime materializes a synthetic KV member, so
+                scale-up remesh is testable without a second process
+``restore_divergence``  the coordinated restore barrier reports one step
+                older than the true local newest-valid (forces a
+                min-reduce disagreement)
 ==============  ==========================================================
 
 Determinism: ``at_step`` fires exactly when the site reports that step;
@@ -37,16 +45,25 @@ import random
 import threading
 from typing import List, Optional
 
-__all__ = ["KINDS", "SimulatedCrash", "inject", "fires", "maybe_raise",
-           "active", "reset"]
+__all__ = ["KINDS", "SimulatedCrash", "HostLost", "inject", "fires",
+           "maybe_raise", "active", "reset"]
 
-KINDS = ("ckpt_io", "ckpt_torn", "nan_grad", "data_fetch", "sigterm")
+KINDS = ("ckpt_io", "ckpt_torn", "nan_grad", "data_fetch", "sigterm",
+         "host_loss", "host_join", "restore_divergence")
 
 
 class SimulatedCrash(RuntimeError):
     """An injected hard crash (kill -9 analogue). Deliberately NOT an
     OSError so retry decorators do not absorb it — only the resilient
     runner's restart path may recover from it."""
+
+
+class HostLost(RuntimeError):
+    """An injected abrupt host death. Unlike SimulatedCrash this is not
+    recoverable in-process: the runner lets it unwind so a supervisor
+    (resilience.hostsim's SimCluster, or the real cluster scheduler)
+    observes the death; the SURVIVORS' elastic runtime does the
+    recovering."""
 
 
 class _Fault:

@@ -1,0 +1,340 @@
+"""Single-box multi-host simulation: N subprocess "hosts" over the
+file-KV ElasticManager + the FileCoordinator loopback barrier.
+
+Each simulated host is a real OS process (``python -m
+paddle_tpu.resilience.hostsim``) with its own jax runtime, its own
+per-host CheckpointManager directory, and its own elastic runtime; the
+only shared state is the KV/coordinator directory tree — exactly the
+shape of a real multi-host job minus the accelerators. That makes the
+whole elastic story (divergent-checkpoint restore barrier, host-loss
+detection via heartbeat staleness, remesh + reshard, resume) testable on
+CPU.
+
+``SimCluster`` is the supervisor: it seeds (optionally divergent)
+checkpoints, launches the hosts, arms per-host deterministic faults, and
+collects one result JSON per surviving host. A host killed by the
+``host_loss`` fault dies with ``os._exit(9)`` — no deregister, no
+checkpoint flush — so survivors only learn of it when its heartbeat goes
+stale, like a real machine loss.
+
+Layout under the cluster root::
+
+    kv/        the ElasticManager member files (<host>.alive)
+    coord/     FileCoordinator generations (allgather/barrier rounds)
+    ckpt/<h>/  per-host CheckpointManager directory
+    reshard/<h>/  per-host save-on-old-mesh -> restore-on-new-mesh staging
+    results/<h>.json  one line of results per surviving host
+"""
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["SimCluster", "worker_main", "HOST_LOSS_EXIT"]
+
+HOST_LOSS_EXIT = 9   # a host_loss death (distinct from every runner code)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _host_name(i: int) -> str:
+    return f"host{i}"
+
+
+class _SlowLoader:
+    """Re-iterable deterministic loader with a per-batch delay, so a
+    simulated run spans enough wall-clock for heartbeat staleness to be
+    observable mid-run."""
+
+    def __init__(self, batches, delay: float = 0.0):
+        self.batches = batches
+        self.delay = delay
+
+    def __iter__(self):
+        for b in self.batches:
+            if self.delay:
+                time.sleep(self.delay)
+            yield b
+
+
+def _tiny_batches(n: int = 4, batch: int = 8, seed: int = 3):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(batch, 8).astype(np.float32),
+             rng.randn(batch, 4).astype(np.float32)) for _ in range(n)]
+
+
+def _tiny_trainer(seed: int = 7, data_degree: int = 2):
+    """The smallest trainer that still exercises the full elastic
+    surface: int8 compressed exchange (non-empty comm_err residuals) on a
+    data mesh whose degree the remesh path can change."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.engine import ParallelTrainer
+    from paddle_tpu.distributed.mesh import build_mesh
+
+    paddle.seed(seed)
+    mesh = build_mesh({"data": data_degree})
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(8, 16)
+            self.l2 = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.l2(nn.functional.relu(self.l1(x)))
+
+    model = MLP()
+    opt = paddle.optimizer.Momentum(0.05, momentum=0.9,
+                                    parameters=model.parameters())
+    return ParallelTrainer(model, opt,
+                           lambda out, y: jnp.mean((out - y) ** 2),
+                           mesh=mesh, grad_sync="int8", grad_sync_block=8)
+
+
+def seed_checkpoints(ckpt_dir: str, upto_step: int, seed: int = 7,
+                     data_degree: int = 2):
+    """Pre-populate a host's checkpoint directory with steps
+    ``0..upto_step``, using the runner's own save format/cursor semantics
+    so a worker resumes them transparently. Deterministic: two hosts
+    seeded to the same step hold identical state."""
+    from ..distributed.checkpoint import CheckpointManager
+    from .runner import _save
+
+    trainer = _tiny_trainer(seed=seed, data_degree=data_degree)
+    batches = _tiny_batches()
+    mgr = CheckpointManager(ckpt_dir, max_to_keep=upto_step + 1,
+                            use_async=False)
+    it = iter(batches)
+    epoch, batch = 0, 0
+    for step in range(upto_step + 1):
+        try:
+            x, y = next(it)
+        except StopIteration:
+            epoch, batch = epoch + 1, 0
+            it = iter(batches)
+            x, y = next(it)
+        trainer.train_step(x, y)
+        batch += 1
+        _save(mgr, trainer, step, epoch, batch)
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# worker (one simulated host; runs as __main__ in its own process)
+# ---------------------------------------------------------------------------
+
+def _counter_total(snapshot: dict, name: str) -> float:
+    series = snapshot.get(name, {}).get("series", {})
+    return float(sum(v for v in series.values()
+                     if isinstance(v, (int, float))))
+
+
+def worker_main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description="one simulated elastic host")
+    p.add_argument("--root", required=True)
+    p.add_argument("--host", required=True)
+    p.add_argument("--world", type=int, required=True)
+    p.add_argument("--np", dest="np_spec", default="2:3")
+    p.add_argument("--steps", type=int, default=24)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--hb-timeout", type=float, default=1.0)
+    p.add_argument("--step-delay", type=float, default=0.15)
+    p.add_argument("--max-remeshes", type=int, default=3)
+    p.add_argument("--fault", action="append", default=[],
+                   metavar="KIND:STEP",
+                   help="arm a deterministic fault, e.g. host_loss:12")
+    args = p.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from .. import telemetry
+    from ..distributed.checkpoint import CheckpointManager
+    from ..distributed.fleet.elastic import ElasticManager
+    from . import faults
+    from .elastic import ElasticRuntime, FileCoordinator, \
+        data_parallel_remesh_fn
+    from .runner import run_resilient
+
+    telemetry.enable()
+    trainer = _tiny_trainer(seed=args.seed, data_degree=2)
+    loader = _SlowLoader(_tiny_batches(), delay=args.step_delay)
+
+    em = ElasticManager(elastic_server=os.path.join(args.root, "kv"),
+                        job_id="sim", np=args.np_spec, host=args.host,
+                        timeout=args.hb_timeout)
+    em.register()
+    # Background heartbeat: the step loop's own heartbeat stalls for
+    # seconds during XLA compiles and restore barriers, which would look
+    # like death to every peer at a sub-second staleness bound. A daemon
+    # thread beats through those stalls; an os._exit host_loss kills it
+    # abruptly, which is exactly how a real machine loss looks.
+    hb_stop = threading.Event()
+
+    def _beat():
+        while not hb_stop.is_set():
+            try:
+                em.heartbeat()
+            except Exception:
+                pass
+            hb_stop.wait(min(0.2, args.hb_timeout / 4))
+
+    threading.Thread(target=_beat, daemon=True).start()
+    # rendezvous: wait for the full initial world before entering
+    deadline = time.time() + 60.0
+    while len(em.hosts()) < args.world and time.time() < deadline:
+        time.sleep(0.05)
+
+    coord = FileCoordinator(os.path.join(args.root, "coord"), job_id="sim",
+                            host=args.host,
+                            stale_after=max(5.0, 4 * args.hb_timeout))
+    mgr = CheckpointManager(os.path.join(args.root, "ckpt", args.host),
+                            max_to_keep=4, use_async=False)
+    world = args.world
+
+    def _degrees(hosts):
+        # full world trains on a 2-wide data mesh; any shrink drops to 1,
+        # so a host loss exercises the R=2 -> R=1 residual remap
+        return {"data": 2 if len(hosts) >= world else 1}
+
+    runtime = ElasticRuntime(
+        em, coordinator=coord,
+        remesh_fn=data_parallel_remesh_fn(
+            os.path.join(args.root, "reshard", args.host),
+            degrees_fn=_degrees),
+        max_remeshes=args.max_remeshes,
+        poll=0.1, stabilize_polls=3, stabilize_timeout=30.0,
+        barrier_timeout=60.0)
+
+    with contextlib.ExitStack() as stack:
+        for spec in args.fault:
+            kind, _, at = spec.partition(":")
+            stack.enter_context(faults.inject(kind, at_step=int(at)))
+        try:
+            res = run_resilient(trainer, loader, args.steps, manager=mgr,
+                                save_every=1, elastic=runtime)
+        except faults.HostLost:
+            # abrupt machine death: no deregister, no flush, no result
+            os._exit(HOST_LOSS_EXIT)
+
+    snap = telemetry.get_registry().to_dict()
+    out = {
+        "host": args.host,
+        "exit_code": res.exit_code,
+        "status": res.status,
+        "steps_done": res.steps_done,
+        "last_step": res.last_step,
+        "loss": None if res.loss is None else float(res.loss),
+        "restarts": res.restarts,
+        "remeshes": res.remeshes,
+        "barrier_steps": res.barrier_steps,
+        "disagreements": _counter_total(
+            snap, "elastic_step_disagreements_total"),
+        "residual_dropped_norm": _counter_total(
+            snap, "elastic_residual_dropped_norm_total"),
+        "data_degree_final": int(trainer.mesh.shape.get("data", 1)),
+        "telemetry": snap,
+    }
+    results_dir = os.path.join(args.root, "results")
+    os.makedirs(results_dir, exist_ok=True)
+    tmp = os.path.join(results_dir, f".{args.host}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(out, f)
+    os.replace(tmp, os.path.join(results_dir, args.host + ".json"))
+    mgr.close()
+    hb_stop.set()
+    em.close()
+    return res.exit_code
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+class SimCluster:
+    """Launch and supervise N simulated hosts sharing one cluster root."""
+
+    def __init__(self, root: str, n_hosts: int = 3, np_spec: str = "2:3",
+                 steps: int = 24, hb_timeout: float = 1.0,
+                 step_delay: float = 0.15, seed: int = 7):
+        self.root = os.path.abspath(root)
+        self.n_hosts = n_hosts
+        self.np_spec = np_spec
+        self.steps = steps
+        self.hb_timeout = hb_timeout
+        self.step_delay = step_delay
+        self.seed = seed
+        os.makedirs(self.root, exist_ok=True)
+
+    def host_ckpt_dir(self, i: int) -> str:
+        return os.path.join(self.root, "ckpt", _host_name(i))
+
+    def seed_divergent(self, steps_by_host: Dict[int, int]):
+        """Pre-seed per-host checkpoint dirs to different steps (the
+        divergence the restore barrier must reconcile)."""
+        for i, upto in steps_by_host.items():
+            seed_checkpoints(self.host_ckpt_dir(i), upto, seed=self.seed)
+
+    def _spawn(self, i: int, faults_for: List[tuple]) -> subprocess.Popen:
+        cmd = [sys.executable, "-m", "paddle_tpu.resilience.hostsim",
+               "--root", self.root, "--host", _host_name(i),
+               "--world", str(self.n_hosts), "--np", self.np_spec,
+               "--steps", str(self.steps), "--seed", str(self.seed),
+               "--hb-timeout", str(self.hb_timeout),
+               "--step-delay", str(self.step_delay)]
+        for kind, at in faults_for:
+            cmd += ["--fault", f"{kind}:{at}"]
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+
+    def run(self, faults: Optional[Dict[int, List[tuple]]] = None,
+            timeout: float = 300.0) -> dict:
+        """Run the cluster to completion. ``faults`` maps host index ->
+        [(kind, at_step), ...]. Returns per-host exit codes, parsed
+        result JSONs (None for dead hosts), and the host-loss count."""
+        faults = faults or {}
+        procs = {i: self._spawn(i, faults.get(i, []))
+                 for i in range(self.n_hosts)}
+        deadline = time.time() + timeout
+        exit_codes: Dict[str, Optional[int]] = {}
+        stderr: Dict[str, str] = {}
+        for i, proc in procs.items():
+            budget = max(1.0, deadline - time.time())
+            try:
+                _, err = proc.communicate(timeout=budget)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                _, err = proc.communicate()
+            exit_codes[_host_name(i)] = proc.returncode
+            stderr[_host_name(i)] = (err or "")[-4000:]
+        results: Dict[str, Optional[dict]] = {}
+        for i in range(self.n_hosts):
+            h = _host_name(i)
+            path = os.path.join(self.root, "results", h + ".json")
+            try:
+                with open(path) as f:
+                    results[h] = json.load(f)
+            except (OSError, ValueError):
+                results[h] = None
+        hosts_lost = sum(1 for c in exit_codes.values()
+                         if c == HOST_LOSS_EXIT)
+        return {"exit_codes": exit_codes, "results": results,
+                "hosts_lost": hosts_lost, "stderr": stderr}
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main())

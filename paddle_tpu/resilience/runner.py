@@ -17,18 +17,25 @@ transparent resume, the launcher's SIGTERM drain):
   bounded by ``max_restarts``.
 - **elastic restart** — when an ElasticManager observes a membership
   change (``ElasticStatus.RESTART``), the loop checkpoints and returns
-  exit code 75 (EX_TEMPFAIL: re-exec me), instead of raising through the
-  user's stack.
+  exit code 75 (EX_TEMPFAIL: re-exec me). With an
+  ``elastic.ElasticRuntime`` instead (``reenter=True``) the RESTART is
+  handled *in place*: drain → commit → bounded remesh/reshard →
+  coordinated restore barrier → continue, with the data cursor taken
+  from the restored checkpoint (never rewound to zero). ``elastic=True``
+  constructs an ElasticManager from the ``PADDLE_ELASTIC_*`` env (the
+  launcher's contract).
 - **faulty input pipeline** — batch fetches run under retry/backoff
   (site ``dataloader_fetch``); the ``nan_grad`` fault is delivered via the
   step's ``grad_taint`` operand so the in-graph guard — not the runner —
-  does the skipping.
+  does the skipping. ``host_loss`` raises ``faults.HostLost`` through the
+  loop (abrupt death: only a supervisor recovers it); ``host_join``
+  materializes a synthetic elastic member.
 """
 from __future__ import annotations
 
 import dataclasses
 import signal
-from typing import Any, Iterable, Optional
+from typing import Any, Iterable, List, Optional
 
 import jax
 import numpy as np
@@ -56,6 +63,9 @@ class RunResult:
     restarts: int          # in-process SimulatedCrash recoveries
     skipped_steps: int     # NaN-guard skips (from trainer state, total)
     restore_fallbacks: int # corrupt checkpoints skipped during restores
+    remeshes: int = 0      # in-place elastic remeshes (runtime lifetime total)
+    barrier_steps: List[int] = dataclasses.field(default_factory=list)
+                           # common step of each restore-barrier entry
 
 
 class _StopFlag:
@@ -130,7 +140,13 @@ def run_resilient(trainer, loader: Iterable, steps: int,
     re-iterable with a deterministic order (the epoch/batch cursor
     fast-forwards it on resume)."""
     from .. import telemetry
+    from ..distributed.fleet.elastic import ElasticManager, ElasticStatus
     tel = telemetry.enabled()
+    if elastic is True:
+        elastic = ElasticManager()
+    # an elastic object that re-enters in place (elastic.ElasticRuntime)
+    # vs a plain manager whose RESTART means "exit 75, get relaunched"
+    runtime = elastic if getattr(elastic, "reenter", False) else None
     stop = _StopFlag()
     if handle_signals:
         stop.install()
@@ -138,9 +154,47 @@ def run_resilient(trainer, loader: Iterable, steps: int,
     step, epoch, batch = 0, 0, 0
     last_loss = None
 
+    def _result(exit_code, status, loss=None):
+        return RunResult(
+            exit_code=exit_code, status=status,
+            steps_done=step, last_step=step - 1,
+            loss=loss, restarts=restarts,
+            skipped_steps=trainer.skipped_steps(),
+            restore_fallbacks=getattr(manager, "restore_fallbacks_total", 0),
+            remeshes=runtime.remeshes if runtime is not None else 0,
+            barrier_steps=(list(runtime.barrier_steps)
+                           if runtime is not None else []))
+
+    def _enter(template_comm=None):
+        """(Re)entry through the runtime's restore barrier; plain
+        newest-valid restore without a runtime. Returns the restored
+        (step, epoch, batch) cursor or None."""
+        if runtime is None:
+            return _restore(manager, trainer)
+        state_t = dict(trainer.state)
+        if template_comm is not None:
+            # the drain checkpoint was written on the PRE-remesh mesh:
+            # restore its residuals into matching buffers, remap after
+            state_t["comm_err"] = template_comm
+        template = {"trainer": state_t, "meta": _meta(0, 0, 0)}
+        restored = runtime.enter(manager, template)
+        if restored is None:
+            return None
+        rt = dict(restored["trainer"])
+        rest_comm = rt.pop("comm_err", {})
+        new_state = dict(trainer.state)
+        new_state.update(rt)
+        trainer.state = new_state
+        from .elastic import remap_comm_err
+        remap_comm_err({k: np.asarray(jax.device_get(v))
+                        for k, v in rest_comm.items()}, trainer)
+        meta = restored["meta"]
+        _set_rng_key_data(meta["rng"])
+        return (int(meta["step"]), int(meta["epoch"]), int(meta["batch"]))
+
     def _resume():
         nonlocal step, epoch, batch
-        cur = _restore(manager, trainer)
+        cur = _enter()
         if cur is not None:
             step, epoch, batch = cur[0] + 1, cur[1], cur[2]
             if tel:
@@ -164,33 +218,39 @@ def run_resilient(trainer, loader: Iterable, steps: int,
         while step < steps:
             if faults.fires("sigterm", step):
                 signal.raise_signal(signal.SIGTERM)
+            if faults.fires("host_loss", step):
+                raise faults.HostLost(f"injected host_loss at step {step}")
+            if faults.fires("host_join", step) and \
+                    hasattr(elastic, "simulate_join"):
+                elastic.simulate_join()
             if stop.signum is not None:
                 if manager is not None and step > 0:
                     _save(manager, trainer, step - 1, epoch, batch)
                     manager.wait_until_finished()
                 sig = stop.signum
-                return RunResult(
-                    exit_code=128 + sig,
-                    status="sigterm" if sig == signal.SIGTERM else "sigint",
-                    steps_done=step, last_step=step - 1,
-                    loss=last_loss, restarts=restarts,
-                    skipped_steps=trainer.skipped_steps(),
-                    restore_fallbacks=getattr(
-                        manager, "restore_fallbacks_total", 0))
+                return _result(
+                    128 + sig,
+                    "sigterm" if sig == signal.SIGTERM else "sigint",
+                    loss=last_loss)
             if elastic is not None:
-                from ..distributed.fleet.elastic import ElasticStatus
                 st = elastic.watch()
                 if st == ElasticStatus.RESTART:
                     if manager is not None and step > 0:
                         _save(manager, trainer, step - 1, epoch, batch)
                         manager.wait_until_finished()
-                    return RunResult(
-                        exit_code=EXIT_RESTART, status="restart",
-                        steps_done=step, last_step=step - 1,
-                        loss=last_loss, restarts=restarts,
-                        skipped_steps=trainer.skipped_steps(),
-                        restore_fallbacks=getattr(
-                            manager, "restore_fallbacks_total", 0))
+                    # pre-remesh residual buffers, captured so the drain
+                    # checkpoint restores into old-mesh shapes
+                    old_comm = trainer.state.get("comm_err", {}) \
+                        if runtime is not None else None
+                    if runtime is not None and runtime.on_restart(trainer):
+                        cur = _enter(template_comm=old_comm)
+                        if cur is not None:
+                            step, epoch, batch = cur[0] + 1, cur[1], cur[2]
+                        # else: coordinated fresh start on a joiner —
+                        # keep the live cursor, never rewind on RESTART
+                        it = _iter_from_cursor()
+                        continue
+                    return _result(EXIT_RESTART, "restart", loss=last_loss)
 
             def _fetch():
                 nonlocal it, epoch, batch
@@ -238,11 +298,7 @@ def run_resilient(trainer, loader: Iterable, steps: int,
                 "resilience_steps_skipped",
                 "steps the NaN guard skipped (from trainer state)"
             ).set(skipped)
-        return RunResult(
-            exit_code=EXIT_OK, status="completed",
-            steps_done=step, last_step=step - 1, loss=loss_val,
-            restarts=restarts, skipped_steps=skipped,
-            restore_fallbacks=getattr(manager, "restore_fallbacks_total", 0))
+        return _result(EXIT_OK, "completed", loss=loss_val)
     finally:
         if handle_signals:
             stop.uninstall()

@@ -61,7 +61,21 @@ resilience_faults_injected_total counter  resilience.faults {kind=...}
 resilience_restarts_total      counter    run_resilient crash recoveries
 resilience_resumes_total       counter    run_resilient checkpoint resumes
 resilience_steps_skipped       gauge      run_resilient (NaN-guard skips)
+elastic_restore_barrier_total  counter    resilience.elastic coordinated
+                                          restore barriers completed
+elastic_step_disagreements_total counter  restore barriers where hosts
+                                          reported divergent steps
+elastic_remesh_total           counter    reshard_trainer remesh ops
+elastic_remesh_failed_total    counter    remesh attempts that fell back
+                                          to the relaunch path (exit 75)
+elastic_residual_dropped_norm_total counter  L2 norm of comm_err rows
+                                          dropped by a scale-down remap
 =============================  =========  =================================
+
+Multi-host merge: ``telemetry.aggregate.gather_registries()`` allgathers
+every process's ``Registry.to_dict()`` and merges on rank 0 with
+``process_index`` labels (per-host series stay distinct, so straggler
+skew survives the merge).
 """
 from __future__ import annotations
 
@@ -75,7 +89,7 @@ from .scope import TelemetryScope, scope  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "DEFAULT_BUCKETS",
-    "scope", "TelemetryScope",
+    "scope", "TelemetryScope", "aggregate",
     "enable", "disable", "enabled", "is_enabled",
     "get_registry", "counter", "gauge", "histogram",
     "prometheus_text", "emit", "peak_flops_per_sec",
@@ -140,6 +154,9 @@ def emit(event: str, **fields):
     s = _sink
     if s is not None:
         s.emit({"event": event, "ts": time.time(), **fields})
+
+
+from . import aggregate  # noqa: E402,F401  (stdlib-only module, safe here)
 
 
 def peak_flops_per_sec() -> float:

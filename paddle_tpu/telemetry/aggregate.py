@@ -1,0 +1,97 @@
+"""Per-host telemetry aggregation: rank-0 merge of Registry snapshots.
+
+Each host's ``Registry.to_dict()`` snapshot is merged into one dict with
+a ``process_index`` label prepended to every series key, so per-host
+series stay distinct after the merge — straggler skew (one slow host's
+``step_time_seconds``) remains visible instead of being averaged away.
+
+Two transports:
+
+- ``gather_registries()`` — the jax path: allgather the JSON-encoded
+  snapshot over ``jax.experimental.multihost_utils`` and merge on
+  ``jax.process_index() == 0`` (other ranks get None). Degenerates to a
+  local relabel when ``process_count() == 1``.
+- ``gather_via_coordinator(coordinator, hosts_fn)`` — the file-KV path
+  used by the elastic hostsim (no jax.distributed): every participant
+  contributes through a ``FileCoordinator.allgather`` round and every
+  participant receives the merge; the rank-0 host (first in sorted
+  order) is the conventional exporter.
+"""
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["with_process_index", "merge_process_dicts",
+           "gather_registries", "gather_via_coordinator"]
+
+
+def _tag_key(series_key: str, index: int) -> str:
+    tag = f"process_index={index}"
+    return f"{tag},{series_key}" if series_key else tag
+
+
+def with_process_index(snapshot: dict, index: int) -> dict:
+    """Relabel one ``Registry.to_dict()`` snapshot with its process."""
+    out = {}
+    for name, m in snapshot.items():
+        out[name] = {"type": m.get("type"), "help": m.get("help"),
+                     "series": {_tag_key(k, index): v
+                                for k, v in m.get("series", {}).items()}}
+    return out
+
+
+def merge_process_dicts(snapshots: Dict[int, dict]) -> dict:
+    """Merge ``{process_index: Registry.to_dict()}`` into one snapshot.
+    Series never collide (each carries its process_index label); on a
+    metric-kind mismatch across hosts the first host's type/help win."""
+    merged: dict = {}
+    for index in sorted(snapshots):
+        tagged = with_process_index(snapshots[index], index)
+        for name, m in tagged.items():
+            if name not in merged:
+                merged[name] = {"type": m["type"], "help": m["help"],
+                                "series": {}}
+            merged[name]["series"].update(m["series"])
+    return merged
+
+
+def gather_registries(registry=None) -> Optional[dict]:
+    """Allgather every process's registry snapshot and merge on rank 0
+    (returns None elsewhere). Single-process: a local relabel+merge."""
+    import jax
+    from . import get_registry
+    reg = registry if registry is not None else get_registry()
+    snapshot = reg.to_dict()
+    n = jax.process_count()
+    if n == 1:
+        return merge_process_dicts({0: snapshot})
+    import numpy as np
+    from jax.experimental import multihost_utils
+    payload = json.dumps(snapshot).encode("utf-8")
+    lengths = multihost_utils.process_allgather(
+        np.asarray([len(payload)], dtype=np.int32))
+    cap = int(np.max(lengths))
+    buf = np.zeros((cap,), dtype=np.uint8)
+    buf[:len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    gathered = multihost_utils.process_allgather(buf)
+    if jax.process_index() != 0:
+        return None
+    lengths = np.asarray(lengths).reshape(n, -1)[:, 0]
+    gathered = np.asarray(gathered).reshape(n, -1)
+    return merge_process_dicts({
+        i: json.loads(bytes(gathered[i, :int(lengths[i])]).decode("utf-8"))
+        for i in range(n)})
+
+
+def gather_via_coordinator(coordinator, hosts_fn: Callable[[], List[str]],
+                           registry=None, timeout: float = 60.0) -> dict:
+    """File-KV transport for the same merge: every participating host
+    contributes its snapshot and receives the full merge; process indices
+    are the ranks of the sorted participating host names."""
+    from . import get_registry
+    reg = registry if registry is not None else get_registry()
+    gathered = coordinator.allgather("telemetry_agg", reg.to_dict(),
+                                     hosts_fn, timeout=timeout)
+    hosts = sorted(gathered)
+    return merge_process_dicts({hosts.index(h): gathered[h] for h in hosts})

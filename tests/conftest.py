@@ -15,3 +15,39 @@ import jax  # noqa: E402
 # The axon TPU plugin ignores the JAX_PLATFORMS env var — force via config.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+
+import signal as _signal  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: heavy tests excluded from tier-1 (-m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "multihost(timeout): multi-process elastic/simulation tests, "
+        "bounded by a SIGALRM watchdog (default 300s) so a wedged "
+        "subprocess cannot eat the tier-1 budget")
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    marker = item.get_closest_marker("multihost")
+    if marker is None or not hasattr(_signal, "SIGALRM"):
+        yield
+        return
+    timeout = int(marker.kwargs.get(
+        "timeout", marker.args[0] if marker.args else 300))
+
+    def _alarm(signum, frame):
+        raise TimeoutError(
+            f"multihost test exceeded its {timeout}s watchdog")
+
+    prev = _signal.signal(_signal.SIGALRM, _alarm)
+    _signal.alarm(timeout)
+    try:
+        yield
+    finally:
+        _signal.alarm(0)
+        _signal.signal(_signal.SIGALRM, prev)

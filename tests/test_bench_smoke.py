@@ -109,6 +109,30 @@ def test_bench_collectives_smoke_telemetry():
     assert extra["telemetry"]["prometheus_bytes"] > 0
 
 
+@pytest.mark.multihost(timeout=420)
+def test_chaos_host_loss_scenario():
+    """tools/chaos_smoke.py --scenario host_loss: the ISSUE acceptance
+    path — 3 subprocess hosts with divergent seeded checkpoints (host0
+    valid to step 10, host1/host2 to step 8) coordinate a restore of step
+    8, host1 dies mid-run, the survivors remesh and run to completion."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_smoke.py"),
+         "--scenario", "host_loss"],
+        capture_output=True, text=True, timeout=400, env=_env())
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert lines, f"no stdout; stderr: {proc.stderr[-2000:]}"
+    res = json.loads(lines[-1])
+    assert res["exit_code"] == 0, res
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert res["scenario"] == "host_loss"
+    assert res["hosts_lost"] == 1
+    assert res["restored_step"] == 8   # min-reduced over {10, 8, 8}
+    assert res["remeshes"] >= 1
+    assert res["barrier_steps"] and res["barrier_steps"][0] == 8
+    assert res["disagreements"] >= 1
+    assert res["merged_metric_count"] > 0
+
+
 def test_numerics_smoke_cpu():
     """tools/numerics_smoke.py: all kernel-vs-dense checks pass on the
     CPU interpreter; on-chip runs reuse the same script (r3 item 10)."""

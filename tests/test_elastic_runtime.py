@@ -1,0 +1,560 @@
+"""Elastic multi-host runtime: coordinated restore barrier, remesh +
+reshard, comm_err residual remapping, runner re-entry, and the fleet
+ElasticManager satellites (stale reaping, close(), np parsing)."""
+import json
+import os
+import signal
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, telemetry
+from paddle_tpu.distributed.checkpoint import CheckpointManager
+from paddle_tpu.distributed.engine import ParallelTrainer
+from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.resilience import faults, run_resilient
+from paddle_tpu.resilience.elastic import (CoordinatorTimeout,
+                                           ElasticRuntime, FileCoordinator,
+                                           coordinated_restore,
+                                           data_parallel_remesh_fn,
+                                           remap_comm_err, reshard_trainer)
+from paddle_tpu.telemetry import aggregate
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    yield
+    faults.reset()
+
+
+@pytest.fixture()
+def fresh_registry():
+    """Isolated telemetry registry, enabled for the test."""
+    old_reg = telemetry.get_registry()
+    old_on = telemetry.enabled()
+    reg = telemetry.Registry()
+    telemetry._set_registry(reg)
+    telemetry.enable(True)
+    yield reg
+    telemetry._set_registry(old_reg)
+    telemetry.enable(old_on)
+
+
+def _counter_total(reg, name):
+    series = reg.to_dict().get(name, {}).get("series", {})
+    return sum(series.values())
+
+
+def _mlp_trainer(data=2, grad_sync="int8", seed=7):
+    paddle.seed(seed)
+    mesh = build_mesh({"data": data})
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(8, 16)
+            self.l2 = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.l2(nn.functional.relu(self.l1(x)))
+
+    model = MLP()
+    opt = paddle.optimizer.Momentum(0.05, momentum=0.9,
+                                    parameters=model.parameters())
+    return ParallelTrainer(model, opt,
+                           lambda out, y: jnp.mean((out - y) ** 2),
+                           mesh=mesh, grad_sync=grad_sync, grad_sync_block=8)
+
+
+def _loader(n=4, batch=8, seed=3):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(batch, 8).astype(np.float32),
+             rng.randn(batch, 4).astype(np.float32)) for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# fleet ElasticManager satellites
+# ---------------------------------------------------------------------------
+
+class TestElasticManager:
+    def test_parse_np(self):
+        assert ElasticManager._parse_np("4") == (4, 4)
+        assert ElasticManager._parse_np("2:3") == (2, 3)
+        assert ElasticManager._parse_np("0") == (0, 0)
+
+    def _mgr(self, tmp_path, host="a", np_spec="2:3", timeout=30.0):
+        return ElasticManager(elastic_server=str(tmp_path), job_id="j",
+                              np=np_spec, host=host, timeout=timeout)
+
+    def test_watch_hold_restart_exit_transitions(self, tmp_path):
+        em = self._mgr(tmp_path, host="a", np_spec="2:3")
+        em.register()
+        try:
+            # n=1 < np_min -> RESTART (someone must relaunch the fleet)
+            assert em.watch() == ElasticStatus.RESTART
+            b = self._mgr(tmp_path, host="b")
+            b.register()
+            assert em.watch() == ElasticStatus.HOLD          # n=2 in range
+            c = self._mgr(tmp_path, host="c")
+            c.register()
+            assert em.watch() == ElasticStatus.HOLD          # n=3 == np_max
+            d = self._mgr(tmp_path, host="d")
+            d.register()
+            assert em.watch() == ElasticStatus.RESTART       # n=4 > np_max
+            for m in (b, c, d):
+                m.close()
+            # only the observer left, and it stops advertising itself:
+            em.deregister()
+            assert em.watch() == ElasticStatus.EXIT          # n=0
+        finally:
+            em.close()
+
+    def test_stale_member_reaped_in_watch(self, tmp_path):
+        em = self._mgr(tmp_path, host="a", np_spec="1:2", timeout=5.0)
+        em.register()
+        try:
+            stale = em._member_file("ghost")
+            with open(stale, "w") as f:
+                f.write("1")
+            past = time.time() - 60
+            os.utime(stale, (past, past))
+            assert em.hosts() == ["a"]        # filtered...
+            assert os.path.exists(stale)
+            assert em.watch() == ElasticStatus.HOLD
+            assert not os.path.exists(stale)  # ...and now reaped
+        finally:
+            em.close()
+
+    def test_close_restores_signal_handlers_and_deregisters(self, tmp_path):
+        prev_term = signal.getsignal(signal.SIGTERM)
+        prev_int = signal.getsignal(signal.SIGINT)
+        em = self._mgr(tmp_path, host="a", np_spec="1:1")
+        em.register()
+        assert signal.getsignal(signal.SIGTERM) == em.signal_handler
+        member = em._member_file()
+        assert os.path.exists(member)
+        em.close()
+        assert signal.getsignal(signal.SIGTERM) == prev_term
+        assert signal.getsignal(signal.SIGINT) == prev_int
+        assert not os.path.exists(member)
+        em.close()  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# FileCoordinator
+# ---------------------------------------------------------------------------
+
+class TestFileCoordinator:
+    def test_allgather_three_participants(self, tmp_path):
+        hosts = ["a", "b", "c"]
+        results = {}
+
+        def _run(h, i):
+            coord = FileCoordinator(str(tmp_path), job_id="j", host=h,
+                                    poll=0.01)
+            results[h] = coord.allgather("vals", i, lambda: hosts,
+                                         timeout=20.0)
+
+        ts = [threading.Thread(target=_run, args=(h, i))
+              for i, h in enumerate(hosts)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        for h in hosts:
+            assert results[h] == {"a": 0, "b": 1, "c": 2}
+
+    def test_round_generations_do_not_reuse_values(self, tmp_path):
+        hosts = ["a", "b"]
+        out = {}
+
+        def _run(h, values):
+            coord = FileCoordinator(str(tmp_path), job_id="j", host=h,
+                                    poll=0.01)
+            out[h] = [coord.allgather("step", v, lambda: hosts, timeout=20.0)
+                      for v in values]
+
+        ts = [threading.Thread(target=_run, args=("a", [10, 20])),
+              threading.Thread(target=_run, args=("b", [11, 21]))]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        for h in hosts:
+            assert out[h][0] == {"a": 10, "b": 11}
+            assert out[h][1] == {"a": 20, "b": 21}
+
+    def test_timeout_when_participant_missing(self, tmp_path):
+        coord = FileCoordinator(str(tmp_path), job_id="j", host="a",
+                                poll=0.01)
+        with pytest.raises(CoordinatorTimeout):
+            coord.barrier("never", lambda: ["a", "ghost"], timeout=0.3)
+
+    def test_membership_shrink_mid_round_completes(self, tmp_path):
+        """hosts_fn is re-read every poll: when a peer dies mid-round and
+        drops out of the live set, the round completes without it."""
+        live = ["a", "ghost"]
+        coord = FileCoordinator(str(tmp_path), job_id="j", host="a",
+                                poll=0.01)
+
+        def _shrink():
+            time.sleep(0.2)
+            live.remove("ghost")
+
+        t = threading.Thread(target=_shrink)
+        t.start()
+        got = coord.allgather("shrink", 7, lambda: list(live), timeout=20.0)
+        t.join()
+        assert got == {"a": 7}
+
+
+# ---------------------------------------------------------------------------
+# coordinated restore barrier
+# ---------------------------------------------------------------------------
+
+def _tiny_state(v=0.0):
+    return {"w": np.full((4,), v, dtype=np.float32)}
+
+
+def _seed_manager(path, upto):
+    mgr = CheckpointManager(str(path), max_to_keep=upto + 1, use_async=False)
+    for s in range(upto + 1):
+        mgr.save(s, _tiny_state(float(s)))
+    mgr.wait_until_finished()
+    return mgr
+
+
+class TestCoordinatedRestore:
+    def test_min_reduce_across_divergent_hosts(self, tmp_path,
+                                               fresh_registry):
+        """host a valid to step 2, b/c only to step 1: everyone restores
+        step 1 (the ISSUE acceptance shape, scaled down)."""
+        upto = {"a": 2, "b": 1, "c": 1}
+        mgrs = {h: _seed_manager(tmp_path / h, s) for h, s in upto.items()}
+        hosts = sorted(upto)
+        out = {}
+
+        def _run(h):
+            coord = FileCoordinator(str(tmp_path / "coord"), job_id="j",
+                                    host=h, poll=0.01)
+            out[h] = coordinated_restore(mgrs[h], _tiny_state(), coord,
+                                         lambda: hosts, timeout=30.0)
+
+        ts = [threading.Thread(target=_run, args=(h,)) for h in hosts]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        for h in hosts:
+            restored, common = out[h]
+            assert common == 1
+            np.testing.assert_allclose(np.asarray(restored["w"]),
+                                       _tiny_state(1.0)["w"])
+        assert _counter_total(fresh_registry,
+                              "elastic_restore_barrier_total") == 3
+        assert _counter_total(fresh_registry,
+                              "elastic_step_disagreements_total") == 3
+        for m in mgrs.values():
+            m.close()
+
+    def test_fresh_start_when_any_host_empty(self, tmp_path):
+        mgr_a = _seed_manager(tmp_path / "a", 2)
+        mgr_b = CheckpointManager(str(tmp_path / "b"), use_async=False)
+        hosts = ["a", "b"]
+        out = {}
+
+        def _run(h, mgr):
+            coord = FileCoordinator(str(tmp_path / "coord"), job_id="j",
+                                    host=h, poll=0.01)
+            out[h] = coordinated_restore(mgr, _tiny_state(), coord,
+                                         lambda: hosts, timeout=30.0)
+
+        ts = [threading.Thread(target=_run, args=("a", mgr_a)),
+              threading.Thread(target=_run, args=("b", mgr_b))]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert out["a"] == (None, -1)     # a does NOT train ahead on step 2
+        assert out["b"] == (None, -1)
+        mgr_a.close()
+        mgr_b.close()
+
+    def test_restore_divergence_fault_forces_rollback(self, tmp_path):
+        mgr = _seed_manager(tmp_path / "a", 2)
+        coord = FileCoordinator(str(tmp_path / "coord"), job_id="j",
+                                host="a", poll=0.01)
+        with faults.inject("restore_divergence"):
+            restored, common = coordinated_restore(
+                mgr, _tiny_state(), coord, lambda: ["a"], timeout=10.0)
+        assert common == 1                # reported 2-1, min-reduced to 1
+        np.testing.assert_allclose(np.asarray(restored["w"]),
+                                   _tiny_state(1.0)["w"])
+        mgr.close()
+
+    def test_divergence_beyond_retention_raises(self, tmp_path):
+        # a's retention no longer holds the common step -> loud failure,
+        # not silent training on mismatched state
+        mgr_a = CheckpointManager(str(tmp_path / "a"), max_to_keep=2,
+                                  use_async=False)
+        for s in range(5):
+            mgr_a.save(s, _tiny_state(float(s)))
+        mgr_b = _seed_manager(tmp_path / "b", 1)
+        hosts = ["a", "b"]
+        errs = {}
+
+        def _run(h, mgr):
+            coord = FileCoordinator(str(tmp_path / "coord"), job_id="j",
+                                    host=h, poll=0.01)
+            try:
+                # short timeout: host b restores fine but then waits at the
+                # exit barrier for a (which raised) — it must time out, not
+                # hold the suite for the full barrier budget
+                coordinated_restore(mgr, _tiny_state(), coord,
+                                    lambda: hosts, timeout=2.0)
+            except (RuntimeError, OSError) as e:
+                errs[h] = e
+
+        ts = [threading.Thread(target=_run, args=("a", mgr_a)),
+              threading.Thread(target=_run, args=("b", mgr_b))]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert "a" in errs and "retention" in str(errs["a"])
+        mgr_a.close()
+        mgr_b.close()
+
+
+# ---------------------------------------------------------------------------
+# comm_err residual remap + trainer reshard
+# ---------------------------------------------------------------------------
+
+class TestRemesh:
+    def test_remap_comm_err_scale_down_counts_dropped_norm(
+            self, fresh_registry):
+        tr = _mlp_trainer(data=4)
+        x, y = _loader(n=1)[0]
+        tr.train_step(x, y)
+        tr.train_step(x, y)
+        old = {k: np.asarray(jax.device_get(v))
+               for k, v in tr.state["comm_err"].items()}
+        assert all(v.shape[0] == 4 for v in old.values())
+        assert any(np.abs(v).sum() > 0 for v in old.values())
+        tr.remesh(build_mesh({"data": 2}))
+        remap_comm_err(old, tr)
+        new = {k: np.asarray(jax.device_get(v))
+               for k, v in tr.state["comm_err"].items()}
+        for k in old:
+            assert new[k].shape[0] == 2
+            np.testing.assert_allclose(new[k], old[k][:2], rtol=1e-6)
+        dropped = float(np.sqrt(sum(
+            float((v[2:].astype(np.float64) ** 2).sum())
+            for v in old.values())))
+        got = _counter_total(fresh_registry,
+                             "elastic_residual_dropped_norm_total")
+        assert got == pytest.approx(dropped, rel=1e-5)
+
+    def test_remap_comm_err_scale_up_zero_fills(self):
+        tr = _mlp_trainer(data=2)
+        x, y = _loader(n=1)[0]
+        tr.train_step(x, y)
+        old = {k: np.asarray(jax.device_get(v))
+               for k, v in tr.state["comm_err"].items()}
+        tr.remesh(build_mesh({"data": 4}))
+        remap_comm_err(old, tr)
+        for k, v in tr.state["comm_err"].items():
+            arr = np.asarray(jax.device_get(v))
+            assert arr.shape[0] == 4
+            np.testing.assert_allclose(arr[:2], old[k], rtol=1e-6)
+            np.testing.assert_array_equal(arr[2:], 0.0)
+
+    def test_reshard_trainer_preserves_params_across_meshes(self, tmp_path):
+        tr = _mlp_trainer(data=4)
+        x, y = _loader(n=1)[0]
+        loss0 = float(tr.train_step(x, y))
+        p0 = {k: np.asarray(jax.device_get(v))
+              for k, v in tr.state["params"].items()}
+        slots0 = jax.device_get(tr.state["opt"]["slots"])
+        reshard_trainer(tr, build_mesh({"data": 2}), str(tmp_path / "rs"))
+        assert tr.mesh.shape["data"] == 2
+        for k, v in tr.state["params"].items():
+            np.testing.assert_allclose(np.asarray(jax.device_get(v)),
+                                       p0[k], rtol=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6),
+            jax.device_get(tr.state["opt"]["slots"]), slots0)
+        # and the step programs were rebuilt for the new mesh
+        loss1 = float(tr.train_step(x, y))
+        assert np.isfinite(loss1)
+        assert np.isfinite(loss0)
+
+
+# ---------------------------------------------------------------------------
+# runner integration (in-process, single host + synthetic join)
+# ---------------------------------------------------------------------------
+
+class _CountingLoader:
+    """Re-iterable loader recording the index of every batch handed out,
+    so cursor rewinds are observable."""
+
+    def __init__(self, batches):
+        self.batches = batches
+        self.fetched = []
+
+    def __iter__(self):
+        for i, b in enumerate(self.batches):
+            self.fetched.append(i)
+            yield b
+
+
+def _runtime_fixture(tmp_path, degrees_fn, max_remeshes=2):
+    em = ElasticManager(elastic_server=str(tmp_path / "kv"), job_id="j",
+                        np="1:4", host="h0", timeout=10.0)
+    em.register()
+    runtime = ElasticRuntime(
+        em, remesh_fn=data_parallel_remesh_fn(str(tmp_path / "rs"),
+                                              degrees_fn=degrees_fn),
+        max_remeshes=max_remeshes, poll=0.01, stabilize_polls=2)
+    return em, runtime
+
+
+class TestRunnerElasticReentry:
+    def test_host_join_remeshes_in_place_and_completes(self, tmp_path):
+        em, runtime = _runtime_fixture(
+            tmp_path, lambda hosts: {"data": 2 * len(hosts)})
+        tr = _mlp_trainer(data=2)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=3,
+                                use_async=False)
+        loader = _CountingLoader(_loader(n=4))
+        try:
+            with faults.inject("host_join", at_step=2):
+                res = run_resilient(tr, loader, 6, manager=mgr,
+                                    save_every=1, elastic=runtime)
+            assert res.exit_code == 0 and res.status == "completed"
+            assert res.steps_done == 6
+            assert res.remeshes == 1
+            assert tr.mesh.shape["data"] == 4
+            assert all(v.shape[0] == 4
+                       for v in tr.state["comm_err"].values())
+            # entry barrier (fresh start) + one re-entry at the drain step
+            assert res.barrier_steps == [-1, 1]
+            # cursor resumed from the restored checkpoint, NOT rewound:
+            # 0,1 trained, then the fast-forward re-consumes 0,1 to reach
+            # the saved batch cursor, then training continues 2,3,wrap 0,1.
+            # A rewind-to-zero would instead retrain 0,1 (6 fetches total).
+            assert loader.fetched == [0, 1, 0, 1, 2, 3, 0, 1]
+        finally:
+            em.close()
+            mgr.close()
+
+    def test_max_remeshes_exhausted_falls_back_to_exit_75(self, tmp_path):
+        em, runtime = _runtime_fixture(
+            tmp_path, lambda hosts: {"data": 2}, max_remeshes=0)
+        tr = _mlp_trainer(data=2)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=3,
+                                use_async=False)
+        try:
+            with faults.inject("host_join", at_step=1):
+                res = run_resilient(tr, _loader(), 5, manager=mgr,
+                                    save_every=1, elastic=runtime)
+            assert res.exit_code == 75 and res.status == "restart"
+            assert res.remeshes == 0
+            # the drain checkpoint was still committed before giving up
+            assert mgr.latest_valid_step() == 0
+        finally:
+            em.close()
+            mgr.close()
+
+    def test_host_loss_fault_unwinds_uncaught(self):
+        tr = _mlp_trainer(data=2)
+        with faults.inject("host_loss", at_step=1):
+            with pytest.raises(faults.HostLost):
+                run_resilient(tr, _loader(), 4)
+
+    def test_plain_manager_restart_still_exits_75(self, tmp_path):
+        """Back-compat: a reenter-less elastic object keeps the relaunch
+        contract (the scheduler re-execs on 75)."""
+
+        class FakeElastic:
+            def watch(self):
+                return ElasticStatus.RESTART
+
+        tr = _mlp_trainer(data=2)
+        res = run_resilient(tr, _loader(), 4, elastic=FakeElastic())
+        assert res.exit_code == 75 and res.status == "restart"
+        assert res.remeshes == 0 and res.barrier_steps == []
+
+
+# ---------------------------------------------------------------------------
+# per-host telemetry aggregation
+# ---------------------------------------------------------------------------
+
+class TestTelemetryAggregation:
+    def _snap(self, steps, secs):
+        reg = telemetry.Registry()
+        reg.counter("steps_total", "steps").inc(steps)
+        reg.histogram("step_time_seconds", "t").observe(secs)
+        return reg.to_dict()
+
+    def test_merge_keeps_per_host_series_distinct(self):
+        merged = aggregate.merge_process_dicts(
+            {0: self._snap(10, 0.1), 1: self._snap(10, 0.9)})
+        series = merged["steps_total"]["series"]
+        assert series == {"process_index=0": 10, "process_index=1": 10}
+        hist = merged["step_time_seconds"]["series"]
+        # the straggler's step time is still visible, not averaged away
+        assert hist["process_index=0"]["sum"] == pytest.approx(0.1)
+        assert hist["process_index=1"]["sum"] == pytest.approx(0.9)
+
+    def test_merge_prefixes_existing_labels(self):
+        reg = telemetry.Registry()
+        reg.counter("grad_sync_bytes_total", "b").inc(5, policy="int8")
+        merged = aggregate.merge_process_dicts({3: reg.to_dict()})
+        assert merged["grad_sync_bytes_total"]["series"] == {
+            "process_index=3,policy=int8": 5}
+
+    def test_gather_registries_single_process(self, fresh_registry):
+        fresh_registry.counter("c", "x").inc(2)
+        merged = aggregate.gather_registries()
+        assert merged["c"]["series"] == {"process_index=0": 2}
+
+    def test_gather_via_coordinator_two_hosts(self, tmp_path):
+        hosts = ["a", "b"]
+        out = {}
+
+        def _run(h, steps):
+            reg = telemetry.Registry()
+            reg.counter("steps_total", "steps").inc(steps)
+            coord = FileCoordinator(str(tmp_path), job_id="j", host=h,
+                                    poll=0.01)
+            out[h] = aggregate.gather_via_coordinator(
+                coord, lambda: hosts, registry=reg, timeout=20.0)
+
+        ts = [threading.Thread(target=_run, args=("a", 3)),
+              threading.Thread(target=_run, args=("b", 5))]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        for h in hosts:
+            assert out[h]["steps_total"]["series"] == {
+                "process_index=0": 3, "process_index=1": 5}
+
+
+# ---------------------------------------------------------------------------
+# full subprocess simulation (beyond the tier-1 chaos scenario)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.multihost(timeout=420)
+def test_hostsim_divergent_restore_no_faults(tmp_path):
+    """3 subprocess hosts, divergent seeds, NO failures: everyone must
+    restore the common step 3 and complete all steps with no remesh."""
+    from paddle_tpu.resilience import hostsim
+    cluster = hostsim.SimCluster(str(tmp_path), n_hosts=3, np_spec="2:3",
+                                 steps=8, hb_timeout=1.0, step_delay=0.05)
+    cluster.seed_divergent({0: 5, 1: 3, 2: 3})
+    out = cluster.run(timeout=240)
+    assert out["hosts_lost"] == 0
+    for h, res in out["results"].items():
+        assert res is not None, (h, out["stderr"][h])
+        assert res["exit_code"] == 0, (h, res)
+        assert res["barrier_steps"][0] == 3
+        assert res["steps_done"] == 8
+        assert res["remeshes"] == 0

@@ -14,8 +14,19 @@ Prints ONE line of JSON::
 
     {"faults_injected": 3, "steps_skipped": 1, "restore_fallbacks": 1, ...}
 
+``--scenario host_loss`` runs the elastic multi-host scenario instead: a
+3-subprocess-host SimCluster with divergent seeded checkpoints (host0
+valid to step 10, host1/host2 only to step 8) — the coordinated restore
+barrier must roll every host back to step 8 — then host1 is killed
+mid-run by the ``host_loss`` fault and the survivors must detect the
+stale heartbeat, remesh, and resume to completion::
+
+    {"scenario": "host_loss", "hosts_lost": 1, "remeshes": 1,
+     "barrier_steps": [8, ...], "restored_step": 8, ...}
+
 Run: ``python tools/chaos_smoke.py [--steps 10] [--ckpt-dir DIR]``
-(also wired as a ``-m 'not slow'`` pytest in tests/test_resilience.py).
+(also wired as a ``-m 'not slow'`` pytest in tests/test_resilience.py;
+the host_loss scenario in tests/test_bench_smoke.py).
 """
 from __future__ import annotations
 
@@ -101,6 +112,51 @@ def run_chaos(steps: int, ckpt_dir: str, run_dir: str | None = None):
     }
 
 
+def run_host_loss(steps: int, root: str):
+    """Elastic multi-host scenario (see module docstring): divergent
+    restore barrier + mid-run host loss + remesh/resume, across 3 real
+    subprocess hosts. Returns the one-line summary dict."""
+    from paddle_tpu.resilience import hostsim
+    from paddle_tpu.telemetry.aggregate import merge_process_dicts
+
+    cluster = hostsim.SimCluster(root, n_hosts=3, np_spec="2:3",
+                                 steps=steps, hb_timeout=1.0,
+                                 step_delay=0.15)
+    # host0 trained ahead to step 10; host1/host2 only reached step 8
+    cluster.seed_divergent({0: 10, 1: 8, 2: 8})
+    out = cluster.run(faults={1: [("host_loss", 12)]}, timeout=280)
+
+    survivors = [r for r in out["results"].values() if r]
+    if not survivors:
+        return {"scenario": "host_loss", "hosts_lost": out["hosts_lost"],
+                "exit_code": 1, "error": "no surviving host wrote results",
+                "worker_exit_codes": out["exit_codes"],
+                "stderr": out["stderr"]}
+    restored = [r["barrier_steps"][0] for r in survivors
+                if r["barrier_steps"]]
+    ok = (out["hosts_lost"] == 1
+          and all(r["exit_code"] == 0 for r in survivors)
+          and len(survivors) == 2)
+    # per-host registries merged rank-0 style with process_index labels
+    merged = merge_process_dicts(
+        {i: r["telemetry"] for i, r in enumerate(survivors)})
+    return {
+        "scenario": "host_loss",
+        "hosts_lost": out["hosts_lost"],
+        "remeshes": max(r["remeshes"] for r in survivors),
+        "barrier_steps": max((r["barrier_steps"] for r in survivors),
+                             key=len),
+        "restored_step": min(restored) if restored else None,
+        "steps_done": min(r["steps_done"] for r in survivors),
+        "disagreements": max(r["disagreements"] for r in survivors),
+        "residual_dropped_norm": max(r["residual_dropped_norm"]
+                                     for r in survivors),
+        "merged_metric_count": len(merged),
+        "worker_exit_codes": out["exit_codes"],
+        "exit_code": 0 if ok else 1,
+    }
+
+
 def run_plain(steps: int, ckpt_dir: str):
     """Fault-free twin of run_chaos (same seed/data) for loss comparison."""
     from paddle_tpu.distributed.checkpoint import CheckpointManager
@@ -123,9 +179,15 @@ def main(argv=None) -> int:
                    help="telemetry run dir (metrics.prom / events.jsonl)")
     p.add_argument("--plain", action="store_true",
                    help="fault-free reference run instead of the chaos loop")
+    p.add_argument("--scenario", choices=["faults", "host_loss"],
+                   default="faults",
+                   help="faults: the in-process chaos loop (default); "
+                        "host_loss: the 3-subprocess elastic scenario")
     args = p.parse_args(argv)
     ckpt = args.ckpt_dir or tempfile.mkdtemp(prefix="chaos_ckpt_")
-    if args.plain:
+    if args.scenario == "host_loss":
+        out = run_host_loss(max(args.steps, 24), ckpt)
+    elif args.plain:
         out = run_plain(args.steps, ckpt)
     else:
         out = run_chaos(args.steps, ckpt, run_dir=args.run_dir)
