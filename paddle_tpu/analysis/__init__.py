@@ -38,10 +38,17 @@ def analyze_jaxpr(closed, mesh=None, donated=None,
     cfg = config or AnalysisConfig()
     findings = run_rules(closed, mesh=mesh, donated=donated, config=cfg,
                          rules=rule_ids)
+    summary = cost.summarize(closed, k=cfg.top_k,
+                             while_trips=cfg.while_trips)
+    if mesh is not None:
+        try:
+            summary.overlap = cost.overlap_summary(
+                closed, mesh, while_trips=cfg.while_trips)
+        except Exception:
+            pass  # the overlap model must never sink an analysis run
     return Report(
         findings=findings,
-        cost=cost.summarize(closed, k=cfg.top_k,
-                            while_trips=cfg.while_trips),
+        cost=summary,
         num_eqns=count_eqns(closed))
 
 

@@ -21,12 +21,13 @@ from __future__ import annotations
 from typing import Callable, List
 
 from .report import CostRow, CostSummary
-from .walker import source_summary, subjaxprs, unwrap, walk
+from .walker import (linear_schedule, source_summary, subjaxprs, unwrap,
+                     walk)
 
 __all__ = [
     "aval_bytes", "eqn_flops", "eqn_bytes", "dot_general_flops",
     "total_flops", "matmul_flops", "peak_live_bytes", "top_equations",
-    "summarize",
+    "summarize", "collective_wire_bytes", "overlap_summary",
 ]
 
 
@@ -217,6 +218,197 @@ def peak_live_bytes(jaxpr) -> float:
                 cur -= sizes.pop(id(a), 0.0)
                 last_use.pop(id(a), None)
     return peak
+
+
+# -- compute/collective overlap model ----------------------------------------
+
+# ring-algorithm wire cost per participating rank, as a multiple of the
+# payload: all-reduce moves 2(n-1)/n payloads (reduce-scatter + all-gather
+# halves), one-shot scatter/gather/all_to_all moves (n-1)/n, ppermute/
+# pbroadcast one full hop. "out" = the payload is the RESULT (all_gather:
+# input is the shard, the gathered output is what crosses the wire).
+_COLL_RING = {
+    "psum": ("in", 2.0),
+    "pmax": ("in", 2.0),
+    "pmin": ("in", 2.0),
+    "all_gather": ("out", 1.0),
+    "psum_scatter": ("in", 1.0),
+    "reduce_scatter": ("in", 1.0),
+    "all_to_all": ("in", 1.0),
+    "ppermute": ("in", None),
+    "pbroadcast": ("in", None),
+}
+
+
+def collective_wire_bytes(eqn, n: int) -> float:
+    """Ring-model bytes one rank moves for a collective over an
+    ``n``-rank group, from the traced operand/result dtypes (so an int8
+    payload is visibly 4x cheaper than fp32)."""
+    side, factor = _COLL_RING[eqn.primitive.name]
+    vs = eqn.outvars if side == "out" else eqn.invars
+    payload = float(sum(_var_bytes(v) for v in vs))
+    if factor is None:
+        return payload
+    return factor * (n - 1) / max(n, 1) * payload
+
+
+def _group_size(axes, mesh) -> int:
+    n = 1
+    for ax in axes:
+        n *= int(mesh.shape.get(ax, 1))
+    return n
+
+
+def _atomic_flops(eqn, while_trips: float) -> float:
+    """FLOPs of an opaque control-flow node (scan/while/cond) billed as
+    one compute block — same traversal semantics as :func:`total_flops`."""
+    subs = list(subjaxprs(eqn))
+    if not subs:
+        return eqn_flops(eqn)
+    if subs[0].kind == "cond":
+        return max(total_flops(s.jaxpr, while_trips) for s in subs)
+    tot = 0.0
+    for s in subs:
+        trips = s.trips if s.trips else while_trips
+        tot += trips * total_flops(s.jaxpr, while_trips)
+    return tot
+
+
+def overlap_summary(jaxpr, mesh, peak_flops=None, while_trips: float = 1.0,
+                    include_timeline: bool = False) -> dict:
+    """Two-stream schedule simulation of the staged program: a single
+    compute stream runs equations at ``peak_flops`` while each collective
+    runs asynchronously on its link's wire stream (one in flight per link
+    class, ring wire bytes / ``mesh.link_bandwidth``) as soon as its
+    operands exist. Scheduling is dependency-driven list scheduling over
+    the linearized program (:func:`linear_schedule`): the compute stream
+    always picks the earliest-ready equation, so work that does NOT
+    depend on an in-flight collective executes under it — the same
+    reordering freedom XLA's latency-hiding scheduler has. The stream
+    only goes idle (stalls) when every remaining equation is waiting on
+    an un-landed collective result, which is exactly what the backward-
+    overlapped bucketed exchange removes: a bucket's collective issued
+    mid-backward lands under the remaining buckets' backward compute
+    instead of serializing after it.
+
+    Returns a dict: ``compute_time``, ``collective_time``,
+    ``stalled_time`` (compute idle waiting on collectives, incl. the
+    tail wait after the last compute), ``overlap_efficiency`` =
+    (collective time - stalls) / collective time clamped to [0, 1]
+    (None when the program has no collectives), ``n_collectives``,
+    ``makespan``; with ``include_timeline`` also ``timeline``: per-node
+    start/end entries sorted by start time (zero-cost bookkeeping nodes
+    omitted). Estimates rank schedules — they are a model, not a
+    profiler.
+    """
+    import heapq
+    from ..distributed.mesh import axis_links, link_bandwidth
+    from .rules import collective_axes
+    if peak_flops is None:
+        from .. import telemetry as _telemetry
+        peak_flops = _telemetry.peak_flops_per_sec()
+    peak_flops = max(float(peak_flops), 1.0)
+    links = axis_links(mesh) if mesh is not None else {}
+    nodes = list(linear_schedule(jaxpr))
+
+    # Classify every node once: (is_collective, duration, link, wire_bytes).
+    plans = []
+    for node in nodes:
+        eqn = node.eqn
+        axes = ()
+        if not node.atomic and mesh is not None \
+                and node.primitive in _COLL_RING:
+            axes = tuple(ax for ax in collective_axes(eqn)
+                         if ax in node.bound_axes and ax in mesh.shape)
+        n_g = _group_size(axes, mesh) if axes else 1
+        if axes and n_g > 1:
+            link = ("dcn" if any(links.get(ax) == "dcn" for ax in axes)
+                    else "ici")
+            wire = collective_wire_bytes(eqn, n_g) * node.trips
+            plans.append((True, wire / link_bandwidth(link), link, wire,
+                          axes))
+        else:
+            f = (_atomic_flops(eqn, while_trips) if node.atomic
+                 else eqn_flops(eqn)) * node.trips
+            plans.append((False, f / peak_flops, None, f, ()))
+
+    # Dataflow edges over canonical var ids (linear_schedule already
+    # resolved call-boundary aliases).
+    producer = {}
+    for j, node in enumerate(nodes):
+        for o in node.out_ids:
+            producer[o] = j
+    consumers = [[] for _ in nodes]
+    indeg = [0] * len(nodes)
+    for j, node in enumerate(nodes):
+        deps = {producer[i] for i in node.in_ids
+                if i in producer and producer[i] != j}
+        indeg[j] = len(deps)
+        for d in deps:
+            consumers[d].append(j)
+
+    node_ready = [0.0] * len(nodes)
+    heap = [(0.0, j) for j in range(len(nodes)) if indeg[j] == 0]
+    heapq.heapify(heap)
+    wire_free = {}                # link class -> busy-until
+    t = 0.0                       # compute-stream cursor
+    coll_total = compute_total = 0.0
+    n_coll = 0
+    timeline = [] if include_timeline else None
+    while heap:
+        rt, j = heapq.heappop(heap)
+        node = nodes[j]
+        is_coll, dur, link, amount, axes = plans[j]
+        if is_coll:
+            start = max(rt, wire_free.get(link, 0.0))
+            done = start + dur
+            wire_free[link] = done
+            coll_total += dur
+            n_coll += 1
+            if timeline is not None:
+                timeline.append({
+                    "kind": "collective", "primitive": node.primitive,
+                    "path": "/".join(node.path) or "<top>",
+                    "eqn_index": node.index, "axes": list(axes),
+                    "link": link, "bytes": amount, "start": start,
+                    "end": done})
+        else:
+            start = max(t, rt)
+            idle = start - t
+            done = start + dur
+            t = done
+            compute_total += dur
+            if timeline is not None and (amount > 0 or idle > 0):
+                timeline.append({
+                    "kind": "compute", "primitive": node.primitive,
+                    "path": "/".join(node.path) or "<top>",
+                    "eqn_index": node.index, "flops": amount,
+                    "start": start, "end": done, "stall": idle})
+        for c in consumers[j]:
+            if done > node_ready[c]:
+                node_ready[c] = done
+            indeg[c] -= 1
+            if indeg[c] == 0:
+                heapq.heappush(heap, (node_ready[c], c))
+    end = max([t] + list(wire_free.values()))
+    stall = max(0.0, end - compute_total)
+    shadowed = max(0.0, coll_total - stall)
+    eff = (None if coll_total <= 0.0
+           else max(0.0, min(1.0, shadowed / coll_total)))
+    if timeline is not None:
+        timeline.sort(key=lambda e: (e["start"], e["eqn_index"]))
+    out = {
+        "compute_time": compute_total,
+        "collective_time": coll_total,
+        "stalled_time": stall,
+        "overlap_efficiency": eff,
+        "n_collectives": n_coll,
+        "makespan": end,
+        "peak_flops": peak_flops,
+    }
+    if timeline is not None:
+        out["timeline"] = timeline
+    return out
 
 
 # -- top-k table -------------------------------------------------------------

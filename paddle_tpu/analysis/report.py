@@ -69,6 +69,10 @@ class CostSummary:
     peak_live_bytes: float = 0.0
     arg_bytes: float = 0.0
     top: List[CostRow] = field(default_factory=list)
+    # overlap-model output (analysis/cost.py overlap_summary): present
+    # when the analysis ran with a mesh; overlap_efficiency is None for
+    # collective-free programs
+    overlap: Optional[dict] = None
 
     def to_dict(self) -> dict:
         return {"total_flops": self.total_flops,
@@ -76,7 +80,8 @@ class CostSummary:
                 "total_bytes": self.total_bytes,
                 "peak_live_bytes": self.peak_live_bytes,
                 "arg_bytes": self.arg_bytes,
-                "top": [r.to_dict() for r in self.top]}
+                "top": [r.to_dict() for r in self.top],
+                "overlap": self.overlap}
 
 
 def _human(n: float) -> str:
@@ -156,6 +161,13 @@ class Report:
             f"({_human(c.matmul_flops)} matmul), "
             f"{_human(c.total_bytes)}B traffic, "
             f"peak live {_human(c.peak_live_bytes)}B")
+        if c.overlap and c.overlap.get("overlap_efficiency") is not None:
+            o = c.overlap
+            lines.append(
+                f"overlap: {o['overlap_efficiency']:.2f} of "
+                f"{o['collective_time'] * 1e6:.4g}us collective time "
+                f"hidden under compute "
+                f"({o['n_collectives']} collectives)")
         if c.top:
             lines.append(f"top {len(c.top)} most expensive equations:")
             lines.append(f"  {'flops':>10s} {'bytes':>10s} {'trips':>6s} "

@@ -45,6 +45,16 @@ class AnalysisConfig:
     # link-mismatch: fp32 payloads below this cross DCN without a finding
     # (per-block scale exchanges are tiny and legitimately uncompressed)
     dcn_uncompressed_min_bytes: float = 1 << 20
+    # exchange-not-overlapped: the caller's intended grad-exchange bucket
+    # count. 0 = unknown (rule stays silent); 1 = monolithic mode (gated
+    # off by design); >= 2 = bucketed, the rule checks the collectives
+    # actually interleave with compute. ParallelTrainer.compile injects
+    # its own K when the caller leaves this at 0.
+    grad_sync_buckets: int = 0
+    # an equation is "compute-heavy" for the overlap rule at/above this
+    # many FLOPs (filters out the scalar bookkeeping that trails every
+    # program and would hide a genuinely serialized exchange)
+    overlap_min_flops: float = 1e5
     disabled_rules: frozenset = frozenset()
 
 
@@ -526,3 +536,72 @@ def pallas_config_untuned(ctx):
                   f"default block configs: no tuning-DB entry for "
                   f"{key!r} (python -m paddle_tpu.ops.pallas.tuner "
                   "persists one)")
+
+
+# grad-sync collectives: the primitives the compressed/bucketed exchange
+# emits, over the batch-reduction axes. The bytes floor keeps scalar
+# reductions (the loss pmean, guard flags) from counting as "exchange".
+_GRAD_SYNC_PRIMS = ("psum", "pmax", "all_to_all", "all_gather",
+                    "psum_scatter", "reduce_scatter")
+_GRAD_SYNC_AXES = frozenset(("data", "sharding", "sep"))
+_GRAD_SYNC_MIN_BYTES = 4096.0
+
+
+@register_rule("exchange-not-overlapped", "warning")
+def exchange_not_overlapped(ctx):
+    """A bucketed (K >= 2) gradient exchange whose collectives all
+    cluster together with no compute-heavy equation between the first
+    and the last — in linear program order the backward finished before
+    any exchange started, so collective time sits fully on the critical
+    path and the bucketing bought nothing (hook misplaced, buckets
+    collapsed to one, or the exchange got hoisted out of the backward).
+    Gated off when ``config.grad_sync_buckets`` is 0 (unknown — callers
+    that did not declare their mode) or 1 (monolithic by design)."""
+    cfg = ctx.config
+    if cfg.grad_sync_buckets < 2:
+        return
+    from .cost import _atomic_flops, eqn_flops
+    from .walker import linear_schedule
+    try:
+        nodes = linear_schedule(ctx.closed)
+    except Exception:
+        return
+    sync = []          # positions of grad-sync collectives
+    heavy = []         # positions of compute-heavy equations
+    first_node = None
+    for pos, node in enumerate(nodes):
+        eqn = node.eqn
+        if not node.atomic and node.primitive in _GRAD_SYNC_PRIMS:
+            axes = tuple(ax for ax in collective_axes(eqn)
+                         if ax in node.bound_axes)
+            if axes and set(axes) <= _GRAD_SYNC_AXES:
+                if ctx.mesh is not None:
+                    n = 1
+                    for ax in axes:
+                        n *= int(ctx.mesh.shape.get(ax, 1))
+                    if n <= 1:
+                        continue
+                if sum(_aval_nbytes(v) for v in eqn.invars) >= \
+                        _GRAD_SYNC_MIN_BYTES:
+                    sync.append(pos)
+                    if first_node is None:
+                        first_node = node
+                continue
+        f = (_atomic_flops(eqn, cfg.while_trips) if node.atomic
+             else eqn_flops(eqn)) * node.trips
+        if f >= cfg.overlap_min_flops:
+            heavy.append(pos)
+    if not sync or not heavy:
+        return
+    lo, hi = min(sync), max(sync)
+    if any(lo < p < hi for p in heavy):
+        return  # compute interleaves with the exchange: overlapped
+    site = EqnSite(first_node.eqn, first_node.path, first_node.index,
+                   first_node.bound_axes, first_node.trips, False, False)
+    yield ctx.finding(
+        site,
+        f"grad_sync_buckets={cfg.grad_sync_buckets} but all {len(sync)} "
+        "grad-sync collectives cluster with no compute-heavy equation "
+        "between them: the exchange is serialized after the backward "
+        "instead of overlapping it (check the per-bucket custom_vjp "
+        "hooks and that the buckets did not collapse to one)")

@@ -29,7 +29,7 @@ from typing import Iterator, Optional, Tuple
 __all__ = [
     "EqnSite", "SubJaxpr", "INLINE_CALL_PRIMS", "unwrap", "inline_target",
     "subjaxprs", "has_inner", "walk", "iter_jaxprs", "count_eqns",
-    "source_summary",
+    "source_summary", "SchedNode", "linear_schedule",
 ]
 
 # call-like primitives whose single inner jaxpr is semantically the
@@ -196,6 +196,105 @@ def iter_jaxprs(jaxpr, _path=()) -> Iterator[Tuple[Tuple[str, ...], object]]:
     for eqn in raw.eqns:
         for sub in subjaxprs(eqn):
             yield from iter_jaxprs(sub.jaxpr, _path + (sub.label,))
+
+
+@dataclass(frozen=True)
+class SchedNode:
+    """One step of the linearized program order (see
+    :func:`linear_schedule`).
+
+    atomic: the node stands for a whole inner program (scan/while/cond/
+    unknown higher-order) billed as one compute block; transparent call
+    shells and shard_map never appear — their bodies are flattened in.
+    in_ids/out_ids: canonical variable identities for dataflow (opaque
+    hashables) — call / shard_map boundary variables are aliased
+    through, so a consumer's in_id matches the producer's out_id across
+    those boundaries. Identities are namespaced per inlined body
+    INSTANCE: jax caches and shares jaxpr bodies across call sites, so
+    the same Var object shows up in every instantiation and raw id()
+    would weld unrelated call sites together.
+    """
+    eqn: object
+    path: Tuple[str, ...]
+    index: int
+    bound_axes: frozenset
+    trips: float
+    atomic: bool
+    in_ids: Tuple
+    out_ids: Tuple
+
+    @property
+    def primitive(self) -> str:
+        return self.eqn.primitive.name
+
+
+_TRANSPARENT_KINDS = ("call", "shard_map")
+
+
+def linear_schedule(jaxpr) -> list:
+    """Linearize a program into the flat equation order a sequential
+    executor would run: transparent call shells (pjit/remat/custom_vjp)
+    and shard_map are flattened into their bodies with boundary-variable
+    aliasing, while control flow (scan/while/cond) stays atomic — one
+    node billed as its whole body. This is the schedule the overlap model
+    (analysis/cost.py) simulates: equation order IS issue order, and the
+    aliased ids give true producer->consumer edges across call shells, so
+    a collective issued mid-backward is visibly separated from the
+    compute that consumes its result."""
+    nodes = []
+    alias = {}
+    frames = iter(range(1 << 62))  # fresh namespace per inlined body
+
+    def canon(fid, v):
+        i = (fid, id(v))
+        seen = 0
+        while i in alias and seen < 1000:
+            i = alias[i]
+            seen += 1
+        return i
+
+    def is_var(a):
+        return hasattr(a, "aval") and not hasattr(a, "val")
+
+    def go(raw, bound_axes, path, trips, fid):
+        for i, eqn in enumerate(raw.eqns):
+            subs = list(subjaxprs(eqn))
+            sub = subs[0] if len(subs) == 1 else None
+            if sub is not None and sub.kind in _TRANSPARENT_KINDS:
+                inner = sub.jaxpr
+                gid = next(frames)
+                axes = bound_axes
+                if sub.kind == "shard_map":
+                    mesh = eqn.params.get("mesh")
+                    axes = bound_axes | set(
+                        getattr(mesh, "axis_names", ()))
+                outer_in = list(eqn.invars)
+                inner_in = list(inner.invars)
+                # call consts ride first in the outer invars: align the
+                # body's invars with the outer TAIL; skip aliasing
+                # entirely on an unexpected arity mismatch (the body
+                # still linearizes, its inputs just read as ready)
+                if len(outer_in) > len(inner_in):
+                    outer_in = outer_in[len(outer_in) - len(inner_in):]
+                if len(outer_in) == len(inner_in):
+                    for ov, iv in zip(outer_in, inner_in):
+                        if is_var(ov):
+                            alias[(gid, id(iv))] = canon(fid, ov)
+                for ov, iv in zip(eqn.outvars, inner.outvars):
+                    if is_var(iv):
+                        alias[(fid, id(ov))] = canon(gid, iv)
+                go(inner, axes, path + (sub.label,), trips, gid)
+                continue
+            nodes.append(SchedNode(
+                eqn=eqn, path=path, index=i, bound_axes=bound_axes,
+                trips=trips, atomic=bool(subs),
+                in_ids=tuple(canon(fid, a) for a in eqn.invars
+                             if is_var(a)),
+                out_ids=tuple(canon(fid, v) for v in eqn.outvars)))
+
+    raw, _ = unwrap(jaxpr)
+    go(raw, frozenset(), (), 1.0, next(frames))
+    return nodes
 
 
 def count_eqns(jaxpr) -> int:

@@ -409,7 +409,8 @@ def compressed_tree_mean(tree, axis,
 
 def compressed_psum_scatter(x, axis, scatter_dim: int = 0,
                             policy: str = "int8",
-                            block: Optional[int] = None):
+                            block: Optional[int] = None,
+                            residual=None):
     """Block-quantized reduce-scatter SUM over ``axis`` — phase 1 of the
     two-phase exchange with NO gather: the wire-compressed drop-in for
     ``lax.psum_scatter(x, axis, scatter_dimension=scatter_dim,
@@ -417,20 +418,29 @@ def compressed_psum_scatter(x, axis, scatter_dim: int = 0,
     keeps only its own chunk, so gathering back would waste the win).
 
     Returns the SUM like psum_scatter; callers divide by the axis size
-    themselves. Stateless — sharded leaves carry no error-feedback
-    residual (their quantization error is fresh per step). Lossless
-    policies fall back to the plain (bf16-cast for "bf16") psum_scatter.
+    themselves. ``residual`` opts into error feedback: when given (an
+    fp32 array of x's shape), the effective input is ``x + residual``
+    and the call returns ``(out, new_residual)`` where new_residual is
+    this rank's full-tensor quantization error — the sharded-leaf
+    counterpart of :func:`compressed_tree_mean`'s residual threading, so
+    ZeRO-2/3 leaves get the same convergence treatment as replicated
+    ones. With ``residual=None`` the call is stateless and returns just
+    the scattered sum (the original contract). Lossless policies fall
+    back to the plain (bf16-cast for "bf16") psum_scatter, passing any
+    residual through untouched.
     """
     if policy not in GRAD_SYNC_POLICIES:
         raise ValueError(f"grad_sync policy {policy!r} not in "
                          f"{GRAD_SYNC_POLICIES}")
     if policy not in QUANTIZED_POLICIES:
         if policy == "bf16" and x.dtype == jnp.float32:
-            return lax.psum_scatter(
+            out = lax.psum_scatter(
                 x.astype(jnp.bfloat16), axis,
                 scatter_dimension=scatter_dim, tiled=True).astype(x.dtype)
-        return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim,
-                                tiled=True)
+        else:
+            out = lax.psum_scatter(x, axis, scatter_dimension=scatter_dim,
+                                   tiled=True)
+        return out if residual is None else (out, residual)
     n = _axis_size(axis)
     blk = resolve_block(policy, block)
     if policy == "int4":
@@ -442,6 +452,9 @@ def compressed_psum_scatter(x, axis, scatter_dim: int = 0,
         quant, dequant, levels = (quantize_int8_blocks,
                                   dequantize_int8_blocks, 127.0)
     xm = jnp.moveaxis(x, scatter_dim, 0)
+    if residual is not None:
+        xm = xm.astype(jnp.float32) + jnp.moveaxis(
+            residual, scatter_dim, 0).astype(jnp.float32)
     d0 = xm.shape[0]
     if d0 % n:
         raise ValueError(f"scatter dim size {d0} not divisible by axis "
@@ -482,7 +495,14 @@ def compressed_psum_scatter(x, axis, scatter_dim: int = 0,
         acc, my_scales = q, scale
     red = dequant(acc, my_scales, blk)
     out = red[:m].reshape(chunk_shape)
-    return jnp.moveaxis(out, 0, scatter_dim).astype(x.dtype)
+    out = jnp.moveaxis(out, 0, scatter_dim).astype(x.dtype)
+    if residual is None:
+        return out
+    # error feedback: this rank's full-tensor quantization error — what the
+    # shared-scale quantizer dropped from (x + residual) — carries forward
+    recon = dequant(q, scale, blk).reshape(n, m_pad)
+    err = (rows - recon)[:, :m].reshape((d0,) + chunk_shape[1:])
+    return out, jnp.moveaxis(err, 0, scatter_dim)
 
 
 def init_residuals(tree):

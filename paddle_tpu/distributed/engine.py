@@ -46,6 +46,66 @@ from .meta_parallel.sharding_parallel import shard_spec_for
 
 DATA_AXES = ("data", "sharding")  # batch is split over both (ZeRO ⊂ DP)
 
+# XLA flags that make the TPU compiler schedule collectives asynchronously
+# and hide them under compute — the hardware half of the bucketed
+# backward-overlapped exchange (the jaxpr half is the per-bucket
+# custom_vjp hooks in grads_fn). Must be in the environment BEFORE the
+# TPU backend initializes; enable_latency_hiding_scheduler() is the
+# idempotent setter.
+LATENCY_HIDING_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+)
+
+
+def enable_latency_hiding_scheduler(env=None) -> bool:
+    """Append :data:`LATENCY_HIDING_FLAGS` to ``LIBTPU_INIT_ARGS``,
+    skipping any flag already present so operator overrides win. Returns
+    True when the environment changed. libtpu reads these at backend
+    initialization, so call this before the first jax device query (the
+    trainer calls it best-effort when ``grad_sync_buckets > 1``; a late
+    call is a no-op for the already-initialized process but still fixes
+    child processes). Deliberately NOT mirrored into ``XLA_FLAGS``:
+    CPU/GPU jaxlib builds hard-fail on unknown ``--xla_tpu_*`` flags
+    there, which would poison every subprocess forked after a bucketed
+    trainer is built."""
+    import os
+    env = os.environ if env is None else env
+    cur = env.get("LIBTPU_INIT_ARGS", "")
+    missing = [f for f in LATENCY_HIDING_FLAGS
+               if f.split("=")[0] not in cur]
+    if not missing:
+        return False
+    env["LIBTPU_INIT_ARGS"] = (cur + " " + " ".join(missing)).strip()
+    return True
+
+
+def partition_reverse_buckets(items, k: int):
+    """Partition ``items`` ([(key, nbytes)] in FORWARD layer order) into
+    at most ``k`` byte-balanced buckets in REVERSE order: bucket 0 holds
+    the last layers — whose grads materialize first during backward — so
+    its exchange is issued earliest and gets the longest compute shadow.
+    Returns a list of non-empty key lists."""
+    items = list(items)
+    k = max(1, min(int(k), len(items)))
+    total = float(sum(b for _, b in items)) or 1.0
+    target = total / k
+    buckets, cur, acc = [], [], 0.0
+    rev = list(reversed(items))
+    for i, (key, b) in enumerate(rev):
+        cur.append(key)
+        acc += float(b)
+        left = len(rev) - i - 1
+        need = k - 1 - len(buckets)  # buckets still owed after this one
+        if need > 0 and (left == need or (acc >= target and left >= need)):
+            buckets.append(cur)
+            cur, acc = [], 0.0
+    if cur:
+        buckets.append(cur)
+    return buckets
+
 
 def _spec_has_axis(spec, axis: str) -> bool:
     return any(ax == axis or (isinstance(ax, tuple) and axis in ax)
@@ -74,6 +134,7 @@ class ParallelTrainer:
                  grad_sync: Optional[str] = None,
                  grad_sync_block: Optional[int] = None,
                  grad_sync_bucket_bytes: int = 4 << 20,
+                 grad_sync_buckets: int = 1,
                  grad_sync_dcn_only: Optional[bool] = None,
                  nan_guard: bool = True,
                  scaler=None):
@@ -122,6 +183,16 @@ class ParallelTrainer:
         self.grad_sync = grad_sync
         self.grad_sync_block = grad_sync_block
         self.grad_sync_bucket_bytes = grad_sync_bucket_bytes
+        # K reverse-layer-order exchange buckets: K=1 is the monolithic
+        # post-backward exchange; K>=2 splits the plain trainable leaves
+        # into byte-balanced buckets and issues each bucket's exchange
+        # INSIDE the backward (per-bucket custom_vjp hooks in grads_fn)
+        # as soon as its layers' grads exist, so collective time hides
+        # under the remaining backward compute.
+        self.grad_sync_buckets = max(
+            1, int(getattr(model, "grad_sync_buckets", grad_sync_buckets)))
+        if self.grad_sync_buckets > 1:
+            enable_latency_hiding_scheduler()
         self.grad_sync_dcn_only = grad_sync_dcn_only
         self.fp16_allreduce = fp16_allreduce or grad_sync == "bf16"
         # GradientMerge (reference: fleet/meta_optimizers
@@ -236,13 +307,38 @@ class ParallelTrainer:
             self._any_quantized = self.grad_sync in QUANTIZED_POLICIES
         self.comm_err_specs = {}
         comm_err = {}
+        # the sharded-grad (ZeRO-2/3) leaves exchange per-tensor through
+        # compressed_psum_scatter over the "sharding" axis; when that
+        # axis's policy quantizes they carry their own per-rank residual
+        # (full-tensor shape: each rank's quantization error spans the
+        # whole gradient, not just its scattered chunk)
+        rs_pol = (self._axis_policy.get("sharding", "fp32")
+                  if isinstance(self._axis_policy, dict)
+                  else self._axis_policy)
+        rs_quant = rs_pol in QUANTIZED_POLICIES
+        ppm = self.model if isinstance(self.model, PipelineParallel) \
+            else getattr(self.model, "_layers", None)
+        is_1f1b = (isinstance(ppm, PipelineParallel)
+                   and getattr(ppm, "schedule", "gpipe") == "1f1b")
         if self._any_quantized:
             R = 1
             for ax in self.reduce_axes:
                 R *= self.mesh.shape.get(ax, 1)
             for k, v in params.items():
-                if not self.trainable[k] or k in self.zero2_dims \
-                        or k in self.zero3_dims:
+                if not self.trainable[k]:
+                    continue
+                if k in self.zero2_dims or k in self.zero3_dims:
+                    # zero-3 grads only pass through the explicit
+                    # (possibly quantized) reduce-scatter on the 1F1B
+                    # manual-grad path; the AD path's gather transpose is
+                    # a lossless psum_scatter and needs no residual
+                    if not rs_quant or (k in self.zero3_dims
+                                        and not is_1f1b):
+                        continue
+                    spec = P(self.reduce_axes)
+                    self.comm_err_specs[k] = spec
+                    comm_err[k] = put(
+                        jnp.zeros((R,) + jnp.shape(v), jnp.float32), spec)
                     continue
                 # trailing dims follow the param's own sharding: a TP- or
                 # pipe-sharded param's residual differs per shard, so it
@@ -349,13 +445,22 @@ class ParallelTrainer:
         if rs_policy not in QUANTIZED_POLICIES:
             rs_policy = None
 
-        def _reduce_scatter(g, d):
+        def _reduce_scatter(g, d, res=None):
+            """Mean reduce-scatter of one sharded-grad leaf. ``res`` opts
+            into error feedback (quantized rs_policy only): returns
+            ``(mean_shard, new_residual)`` — the residual stays in the
+            un-divided SUM domain, matching what the quantizer sees."""
             if rs_policy is not None:
-                return compressed_psum_scatter(
+                out = compressed_psum_scatter(
                     g, "sharding", scatter_dim=d, policy=rs_policy,
-                    block=self.grad_sync_block) / n_shard
-            return lax.psum_scatter(g, "sharding", scatter_dimension=d,
-                                    tiled=True) / n_shard
+                    block=self.grad_sync_block, residual=res)
+                if res is not None:
+                    out, res = out
+                    return out / n_shard, res
+                return out / n_shard
+            out = lax.psum_scatter(g, "sharding", scatter_dimension=d,
+                                   tiled=True) / n_shard
+            return out if res is None else (out, res)
         pipe_n = mesh.shape.get("pipe", 1)
         # params NOT sharded over the pipe axis (embedding/norm/head under
         # PP, i.e. everything outside the _StackedStage bodies) are
@@ -391,6 +496,33 @@ class ParallelTrainer:
         # off the guard's finite flag.
         use_amp = self.scaler is not None
 
+        # Backward-overlapped exchange buckets: the plain trainable
+        # leaves (the flat-exchange set) in named_parameters — i.e.
+        # forward/layer — order, split into byte-balanced REVERSE-order
+        # buckets. Bucket 0 holds the last layers, whose grads
+        # materialize first in the backward, so its exchange is issued
+        # earliest and hides under the longest remaining compute. Only
+        # the AD path buckets: 1F1B computes grads manually (no backward
+        # to hook into), and a single leaf has nothing to split.
+        plain_keys = [k for k in self.param_specs
+                      if self.trainable[k] and k not in zero2_dims
+                      and k not in zero3_dims]
+        use_buckets = (self.grad_sync_buckets > 1 and pp_grads is None
+                       and bool(sync_axes) and len(plain_keys) >= 2)
+        bucket_keys = []
+        if use_buckets:
+            bucket_keys = partition_reverse_buckets(
+                [(k, self.state["params"][k].nbytes) for k in plain_keys],
+                self.grad_sync_buckets)
+            use_buckets = len(bucket_keys) >= 2
+        self.grad_sync_bucket_keys = ([list(b) for b in bucket_keys]
+                                      if use_buckets
+                                      else ([list(plain_keys)]
+                                            if plain_keys else []))
+        self._use_buckets = use_buckets
+        bucketed = (frozenset(k for b in bucket_keys for k in b)
+                    if use_buckets else frozenset())
+
         def grads_fn(params, buffers, comm_err, scale, key, inputs, labels):
             tparams = {k: v for k, v in params.items() if self.trainable[k]}
             frozen = {k: v for k, v in params.items() if not self.trainable[k]}
@@ -412,7 +544,50 @@ class ParallelTrainer:
                     if mesh.shape.get(ax, 1) > 1:
                         loss = lax.pmean(loss, ax)
             else:
-                def lf(tp):
+                # Per-bucket exchange hook: identity on the params in the
+                # forward; the backward performs the bucket's whole DP
+                # exchange (AMP unscale, pipe psum, compressed flat mean)
+                # ON the cotangents at the exact point in the backward
+                # where the bucket's grads materialize — XLA sees the
+                # collective mid-backward and the latency-hiding
+                # scheduler can run it under the remaining compute. The
+                # bucket's NEW error-feedback residual leaves the
+                # backward as the cotangent of the residual input
+                # (value_and_grad argnums=(0, 1) below).
+                def _exchange_hook():
+                    @jax.custom_vjp
+                    def hook(sub, res):
+                        return sub
+
+                    def h_fwd(sub, res):
+                        return sub, res
+
+                    def h_bwd(res, g):
+                        g = dict(g)
+                        if use_amp:
+                            inv = 1.0 / scale
+                            g = {k: v * inv.astype(v.dtype)
+                                 for k, v in g.items()}
+                        for k in g:
+                            if k in pipe_psum_keys:
+                                g[k] = lax.psum(g[k], "pipe")
+                        mean, new_res = compressed_tree_mean(
+                            g, sync_axes, policy=self._axis_policy,
+                            block=self.grad_sync_block,
+                            bucket_bytes=self.grad_sync_bucket_bytes,
+                            residuals=(res if res else None))
+                        return mean, (new_res if res else {})
+
+                    hook.defvjp(h_fwd, h_bwd)
+                    return hook
+
+                hook = _exchange_hook() if use_buckets else None
+
+                def lf(tp, res_in):
+                    tp = dict(tp)
+                    if use_buckets:
+                        for keys, r in zip(bucket_keys, res_in):
+                            tp.update(hook({k: tp[k] for k in keys}, r))
                     merged = dict(frozen)
                     merged.update(tp)
                     # ZeRO-3 storage shards -> full params for this step's
@@ -432,11 +607,20 @@ class ParallelTrainer:
                         loss = loss * scale.astype(loss.dtype)
                     return loss
 
-                loss, grads = jax.value_and_grad(lf)(tparams)
+                if use_buckets:
+                    res_in = tuple(
+                        {k: comm_err[k][0] for k in keys if k in comm_err}
+                        for keys in bucket_keys)
+                    loss, (grads, gres) = jax.value_and_grad(
+                        lf, argnums=(0, 1))(tparams, res_in)
+                else:
+                    loss, grads = jax.value_and_grad(lf)(tparams, ())
                 if use_amp:
                     inv = 1.0 / scale
                     loss = loss * inv.astype(loss.dtype)
-                    grads = {k: g * inv.astype(g.dtype)
+                    # bucketed leaves were unscaled inside their hook
+                    grads = {k: (g if k in bucketed
+                                 else g * inv.astype(g.dtype))
                              for k, g in grads.items()}
             # DP grad averaging over the data axes; 'model'/'pipe' grads
             # are handled by shard_map transposition of the collectives.
@@ -446,7 +630,9 @@ class ParallelTrainer:
             # residual — otherwise the pipe-replicated comm_err state
             # would silently diverge across stages.
             for k in pipe_psum_keys:
-                grads[k] = lax.psum(grads[k], "pipe")
+                if k not in bucketed:
+                    grads[k] = lax.psum(grads[k], "pipe")
+            new_comm_err = dict(comm_err)
 
             # ZeRO-2/3 leaves keep per-tensor handling: they LEAVE the
             # exchange sharded over "sharding" (reduce-scatter), which the
@@ -465,8 +651,16 @@ class ParallelTrainer:
                     # for the mean, pmean over the remaining data axes
                     if pp_grads is not None:
                         # manual grads are wrt the GATHERED param: explicit
-                        # reduce-scatter (mean) back onto the storage shard
-                        grads[k] = _reduce_scatter(grads[k], zero3_dims[k])
+                        # reduce-scatter (mean) back onto the storage
+                        # shard, threading the leaf's EF residual when the
+                        # sharding hop quantizes
+                        if k in comm_err:
+                            grads[k], r = _reduce_scatter(
+                                grads[k], zero3_dims[k], comm_err[k][0])
+                            new_comm_err[k] = r[None]
+                        else:
+                            grads[k] = _reduce_scatter(
+                                grads[k], zero3_dims[k])
                     else:
                         grads[k] = grads[k] / n_shard
                     for ax in ("data", "sep"):
@@ -474,30 +668,45 @@ class ParallelTrainer:
                             grads[k] = _pmean(grads[k], ax)
                 elif k in zero2_dims:
                     # reduce-scatter (mean) over sharding; pmean over data
-                    grads[k] = _reduce_scatter(grads[k], zero2_dims[k])
+                    if k in comm_err:
+                        grads[k], r = _reduce_scatter(
+                            grads[k], zero2_dims[k], comm_err[k][0])
+                        new_comm_err[k] = r[None]
+                    else:
+                        grads[k] = _reduce_scatter(grads[k], zero2_dims[k])
                     for ax in ("data", "sep"):
                         if ax in reduce_axes and mesh.shape.get(ax, 1) > 1:
                             grads[k] = _pmean(grads[k], ax)
 
-            # plain leaves: ONE bucketed flat exchange (compressed.py) over
-            # the data axes instead of one pmean per tensor — the Reducer
-            # bucketing, plus bf16/int8 wire compression per self.grad_sync.
-            # comm_err is the int8 error-feedback state, replica-major
-            # outside the step; its local view here is (1, *shape).
+            if use_buckets:
+                # the per-bucket exchanges already ran inside the
+                # backward; fold each bucket's new residual (the
+                # cotangent of its residual input) back into the
+                # replica-major comm_err state
+                for r in gres:
+                    for k, v in r.items():
+                        new_comm_err[k] = v[None]
+                return loss, grads, new_comm_err
+
+            # plain leaves, monolithic (K=1) mode: ONE bucketed flat
+            # exchange (compressed.py) over the data axes instead of one
+            # pmean per tensor — the Reducer bucketing, plus bf16/int8
+            # wire compression per self.grad_sync. comm_err is the int8
+            # error-feedback state, replica-major outside the step; its
+            # local view here is (1, *shape).
             plain = {k: grads[k] for k in grads
                      if k not in zero3_dims and k not in zero2_dims}
-            new_comm_err = comm_err
             if plain and sync_axes:
-                res = ({k: comm_err[k][0] for k in plain}
-                       if comm_err else None)
+                res = ({k: comm_err[k][0] for k in plain
+                        if k in comm_err} or None)
                 mean, res = compressed_tree_mean(
                     plain, sync_axes, policy=self._axis_policy,
                     block=self.grad_sync_block,
                     bucket_bytes=self.grad_sync_bucket_bytes,
                     residuals=res)
                 grads.update(mean)
-                if comm_err:
-                    new_comm_err = {k: res[k][None] for k in res}
+                if res:
+                    new_comm_err.update({k: res[k][None] for k in res})
             return loss, grads, new_comm_err
 
         def _grad_spec(k):
@@ -645,25 +854,33 @@ class ParallelTrainer:
         plain_params = {k: v for k, v in self.state["params"].items()
                         if self.trainable[k] and k not in zero2_dims
                         and k not in zero3_dims}
-        self._wire_parts = []      # [(policy, link, bytes_per_step)]
+        # [(policy, link, bucket_label, bytes_per_step)] — bucket "0" is
+        # the whole exchange in monolithic mode, else the reverse-order
+        # bucket index (bucket 0 = last layers, exchanged first)
+        self._wire_parts = []
         self._wire_bytes_per_step = 0.0
         self._wire_fp32_per_step = 0.0
         if plain_params and n_sync > 1:
             from .compressed import tree_wire_bytes
             links = axis_links(mesh)
-            for axes_g, pol in normalize_axis_policies(sync_axes,
-                                                       self._axis_policy):
-                n_g = 1
-                for ax in axes_g:
-                    n_g *= mesh.shape.get(ax, 1)
-                if n_g <= 1:
+            for bi, keys in enumerate(self.grad_sync_bucket_keys):
+                bparams = {k: plain_params[k] for k in keys
+                           if k in plain_params}
+                if not bparams:
                     continue
-                link = ("dcn" if any(links.get(ax) == "dcn"
-                                     for ax in axes_g) else "ici")
-                b = K * tree_wire_bytes(plain_params, n_g, pol,
-                                        block=self.grad_sync_block)
-                self._wire_parts.append((pol, link, b))
-            self._wire_bytes_per_step = sum(p[2] for p in self._wire_parts)
+                for axes_g, pol in normalize_axis_policies(
+                        sync_axes, self._axis_policy):
+                    n_g = 1
+                    for ax in axes_g:
+                        n_g *= mesh.shape.get(ax, 1)
+                    if n_g <= 1:
+                        continue
+                    link = ("dcn" if any(links.get(ax) == "dcn"
+                                         for ax in axes_g) else "ici")
+                    b = K * tree_wire_bytes(bparams, n_g, pol,
+                                            block=self.grad_sync_block)
+                    self._wire_parts.append((pol, link, str(bi), b))
+            self._wire_bytes_per_step = sum(p[3] for p in self._wire_parts)
             self._wire_fp32_per_step = K * tree_wire_bytes(
                 plain_params, n_sync, "fp32", block=self.grad_sync_block)
 
@@ -742,9 +959,14 @@ class ParallelTrainer:
                 for part in (self.state["params"], self.state["opt"],
                              self.state["comm_err"], self.state["guard"])
                 for v in jax.tree_util.tree_leaves(part))
-            return {"flops": _cost.total_flops(closed),
-                    "peak_live_bytes": _cost.peak_live_bytes(closed),
-                    "donated_bytes": float(donated)}
+            out = {"flops": _cost.total_flops(closed),
+                   "peak_live_bytes": _cost.peak_live_bytes(closed),
+                   "donated_bytes": float(donated)}
+            try:
+                out["overlap"] = _cost.overlap_summary(closed, self.mesh)
+            except Exception:
+                out["overlap"] = None
+            return out
         except Exception:
             return None
 
@@ -768,7 +990,28 @@ class ParallelTrainer:
                     time.perf_counter() - t0)
         if not analyze:
             return step
+        import dataclasses
+
         from .. import analysis
+        # declare the exchange mode to the overlap rule: 0 means the
+        # caller didn't say, so inject the trainer's own effective bucket
+        # count (an explicit non-zero value in the config wins)
+        cfg = config or analysis.AnalysisConfig()
+        if getattr(cfg, "grad_sync_buckets", 0) == 0:
+            eff = (len(self.grad_sync_bucket_keys)
+                   if getattr(self, "_use_buckets", False) else 1)
+            cfg = dataclasses.replace(cfg, grad_sync_buckets=eff)
+        config = cfg
+        closed, donated = self._staged_jaxpr(step, inputs, labels, lr)
+        report = analysis.analyze_jaxpr(closed, mesh=self.mesh,
+                                        donated=donated, config=config)
+        return step, report
+
+    def _staged_jaxpr(self, step, inputs, labels, lr=None):
+        """Trace the staged ``step`` to a ClosedJaxpr with this trainer's
+        live state as abstract operands. Returns ``(closed, donated)``
+        where ``donated`` is the flat invar index set of jit's
+        ``donate_argnums``."""
         from ..framework.random import get_rng_key
         lr = self.optimizer.get_lr() if lr is None else lr
         args = (self.state["params"], self.state["buffers"],
@@ -782,9 +1025,14 @@ class ParallelTrainer:
             if i in (0, 2, 3, 4):
                 donated.update(range(off, off + n))
             off += n
-        report = analysis.analyze_jaxpr(closed, mesh=self.mesh,
-                                        donated=donated, config=config)
-        return step, report
+        return closed, donated
+
+    def staged_jaxpr(self, inputs, labels, lr=None):
+        """Public tracing hook for tools: stage the train step for this
+        batch shape and return its ClosedJaxpr (nothing executed)."""
+        inputs, labels, step = self._stage(inputs, labels, place=False)
+        closed, _ = self._staged_jaxpr(step, inputs, labels, lr)
+        return closed
 
     # -- run ----------------------------------------------------------------
     def train_step(self, inputs, labels, lr: Optional[float] = None,
@@ -899,15 +1147,22 @@ class ParallelTrainer:
                 "grad_sync_bytes_total",
                 "logical wire bytes per rank of the bucketed grad "
                 "exchange, per exchange group")
-            for pol, link, b in self._wire_parts:
+            for pol, link, bucket, b in self._wire_parts:
                 if b:
-                    wire.inc(b, policy=pol, link=link)
+                    wire.inc(b, policy=pol, link=link, bucket=bucket)
             if self._wire_bytes_per_step > 0:
                 _telemetry.gauge(
                     "grad_sync_compression_x",
                     "fp32 wire bytes / policy wire bytes").set(
                         self._wire_fp32_per_step /
                         self._wire_bytes_per_step)
+        if cost and cost.get("overlap") and \
+                cost["overlap"].get("overlap_efficiency") is not None:
+            _telemetry.gauge(
+                "grad_sync_overlap_efficiency",
+                "fraction of the staged step's collective time the "
+                "overlap model predicts is hidden under compute").set(
+                    cost["overlap"]["overlap_efficiency"])
         res = None
         if self.state["comm_err"]:
             from .compressed import residual_norm

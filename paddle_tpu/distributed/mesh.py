@@ -191,6 +191,33 @@ def axis_link(axis: str, mesh: Optional[Mesh] = None) -> str:
     return axis_links(mesh).get(axis, "ici")
 
 
+# ---------------------------------------------------------------------------
+# link bandwidth constants (the overlap model's wire-time denominators)
+# ---------------------------------------------------------------------------
+
+# Per-chip effective bandwidth in bytes/sec for each link class. The ICI
+# figure is one v5e-class torus link pair (~90 GB/s); DCN is a 50 Gb/s
+# per-host share (~6.25 GB/s). These feed analysis/cost.py's collective
+# time estimates (time = ring wire bytes / bandwidth) — they rank
+# schedules and size overlap windows, they are not a profiler. Override
+# per deployment with PADDLE_TPU_ICI_BPS / PADDLE_TPU_DCN_BPS.
+LINK_BANDWIDTHS: Dict[str, float] = {"ici": 9.0e10, "dcn": 6.25e9}
+
+_LINK_BW_ENV = {"ici": "PADDLE_TPU_ICI_BPS", "dcn": "PADDLE_TPU_DCN_BPS"}
+
+
+def link_bandwidth(link: str) -> float:
+    """Bytes/sec of one link class, honoring the env override."""
+    import os
+    env = os.environ.get(_LINK_BW_ENV.get(link, ""), "")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    return LINK_BANDWIDTHS.get(link, LINK_BANDWIDTHS["ici"])
+
+
 class CommunicateTopology:
     """reference: fleet/base/topology.py:36 — coordinate math over the mesh."""
 

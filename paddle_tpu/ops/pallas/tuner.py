@@ -134,8 +134,13 @@ class TuningDB:
          "kernel": "flash_attention", "device": "tpu-v5e",
          "dtype": "bfloat16", "dims": {"d": 64, "sq": 1024, "sk": 1024},
          "mean_us": 123.4 | None,        # None = correctness-only sweep
-         "validated": "interpret" | "device",
+         "validated": "interpret" | "device" | "seed",
          "swept": 6}                     # candidates that passed numerics
+
+    ``validated: "seed"`` marks a shipped config-only entry for a shape
+    too large to interpret-validate on CPU (the TPU bench buckets): the
+    blocks are legal for the shape but unmeasured — a device tuner run
+    (``--suite bench``) refreshes them in place.
     """
 
     def __init__(self, entries: Optional[Dict[str, dict]] = None,

@@ -37,10 +37,15 @@ mfu                            gauge      analysis.cost FLOPs / step time /
 peak_live_bytes                gauge      analysis.cost over the staged step
 donated_bytes                  gauge      donated state (params+opt+residual)
 grad_sync_bytes_total          counter    logical wire bytes
-                                          {policy=..., link=ici|dcn}
+                                          {policy=..., link=ici|dcn,
+                                          bucket=0..K-1}
 grad_sync_compression_x        gauge      fp32 bytes / policy bytes
 grad_sync_residual_norm        gauge      int8/int4 error-feedback
                                           residual L2
+grad_sync_overlap_efficiency   gauge      analysis.cost.overlap_summary
+                                          over the staged step (fraction
+                                          of collective time hidden
+                                          under backward compute)
 collective_calls_total         counter    collective.py, trace time {op=...}
 dataloader_fetch_seconds       histogram  io.DataLoader batch fetch
 checkpoint_save_seconds        histogram  distributed.checkpoint

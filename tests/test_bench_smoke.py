@@ -107,6 +107,34 @@ def test_bench_collectives_smoke_telemetry():
     assert wb["int8"] > 0
     assert wb["fp32"] > wb["int8"]
     assert extra["telemetry"]["prometheus_bytes"] > 0
+    # the K=2 overlap model smoke piggybacks on the exchange suite
+    ov = extra["overlap_smoke"]
+    assert ov["overlap_efficiency"] > 0
+    assert ov["n_collectives"] >= 2
+    assert len(ov["buckets"]) >= 2
+
+
+def test_bench_collectives_overlap_suite_smoke():
+    """tools/bench_collectives.py --suite overlap --smoke --json: the
+    overlap-efficiency metric contract — staged K=1 vs K=buckets on the
+    tiny GPT, bucketed strictly better, full per-K summaries under
+    --json."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_collectives.py"),
+         "--suite", "overlap", "--smoke", "--json", "--buckets", "4"],
+        capture_output=True, text=True, timeout=600, env=_env())
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    res = json.loads(lines[-1])
+    assert res["metric"] == "grad_sync_overlap_efficiency"
+    assert res["value"] is not None and res["value"] > 0
+    assert res["vs_baseline"] is None or res["value"] > res["vs_baseline"]
+    extra = res["extra"]
+    assert extra["k"] == 4
+    assert extra["k4"]["n_collectives"] >= 4
+    assert len(extra["k4"]["buckets"]) >= 2
+    assert extra["k1"]["buckets"] == [sum(extra["k4"]["buckets"])]
+    assert extra["hidden_wire_seconds"] > 0
 
 
 @pytest.mark.multihost(timeout=420)

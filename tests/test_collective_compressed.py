@@ -887,7 +887,7 @@ class TestDCNGating:
         assert np.isfinite(l1) and l1 < l0
         # wire accounting splits per link: the dcn part is int4
         assert any(pol == "int4" and link == "dcn"
-                   for pol, link, _ in tr._wire_parts)
+                   for pol, link, _, _ in tr._wire_parts)
 
     def test_engine_all_ici_mesh_disables_compression(self):
         """On an all-ICI mesh (inferred: single process) dcn_only turns

@@ -228,7 +228,7 @@ def test_grad_sync_wire_metrics_int8():
         reg = tel.registry
         wire = reg.get("grad_sync_bytes_total")
         assert wire is not None and \
-            wire.value(policy="int8", link="ici") > 0
+            wire.value(policy="int8", link="ici", bucket="0") > 0
         # int8 wire bytes are a fraction of fp32's
         assert reg.get("grad_sync_compression_x").value() > 1.0
         # error-feedback residual exists and was normed
@@ -362,7 +362,8 @@ def test_scope_e2e_gpt_cpu_mesh(tmp_path):
     assert reg.get("mfu").value() > 0
     assert reg.get("tokens_per_sec").value() > 0
     assert reg.get("grad_sync_bytes_total").value(policy="int8",
-                                                  link="ici") > 0
+                                                  link="ici",
+                                                  bucket="0") > 0
     assert reg.get("peak_live_bytes").value() > 0
 
     # -- prometheus text ----------------------------------------------------

@@ -20,12 +20,19 @@ inner ("ici") axis — the DCN-gating deployment shape. Latency is
 wall-clock on whatever backend runs — on forced host devices it measures
 the code path, not ICI; on TPUs it is the real exchange time.
 
+The ``overlap`` suite instead stages the bench-GPT train step through
+``ParallelTrainer`` at bucket counts K=1 and K=``--buckets`` on the data
+mesh and reports the analysis overlap model's ``overlap_efficiency`` —
+the fraction of collective wire time hidden under backward/optimizer
+compute. Bucketed (K>=2) must strictly beat monolithic.
+
 Usage:
     python tools/bench_collectives.py                     # defaults
     python tools/bench_collectives.py --numel 4194304 --devices 4 \
         --block 256 --int4-block 64 --bucket-mb 4 --iters 20
     python tools/bench_collectives.py --smoke   # tiny shapes + telemetry
                                                 # self-check (CI)
+    python tools/bench_collectives.py --suite overlap --json
 """
 from __future__ import annotations
 
@@ -34,8 +41,85 @@ import json
 import time
 
 
+def _overlap_trainer(buckets: int, smoke: bool, devices: int, policy: str):
+    """The lint_program/bench GPT configuration on a data mesh with the
+    given grad-sync bucket count."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from _mesh_setup import data_mesh
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.engine import ParallelTrainer
+    from paddle_tpu.text.models import GPTForPretraining
+
+    if smoke:
+        vocab, h, layers, heads, seq, batch = 256, 64, 1, 2, 32, 4
+    else:  # the bench.py CPU gpt_base shape
+        vocab, h, layers, heads, seq, batch = 1024, 128, 2, 4, 128, 4
+    paddle.seed(0)
+    model = GPTForPretraining(
+        tensor_parallel=False, vocab_size=vocab, hidden_size=h,
+        num_layers=layers, num_heads=heads, max_position_embeddings=seq,
+        attn_dropout=0.0, hidden_dropout=0.0)
+    model.bfloat16()
+    opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters())
+    trainer = ParallelTrainer(
+        model, opt,
+        lambda logits, lbl: nn.functional.cross_entropy(logits, lbl),
+        mesh=data_mesh(devices), grad_sync=policy,
+        grad_sync_buckets=buckets)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (batch, seq)).astype("int32")
+    labels = rng.randint(0, vocab, (batch, seq)).astype("int32")
+    return trainer, ids, labels
+
+
+def overlap_case(buckets: int, smoke: bool, devices: int,
+                 policy: str) -> dict:
+    """Stage one train step and run the overlap model over its jaxpr."""
+    from paddle_tpu.analysis import cost
+
+    trainer, ids, labels = _overlap_trainer(buckets, smoke, devices, policy)
+    closed = trainer.staged_jaxpr(ids, labels)
+    out = cost.overlap_summary(closed, trainer.mesh)
+    out["buckets"] = [len(b) for b in trainer.grad_sync_bucket_keys]
+    return out
+
+
+def run_overlap(args) -> None:
+    k = max(2, args.buckets)
+    base = overlap_case(1, args.smoke, args.devices, args.policy)
+    bucketed = overlap_case(k, args.smoke, args.devices, args.policy)
+    eff1 = base["overlap_efficiency"]
+    effk = bucketed["overlap_efficiency"]
+    if args.smoke:
+        assert effk is not None and effk > 0, bucketed
+        assert eff1 is None or effk > eff1, (base, bucketed)
+    extra = {"k": k, "devices": args.devices, "policy": args.policy,
+             "smoke": bool(args.smoke),
+             "overlap_efficiency_k1": eff1,
+             "hidden_wire_seconds": (
+                 None if effk is None
+                 else effk * bucketed["collective_time"])}
+    if args.json:
+        extra["k1"] = base
+        extra[f"k{k}"] = bucketed
+    print(json.dumps({
+        "metric": "grad_sync_overlap_efficiency",
+        "value": effk,
+        "unit": "frac",
+        "vs_baseline": eff1,
+        "extra": extra,
+    }))
+
+
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--suite", choices=("exchange", "overlap"),
+                    default="exchange",
+                    help="exchange: wire bytes/latency per policy; "
+                         "overlap: staged-step overlap_efficiency at "
+                         "K=1 vs K=--buckets")
     ap.add_argument("--numel", type=int, default=1 << 22,
                     help="total gradient elements (fp32)")
     ap.add_argument("--devices", type=int, default=4,
@@ -47,11 +131,19 @@ def main():
                          "are coarse)")
     ap.add_argument("--bucket-mb", type=int, default=4,
                     help="flat bucket size in MiB")
+    ap.add_argument("--buckets", type=int, default=4,
+                    help="overlap suite: grad-sync bucket count K")
+    ap.add_argument("--policy", default="fp32",
+                    help="overlap suite: grad_sync policy")
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--json", action="store_true",
+                    help="overlap suite: include the full per-K overlap "
+                         "summaries in the JSON line")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes + telemetry self-check; asserts the "
-                         "registry saw the per-policy wire-byte counters")
+                         "registry saw the per-policy wire-byte counters "
+                         "and (overlap) that K>=2 hides wire time")
     args = ap.parse_args()
     if args.smoke:
         args.numel, args.devices, args.block = 4096, 2, 64
@@ -62,6 +154,8 @@ def main():
                              force_host_devices)
     force_host_devices(args.devices)
     ensure_repo_on_path()
+    if args.suite == "overlap":
+        return run_overlap(args)
 
     import math
 
@@ -194,6 +288,17 @@ def main():
         assert wb["int8"] > 0 and wb["fp32"] > wb["int8"], wb
         assert wb["int4"] > 0 and wb["int8"] > wb["int4"], wb
         assert ratio4 >= 7.0, f"int4 must beat fp32 by >=7x, got {ratio4}"
+        # the bucketed exchange must hide wire time: K=2 on the CPU mesh
+        # shows a strictly positive overlap_efficiency in the schedule
+        # model of the staged train step
+        ov = overlap_case(2, smoke=True, devices=args.devices,
+                          policy="fp32")
+        assert ov["overlap_efficiency"] is not None \
+            and ov["overlap_efficiency"] > 0, ov
+        extra["overlap_smoke"] = {
+            "overlap_efficiency": ov["overlap_efficiency"],
+            "n_collectives": ov["n_collectives"],
+            "buckets": ov["buckets"]}
     print(json.dumps({
         "metric": "int8_vs_fp32_bytes_x",
         "value": round(ratio, 3),

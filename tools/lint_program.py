@@ -89,14 +89,43 @@ def _build_bert(smoke: bool):
 BUILDERS = {"gpt": _build_gpt, "bert": _build_bert}
 
 
-def lint_model(name: str, smoke: bool, top: int):
+def lint_model(name: str, smoke: bool, top: int, dump_schedule: bool = False):
     from paddle_tpu.analysis import AnalysisConfig
 
     data_mesh(1)
     trainer, inputs, labels = BUILDERS[name](smoke)
     _, report = trainer.compile(inputs, labels, analyze=True,
                                 config=AnalysisConfig(top_k=top))
-    return report
+    schedule = None
+    if dump_schedule:
+        from paddle_tpu.analysis import cost
+        closed = trainer.staged_jaxpr(inputs, labels)
+        schedule = cost.overlap_summary(closed, trainer.mesh,
+                                        include_timeline=True)
+    return report, schedule
+
+
+def _schedule_text(name: str, sched: dict) -> str:
+    """Render the overlap timeline as a fixed-width per-equation table."""
+    lines = [f"-- {name} schedule: "
+             f"makespan {sched['makespan'] * 1e6:.4g}us, "
+             f"compute {sched['compute_time'] * 1e6:.4g}us, "
+             f"collective {sched['collective_time'] * 1e6:.4g}us, "
+             f"stalled {sched['stalled_time'] * 1e6:.4g}us, "
+             "overlap_efficiency "
+             + (f"{sched['overlap_efficiency']:.3f}"
+                if sched["overlap_efficiency"] is not None else "n/a"),
+             f"{'start_us':>10} {'end_us':>10} {'kind':<10} "
+             f"{'primitive':<22} {'cost':>12}  path"]
+    for e in sched.get("timeline", ()):
+        cost = (f"{e['bytes']:.0f}B/{e['link']}" if e["kind"] == "collective"
+                else f"{e['flops']:.0f}F")
+        stall = (f" (+{e['stall'] * 1e6:.3g}us stall)"
+                 if e.get("stall") else "")
+        lines.append(f"{e['start'] * 1e6:>10.3f} {e['end'] * 1e6:>10.3f} "
+                     f"{e['kind']:<10} {e['primitive']:<22} {cost:>12}  "
+                     f"{e['path']}{stall}")
+    return "\n".join(lines)
 
 
 def main(argv=None) -> int:
@@ -111,22 +140,33 @@ def main(argv=None) -> int:
                     help="tiny 1-layer configs; the tier-1 CI wrapper")
     ap.add_argument("--devices", type=int, default=1,
                     help="forced host device count when no accelerator")
+    ap.add_argument("--dump-schedule", action="store_true",
+                    help="print the overlap model's per-equation "
+                         "compute/collective timeline (with --json: a "
+                         "'schedule' object per model)")
     args = ap.parse_args(argv)
 
     force_host_devices(args.devices)
     ensure_repo_on_path()
 
     models = ("gpt", "bert") if args.model == "all" else (args.model,)
-    reports = {}
+    reports, schedules = {}, {}
     for name in models:
-        reports[name] = lint_model(name, args.smoke, args.top)
+        reports[name], schedules[name] = lint_model(
+            name, args.smoke, args.top, dump_schedule=args.dump_schedule)
 
     if args.json:
-        print(json.dumps({n: r.to_dict() for n, r in reports.items()}))
+        out = {n: r.to_dict() for n, r in reports.items()}
+        if args.dump_schedule:
+            for n in out:
+                out[n]["schedule"] = schedules[n]
+        print(json.dumps(out))
     else:
         for name, rep in reports.items():
             print(f"== {name} ==")
             print(rep.to_text())
+            if args.dump_schedule and schedules[name] is not None:
+                print(_schedule_text(name, schedules[name]))
     ok = all(r.ok for r in reports.values())
     if not ok:
         print("lint_program: error-severity findings present",
