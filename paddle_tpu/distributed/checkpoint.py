@@ -156,12 +156,19 @@ def _crc_file(path: str, chunk: int = 1 << 20) -> int:
     return c & 0xFFFFFFFF
 
 
-def write_manifest(step_dir: str) -> dict:
+def write_manifest(step_dir: str, arrays: Optional[dict] = None) -> dict:
     """Commit marker: fsync every file under ``step_dir``, then atomically
     write a CRC32/size manifest. The manifest is written LAST (tmp + fsync +
     rename + dir fsync), so its presence proves every byte it attests to
     reached stable storage — a kill -9 at any point leaves either no
-    manifest (step invalid, restore falls back) or a complete one."""
+    manifest (step invalid, restore falls back) or a complete one.
+
+    ``arrays`` (leaf-path → content digest, from
+    ``resilience.integrity.tree_digests``) is recorded under an ``"arrays"``
+    key: file CRCs attest the *bytes on disk*, array digests attest the
+    *decoded values* — deep verify re-hashes the restored pytree against
+    them, catching corruption the file layer re-encodes (and giving
+    ``replay_step`` its reference digest)."""
     files = {}
     for root, _dirs, names in os.walk(step_dir):
         for n in sorted(names):
@@ -172,6 +179,8 @@ def write_manifest(step_dir: str) -> dict:
             files[os.path.relpath(p, step_dir)] = {
                 "size": os.path.getsize(p), "crc32": _crc_file(p)}
     manifest = {"version": 1, "files": files}
+    if arrays:
+        manifest["arrays"] = dict(arrays)
     tmp = os.path.join(step_dir, MANIFEST_NAME + ".tmp")
     with open(tmp, "w") as f:
         json.dump(manifest, f)
@@ -182,12 +191,16 @@ def write_manifest(step_dir: str) -> dict:
     return manifest
 
 
-def verify_manifest(step_dir: str) -> Optional[bool]:
+def verify_manifest(step_dir: str, level: str = "full") -> Optional[bool]:
     """Three-valued: ``True`` — manifest present and every attested file
-    matches size+CRC; ``False`` — manifest present but unreadable, or a file
-    is missing/corrupt (torn checkpoint); ``None`` — no manifest (a legacy
+    matches; ``False`` — manifest present but unreadable, or a file is
+    missing/corrupt (torn checkpoint); ``None`` — no manifest (a legacy
     checkpoint from before this commit protocol; restore attempts it and
-    relies on orbax's own errors)."""
+    relies on orbax's own errors).
+
+    ``level="size"`` checks existence + recorded byte size only (a stat per
+    file, no reads) — the cheap pre-reject used when scanning many steps;
+    ``level="full"`` also re-CRCs every file."""
     mpath = os.path.join(step_dir, MANIFEST_NAME)
     if not os.path.exists(mpath):
         return None
@@ -199,8 +212,9 @@ def verify_manifest(step_dir: str) -> Optional[bool]:
     for rel, meta in manifest.get("files", {}).items():
         p = os.path.join(step_dir, rel)
         try:
-            if os.path.getsize(p) != meta["size"] or \
-                    _crc_file(p) != meta["crc32"]:
+            if os.path.getsize(p) != meta["size"]:
+                return False
+            if level != "size" and _crc_file(p) != meta["crc32"]:
                 return False
         except OSError:
             return False
@@ -223,7 +237,7 @@ def _corrupt_one_file(step_dir: str):
 
 
 def _stage_save(dest: str, state: Any, nbytes: float,
-                err: BaseException) -> str:
+                err: BaseException, arrays: Optional[dict] = None) -> str:
     """Degraded save path: a plain sync orbax write onto local disk, no
     fault hooks and no retry — if LOCAL disk is failing too there is
     nothing left to degrade to. Manifested like any committed step so
@@ -235,7 +249,7 @@ def _stage_save(dest: str, state: Any, nbytes: float,
     os.makedirs(os.path.dirname(dest), exist_ok=True)
     ocp.Checkpointer(ocp.StandardCheckpointHandler()).save(
         dest, args=ocp.args.StandardSave(state), force=True)
-    write_manifest(dest)
+    write_manifest(dest, arrays=arrays)
     _count_staged(nbytes)
     warnings.warn(
         f"checkpoint save exceeded its retry byte budget ({err}); "
@@ -263,7 +277,8 @@ def save_checkpoint(path: str, state: Any, overwrite: bool = True,
     t0 = time.perf_counter()
 
     def _write():
-        faults.maybe_raise("ckpt_io", msg="injected ckpt_io on save")
+        faults.maybe_raise("ckpt_io", site="save_checkpoint",
+                            msg="injected ckpt_io on save")
         ckptr.save(os.path.abspath(path), args=ocp.args.StandardSave(state),
                    force=overwrite)
 
@@ -327,7 +342,8 @@ class CheckpointManager:
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  save_interval_steps: int = 1, use_async: bool = True,
-                 staging_dir: Optional[str] = None):
+                 staging_dir: Optional[str] = None,
+                 deep_digests: bool = True):
         import orbax.checkpoint as ocp
         self._dir = os.path.abspath(directory)
         self._staging = staging_dir or os.path.join(
@@ -342,7 +358,9 @@ class CheckpointManager:
                 max_to_keep=None,
                 save_interval_steps=save_interval_steps,
                 enable_async_checkpointing=use_async))
+        self._deep_digests = deep_digests
         self._pending: List[int] = []   # written (maybe in flight), no manifest yet
+        self._pending_digests = {}      # step -> tree_digests, until committed
         self._vcache = {}               # step -> verify_manifest result
         self.restore_fallbacks_total = 0   # corrupt steps skipped over
         self.last_restored_step: Optional[int] = None
@@ -380,14 +398,18 @@ class CheckpointManager:
         while self._pending:
             step = self._pending.pop(0)
             sdir = self._step_dir(step)
-            if faults.fires("ckpt_torn", step=step):
+            if faults.fires("ckpt_torn", step=step, site="ckpt_commit"):
                 _corrupt_one_file(sdir)
                 self._vcache.pop(step, None)
+                self._pending_digests.pop(step, None)
                 raise faults.SimulatedCrash(
                     f"simulated kill -9 committing checkpoint step {step}")
             if os.path.isdir(sdir):
-                write_manifest(sdir)
+                write_manifest(sdir,
+                               arrays=self._pending_digests.pop(step, None))
                 self._vcache[step] = True
+            else:
+                self._pending_digests.pop(step, None)
         self._gc()
 
     def _gc(self):
@@ -427,9 +449,16 @@ class CheckpointManager:
             # the stale (possibly torn) attempt so orbax doesn't refuse
             self._mngr.delete(step)
             self._vcache.pop(step, None)
+            self._pending_digests.pop(step, None)
+        arrays = None
+        if self._deep_digests:
+            # content digests are taken from the live state at save time —
+            # the ground truth the payload must still decode to at restore
+            from ..resilience.integrity import tree_digests
+            arrays = tree_digests(state)
 
         def _write():
-            faults.maybe_raise("ckpt_io", step=step,
+            faults.maybe_raise("ckpt_io", step=step, site="manager_save",
                                msg=f"injected ckpt_io at step {step}")
             return self._mngr.save(step, args=ocp.args.StandardSave(state))
 
@@ -445,11 +474,14 @@ class CheckpointManager:
             # Staged steps live OUTSIDE orbax's step tracking (no
             # pending/GC) and are picked up by restore() only when no
             # primary step verifies.
-            _stage_save(self._staged_step_dir(step), state, nbytes, e)
+            _stage_save(self._staged_step_dir(step), state, nbytes, e,
+                        arrays=arrays)
             _record("save", time.perf_counter() - t0, state)
             return True
         if saved:  # interval-skipped saves shouldn't pollute the histogram
             self._pending.append(step)
+            if arrays is not None:
+                self._pending_digests[step] = arrays
             if not self._use_async:
                 self._commit_pending()
             _record("save", time.perf_counter() - t0, state)
@@ -470,7 +502,7 @@ class CheckpointManager:
         # host
         return self._mngr.restore(step, args=ocp.args.StandardRestore())
 
-    def _count_fallbacks(self, n: int):
+    def _count_fallbacks(self, n: int, reason: str = "manifest"):
         if not n:
             return
         self.restore_fallbacks_total += n
@@ -478,10 +510,51 @@ class CheckpointManager:
         if telemetry.enabled():
             telemetry.counter(
                 "ckpt_restore_fallbacks_total",
-                "restores that skipped corrupt/torn checkpoints").inc(n)
+                "restores that skipped corrupt/torn checkpoints").inc(
+                    n, reason=reason)
+
+    def _manifest_arrays(self, step: int) -> Optional[dict]:
+        """The recorded content digests for ``step`` (None when the step
+        predates deep digests or has no readable manifest)."""
+        mpath = os.path.join(self._step_dir(step), MANIFEST_NAME)
+        try:
+            with open(mpath) as f:
+                return json.load(f).get("arrays") or None
+        except (OSError, ValueError):
+            return None
+
+    def _deep_verify(self, step: int) -> Optional[bool]:
+        """Restore the step's payload and re-hash every array against the
+        digests recorded at save time. ``True`` — all match; ``False`` —
+        a mismatch or an unreadable payload (rot the file CRCs re-encoded
+        away, or plain corruption); ``None`` — no digests recorded."""
+        from ..resilience.integrity import compare_digests, tree_digests
+        recorded = self._manifest_arrays(step)
+        if not recorded:
+            return None
+        try:
+            out = self._restore_step(step, None)
+        except Exception:
+            return False
+        return not compare_digests(recorded, tree_digests(out))
+
+    def verify(self, step: int, deep: bool = False) -> Optional[bool]:
+        """On-demand integrity check of a committed step. Shallow verifies
+        the file layer (size + CRC32); ``deep=True`` additionally restores
+        the payload and re-hashes every array against the save-time content
+        digests. Three-valued like :func:`verify_manifest` (``None`` when
+        the relevant attestation was never recorded)."""
+        self._vcache.pop(step, None)
+        shallow = self._verify(step)
+        if shallow is False or not deep:
+            return shallow
+        dv = self._deep_verify(step)
+        if dv is None:  # no digests recorded: report the shallow verdict
+            return shallow
+        return dv
 
     def restore(self, step: Optional[int] = None,
-                template: Optional[Any] = None):
+                template: Optional[Any] = None, deep: bool = False):
         from ..resilience.retry import call_with_retry
         self._commit_pending()
         if step is not None:  # explicit step: verify, no fallback
@@ -495,14 +568,28 @@ class CheckpointManager:
             out = call_with_retry(self._restore_step, step, template,
                                   site="ckpt_restore", tries=2,
                                   base_delay=0.01)
+            if deep:
+                recorded = self._manifest_arrays(step)
+                if recorded:
+                    from ..resilience.integrity import (compare_digests,
+                                                        tree_digests)
+                    bad = compare_digests(recorded, tree_digests(out))
+                    if bad:
+                        raise OSError(
+                            f"checkpoint step {step} failed deep "
+                            f"verification: {bad[:4]}")
             _record("restore", time.perf_counter() - t0, out)
             self.last_restored_step = step
             return out
-        fallbacks = 0
         for s in sorted(self._mngr.all_steps() or [], reverse=True):
             self._vcache.pop(s, None)
             if self._verify(s) is False:
-                fallbacks += 1
+                self._count_fallbacks(1, reason="manifest")
+                continue
+            if deep and self._deep_verify(s) is False:
+                # bytes check out but the decoded values do not — silent
+                # corruption between the file layer and the arrays
+                self._count_fallbacks(1, reason="deep")
                 continue
             try:
                 t0 = time.perf_counter()
@@ -512,10 +599,9 @@ class CheckpointManager:
             except Exception:
                 # no manifest (legacy) or rot the manifest couldn't see —
                 # orbax/tensorstore raised; fall back to an older step
-                fallbacks += 1
+                self._count_fallbacks(1, reason="restore")
                 continue
             _record("restore", time.perf_counter() - t0, out)
-            self._count_fallbacks(fallbacks)
             self.last_restored_step = s
             return out
         # no primary step restored: fall back to locally staged saves
@@ -523,26 +609,31 @@ class CheckpointManager:
         for s in sorted(self.staged_steps(), reverse=True):
             sdir = self._staged_step_dir(s)
             if verify_manifest(sdir) is False:
-                fallbacks += 1
+                self._count_fallbacks(1, reason="staged")
                 continue
             try:
                 t0 = time.perf_counter()
                 out = load_checkpoint(sdir, template=template)
             except Exception:
-                fallbacks += 1
+                self._count_fallbacks(1, reason="staged")
                 continue
-            self._count_fallbacks(fallbacks)
             self.last_restored_step = s
             return out
-        self._count_fallbacks(fallbacks)
         return None
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
     def latest_valid_step(self) -> Optional[int]:
-        """Newest step that passes (or predates) manifest verification."""
+        """Newest step that passes (or predates) manifest verification.
+        A size-only pre-pass (one stat per file, no reads) rejects
+        truncated/missing payloads before the full CRC pass — this runs in
+        the elastic restore barrier on every host, so the common
+        all-healthy case should not re-read whole checkpoints."""
         for s in sorted(self._mngr.all_steps() or [], reverse=True):
+            if verify_manifest(self._step_dir(s), level="size") is False:
+                self._vcache[s] = False
+                continue
             if self._verify(s) is not False:
                 return s
         return None

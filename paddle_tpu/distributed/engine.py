@@ -28,6 +28,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -137,6 +138,7 @@ class ParallelTrainer:
                  grad_sync_buckets: int = 1,
                  grad_sync_dcn_only: Optional[bool] = None,
                  nan_guard: bool = True,
+                 integrity_check_every: int = 0,
                  scaler=None):
         self.model = model
         self.optimizer = optimizer
@@ -199,6 +201,17 @@ class ParallelTrainer:
         # gradient_merge_optimizer + DistributedStrategy.gradient_merge):
         # split each batch into k chunks, accumulate grads, one optimizer step
         self.accumulate_steps = accumulate_steps
+        # Silent-corruption defense (resilience/integrity.py): every
+        # `integrity_check_every` steps the jitted step additionally
+        # fingerprints params/opt/comm_err in-graph and compares the
+        # data-replicated leaves across ranks with pmin/pmax. Two cached
+        # programs (like LocalSGD's sync/non-sync split): the non-check
+        # step carries ZERO fingerprint collectives and no recompiles
+        # happen after the first check step. 0 disables the feature.
+        self.integrity_check_every = max(0, int(getattr(
+            model, "integrity_check_every", integrity_check_every)))
+        self._steps_run = 0
+        self.last_divergence: list = []
         self.state = None
         self._init_state()
         self._build()
@@ -487,6 +500,75 @@ class ParallelTrainer:
             # identically at any device count
             sync_axes = live_axes
 
+        # -- replica-divergence fingerprints (resilience/integrity.py) --
+        # Per-leaf metadata for the check program: leaves whose spec
+        # mentions none of the live data axes are REPLICATED across data
+        # ranks — their fingerprints must agree bit-exactly, so they
+        # join the pmin/pmax divergence compare. Leaves legitimately
+        # sharded over those axes (comm_err's per-rank residual rows,
+        # ZeRO slots) get a wrapping-psum combined digest instead:
+        # recordable and replay-comparable, but excluded from the
+        # cross-rank equality decision (their per-rank bytes differ by
+        # design). Entry order == tree_leaves order of (params, opt,
+        # comm_err) as the check shard_map receives them.
+        self.integrity_axes = live_axes
+        entries = []
+        for part, vals, specs in (
+                # plain-dict forms, matching exactly what the check
+                # shard_map is called with (flatten order must agree)
+                ("params", dict(self.state["params"]),
+                 dict(self.param_specs)),
+                ("opt", self.state["opt"], self.opt_specs),
+                ("comm_err", dict(self.state["comm_err"]),
+                 dict(self.comm_err_specs))):
+            spec_list = []
+            jax.tree_util.tree_map(
+                lambda v, s: spec_list.append(s), vals, specs)
+            flat, _ = jax.tree_util.tree_flatten_with_path(vals)
+            for (path, _v), spec in zip(flat, spec_list):
+                leaf_axes = tuple(ax for ax in live_axes
+                                  if _spec_has_axis(spec, ax))
+                entries.append(
+                    (part + jax.tree_util.keystr(path), leaf_axes))
+        self._integrity_entries = entries
+        self._integrity_cmp_idx = [i for i, (_n, a) in enumerate(entries)
+                                   if not a]
+        # every size>1 mesh axis: the divergence mask is pmax-spread over
+        # all of them so rank 0's copy of the verdict is authoritative
+        # even when the diverged leaf is model/pipe-sharded
+        self._integrity_all_axes = tuple(
+            ax for ax in mesh.axis_names if mesh.shape.get(ax, 1) > 1)
+
+        def integrity_check_fn(params, opt_state, comm_err):
+            from ..resilience.integrity import fingerprint_array
+            leaves = (jax.tree_util.tree_leaves(params)
+                      + jax.tree_util.tree_leaves(opt_state)
+                      + jax.tree_util.tree_leaves(comm_err))
+            fps = []
+            for (_name, leaf_axes), leaf in zip(self._integrity_entries,
+                                                leaves):
+                fp = fingerprint_array(leaf)
+                if leaf_axes:
+                    # wrap-add is order-independent: the combined digest
+                    # of a sharded leaf is deterministic on any backend
+                    fp = lax.psum(fp, leaf_axes)
+                fps.append(fp)
+            fps = (jnp.stack(fps) if fps
+                   else jnp.zeros((0,), jnp.uint32))
+            cmp_idx = self._integrity_cmp_idx
+            if cmp_idx and self.integrity_axes:
+                cmp = fps[jnp.asarray(cmp_idx)]
+                div = (lax.pmin(cmp, self.integrity_axes)
+                       != lax.pmax(cmp, self.integrity_axes)
+                       ).astype(jnp.int32)
+                if self._integrity_all_axes:
+                    div = lax.pmax(div, self._integrity_all_axes)
+            else:
+                div = jnp.zeros((len(cmp_idx),), jnp.int32)
+            return fps, div
+
+        self._integrity_check_fn = integrity_check_fn
+
         # loss scaling (scaler attached): the loss is scaled BEFORE the
         # backward pass (underflow protection is in the gradient compute,
         # scaling afterwards would be too late) and grads are unscaled
@@ -727,8 +809,15 @@ class ParallelTrainer:
 
         K = self.accumulate_steps
 
-        def make_step(input_specs, label_specs):
+        def make_step(input_specs, label_specs, do_check=False):
             """Jitted step for one concrete (inputs, labels) pytree shape.
+
+            ``do_check=True`` builds the integrity variant: after the
+            update it fingerprints params/opt/comm_err in-graph and
+            pmin/pmax-compares the data-replicated leaves across ranks
+            (two scalar collectives per compared leaf). The plain
+            program carries none of this — zero fingerprint
+            collectives, asserted by chaos_smoke's sdc scenario.
 
             Data specs are per-LEAF: the batch dim always splits over
             data×sharding; with context parallelism ("sep" axis) rank>=2
@@ -760,6 +849,14 @@ class ParallelTrainer:
 
             nan_guard = self.nan_guard
             scaler = self.scaler
+
+            check_map = None
+            if do_check and self._integrity_entries:
+                check_map = shard_map(
+                    self._integrity_check_fn, mesh=mesh,
+                    in_specs=(dict(self.param_specs), self.opt_specs,
+                              dict(self.comm_err_specs)),
+                    out_specs=(P(), P()), check_vma=False)
 
             def train_step(params, buffers, opt_state, comm_err, guard,
                            key, lr, taint, inputs, labels):
@@ -831,7 +928,16 @@ class ParallelTrainer:
                     if use_amp:
                         new_guard["amp"] = scaler.update_scale_state(
                             guard["amp"], ~finite)
-                return loss, new_params, new_opt, comm_err, new_guard
+                # integrity fingerprints of the FINAL (possibly
+                # guard-reverted) state — exactly what a checkpoint at
+                # this step would persist. None on the plain program:
+                # an empty pytree output, so both programs unpack alike.
+                integ = None
+                if check_map is not None:
+                    integ = check_map(dict(new_params), new_opt,
+                                      dict(comm_err))
+                return loss, new_params, new_opt, comm_err, new_guard, \
+                    integ
 
             return jax.jit(train_step, donate_argnums=(0, 2, 3, 4))
 
@@ -893,10 +999,14 @@ class ParallelTrainer:
             return P(DATA_AXES, "sep")
         return P(DATA_AXES)
 
-    def _stage(self, inputs, labels, place: bool = True):
+    def _stage(self, inputs, labels, place: bool = True,
+               do_check: bool = False):
         """Normalize a batch and get its jitted step from the cache
         (tracing it on first use). ``place=False`` skips device_put so
         ShapeDtypeStruct batches can stage without materializing data.
+        ``do_check`` selects the integrity-check program variant (its
+        own cache slot: at steady state both programs are staged once
+        and the cadence flips between them with no recompiles).
         Returns (inputs, labels, step)."""
         conv = lambda x: x if isinstance(x, jax.ShapeDtypeStruct) \
             else jnp.asarray(x)  # noqa: E731
@@ -913,12 +1023,13 @@ class ParallelTrainer:
                     x, NamedSharding(self.mesh, s)), labels, lb_specs)
         cache_key = (jax.tree_util.tree_structure((inputs, labels)),
                      tuple(_rank(l) for l in jax.tree_util.tree_leaves(
-                         (inputs, labels))))
+                         (inputs, labels))),
+                     bool(do_check))
         step = self._step_cache.get(cache_key)
         self._last_stage_miss = step is None
         if step is None:
             t0 = time.perf_counter()
-            step = self._make_step(in_specs, lb_specs)
+            step = self._make_step(in_specs, lb_specs, do_check)
             self._step_cache[cache_key] = step
             if _telemetry.enabled():
                 _telemetry.counter(
@@ -1027,10 +1138,12 @@ class ParallelTrainer:
             off += n
         return closed, donated
 
-    def staged_jaxpr(self, inputs, labels, lr=None):
+    def staged_jaxpr(self, inputs, labels, lr=None, do_check=False):
         """Public tracing hook for tools: stage the train step for this
-        batch shape and return its ClosedJaxpr (nothing executed)."""
-        inputs, labels, step = self._stage(inputs, labels, place=False)
+        batch shape and return its ClosedJaxpr (nothing executed).
+        ``do_check=True`` traces the integrity-check program variant."""
+        inputs, labels, step = self._stage(inputs, labels, place=False,
+                                           do_check=do_check)
         closed, _ = self._staged_jaxpr(step, inputs, labels, lr)
         return closed
 
@@ -1054,7 +1167,13 @@ class ParallelTrainer:
         # inputs/labels may be arbitrary pytrees (e.g. (mlm, nsp) labels)
         tel = _telemetry.enabled()
         t_start = time.perf_counter() if tel else 0.0
-        inputs, labels, step = self._stage(inputs, labels)
+        # integrity cadence: host-side choice between the two cached
+        # programs (no recompile, no in-graph branch on the step count)
+        self._steps_run += 1
+        ce = self.integrity_check_every
+        do_check = bool(ce) and self._steps_run % ce == 0
+        inputs, labels, step = self._stage(inputs, labels,
+                                           do_check=do_check)
         # Host range for the profiler/chrome trace; the telemetry counter
         # track is aligned against these. Skipped entirely (no object,
         # no named_scope) when the profiler is off.
@@ -1062,7 +1181,7 @@ class ParallelTrainer:
               if _profiler.is_profiler_enabled() else None)
         n_compiled0 = self._jit_cache_size(step) if tel else None
         taint = 1.0 if grad_taint is None else float(grad_taint)
-        loss, new_params, new_opt, new_comm_err, new_guard = step(
+        loss, new_params, new_opt, new_comm_err, new_guard, integ = step(
             self.state["params"], self.state["buffers"], self.state["opt"],
             self.state["comm_err"], self.state["guard"], key, lr, taint,
             inputs, labels)
@@ -1076,6 +1195,8 @@ class ParallelTrainer:
         self.state["opt"] = new_opt
         self.state["comm_err"] = new_comm_err
         self.state["guard"] = new_guard
+        if integ is not None:
+            self._record_integrity(integ)
         if tel:
             self._record_step_telemetry(
                 time.perf_counter() - t_start, inputs, step, n_compiled0)
@@ -1088,6 +1209,40 @@ class ParallelTrainer:
         if _flags.flag("benchmark"):
             jax.block_until_ready(loss)
         return loss
+
+    def _record_integrity(self, integ):
+        """Host side of the check step: pull the tiny divergence mask
+        (len == compared leaves, int32) and remember which leaves'
+        fingerprints disagreed across data ranks. The fingerprints
+        themselves stay on device unless someone asks
+        (``last_fingerprints``)."""
+        fps, div = integ
+        self.last_fingerprints = fps
+        mask = np.asarray(jax.device_get(div)).reshape(-1)
+        names = [self._integrity_entries[i][0]
+                 for i in self._integrity_cmp_idx]
+        diverged = [n for n, m in zip(names, mask) if int(m)]
+        self.last_divergence = diverged
+        if _telemetry.enabled():
+            _telemetry.counter(
+                "integrity_check_steps_total",
+                "train steps that ran the fingerprint check program"
+            ).inc()
+            if diverged:
+                c = _telemetry.counter(
+                    "replica_divergence_total",
+                    "integrity checks where a leaf's fingerprint "
+                    "differed across data-parallel ranks")
+                for n in diverged:
+                    c.inc(leaf=n)
+        return diverged
+
+    def consume_divergence(self) -> list:
+        """Divergent leaf names from the most recent check step, cleared
+        on read — run_resilient polls this after every step and converts
+        a non-empty answer into quarantine + rollback."""
+        out, self.last_divergence = self.last_divergence, []
+        return out
 
     @staticmethod
     def _jit_cache_size(step):

@@ -195,6 +195,7 @@ class DataLoader:
                 # data_fetch faults) are retried here, not surfaced to the
                 # training loop
                 faults.maybe_raise("data_fetch", step=step,
+                                   site="dataloader_fetch",
                                    msg="injected data_fetch in dataloader")
                 return [self.dataset[i] for i in indices]
 

@@ -1,6 +1,6 @@
 """Fault-resilient training runtime.
 
-Five small parts compose the recovery story (see each module's docstring):
+Six small parts compose the recovery story (see each module's docstring):
 
 - ``faults``  — deterministic fault injection (every recovery path has a
   reproducible trigger)
@@ -14,6 +14,12 @@ Five small parts compose the recovery story (see each module's docstring):
   step), scale-up/down remesh + reshard through the sharded checkpoint,
   comm_err residual remapping; ``hostsim`` runs N subprocess "hosts"
   over the file-KV so the whole thing is testable on one CPU box.
+- ``integrity`` — silent-corruption defense: in-graph replica-divergence
+  fingerprinting (checked every ``integrity_check_every`` steps at zero
+  cost in between), majority-vote replica quarantine, per-array content
+  digests behind the deep checkpoint verify, deterministic step replay
+  (SDC vs nondeterminism), and the hang watchdog that turns a wedged
+  host into an ordinary host-loss remesh.
 
 Crash-consistent checkpoint commits live with the checkpoint code itself
 (``distributed.checkpoint``: manifest write/verify + fallback restore).
@@ -25,6 +31,9 @@ from .elastic import (CoordinatorTimeout, ElasticRuntime,  # noqa: F401
                       reshard_trainer)
 from .faults import HostLost, SimulatedCrash, inject  # noqa: F401
 from .guard import all_finite, all_finite_value  # noqa: F401
+from .integrity import (HangWatchdog, compare_digests,  # noqa: F401
+                        count_fingerprint_collectives, inject_param_flip,
+                        quarantine_outliers, replay_step, tree_digests)
 from .retry import RetryBytesExhausted, call_with_retry, retry  # noqa: F401
 from .runner import RunResult, run_resilient  # noqa: F401
 
@@ -33,4 +42,6 @@ __all__ = ["faults", "SimulatedCrash", "HostLost", "inject", "all_finite",
            "RetryBytesExhausted", "RunResult", "run_resilient",
            "CoordinatorTimeout", "FileCoordinator", "coordinated_restore",
            "remap_comm_err", "reshard_trainer", "ElasticRuntime",
-           "data_parallel_remesh_fn"]
+           "data_parallel_remesh_fn", "HangWatchdog", "tree_digests",
+           "compare_digests", "count_fingerprint_collectives",
+           "inject_param_flip", "quarantine_outliers", "replay_step"]

@@ -168,7 +168,7 @@ def coordinated_restore(manager, template, coordinator: FileCoordinator,
     from . import faults
     local = manager.latest_valid_step() if manager is not None else None
     local = -1 if local is None else int(local)
-    if faults.fires("restore_divergence"):
+    if faults.fires("restore_divergence", site="restore_barrier"):
         # pretend our newest checkpoint is torn: report one step older
         local = max(local - 1, -1)
     steps = coordinator.allgather("restore_step", local, hosts_fn,

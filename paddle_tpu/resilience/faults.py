@@ -31,12 +31,26 @@ kind            effect at the instrumented site
 ``restore_divergence``  the coordinated restore barrier reports one step
                 older than the true local newest-valid (forces a
                 min-reduce disagreement)
+``param_flip``  silent data corruption: one low mantissa bit of one
+                parameter element flips on ONE data replica
+                (integrity.inject_param_flip, deterministic in the
+                spec's seed + step) — only the fingerprint check can
+                see it
+``host_hang``   the runner blocks inside the step like a wedged
+                collective (integrity.simulate_hang); recovery is the
+                hang watchdog firing, heartbeats stopping, and peers
+                remeshing around the silent host
 ==============  ==========================================================
 
 Determinism: ``at_step`` fires exactly when the site reports that step;
 ``prob`` draws from ``random.Random`` seeded per (seed, call-index), so a
 given spec fires at the same call sites in every run. Each armed fault
 fires at most ``times`` times (default 1).
+
+Fired-fault telemetry records BOTH the kind and the site that consulted
+it (``resilience_faults_injected_total{kind=..., site=...}``), so a
+chaos run's series distinguish a ckpt_io hit in ``manager_save`` from
+one in ``save_checkpoint``.
 """
 from __future__ import annotations
 
@@ -46,10 +60,11 @@ import threading
 from typing import List, Optional
 
 __all__ = ["KINDS", "SimulatedCrash", "HostLost", "inject", "fires",
-           "maybe_raise", "active", "reset"]
+           "fire_spec", "maybe_raise", "active", "reset"]
 
 KINDS = ("ckpt_io", "ckpt_torn", "nan_grad", "data_fetch", "sigterm",
-         "host_loss", "host_join", "restore_divergence")
+         "host_loss", "host_join", "restore_divergence", "param_flip",
+         "host_hang")
 
 
 class SimulatedCrash(RuntimeError):
@@ -124,24 +139,40 @@ def active(kind: Optional[str] = None) -> bool:
                    for f in _ACTIVE)
 
 
-def fires(kind: str, step: Optional[int] = None) -> bool:
-    """Consult the armed faults at an instrumentation site. Counts
-    ``resilience_faults_injected_total{kind=...}`` when one fires."""
+def fire_spec(kind: str, step: Optional[int] = None,
+              site: Optional[str] = None) -> Optional[_Fault]:
+    """Consult the armed faults at an instrumentation site; returns the
+    spec that fired (None on no hit) so sites with deterministic
+    payloads — param_flip derives its bit/leaf/replica from the spec's
+    seed — can read it. Counts
+    ``resilience_faults_injected_total{kind=..., site=...}``."""
+    hit = None
     with _lock:
-        hit = any([f.should_fire(step) for f in _ACTIVE if f.kind == kind])
-    if hit:
+        # every matching spec is consulted (each keeps its own call
+        # index / shot budget); the first that fires is returned
+        for f in _ACTIVE:
+            if f.kind == kind and f.should_fire(step):
+                hit = hit or f
+    if hit is not None:
         from .. import telemetry
         if telemetry.enabled():
             telemetry.counter(
                 "resilience_faults_injected_total",
-                "faults fired by the injection harness").inc(kind=kind)
+                "faults fired by the injection harness").inc(
+                    kind=kind, site=site or "unspecified")
     return hit
 
 
+def fires(kind: str, step: Optional[int] = None,
+          site: Optional[str] = None) -> bool:
+    """Boolean form of :func:`fire_spec`."""
+    return fire_spec(kind, step=step, site=site) is not None
+
+
 def maybe_raise(kind: str, step: Optional[int] = None, exc=IOError,
-                msg: Optional[str] = None):
+                msg: Optional[str] = None, site: Optional[str] = None):
     """``fires`` that raises ``exc`` on a hit (the IOError-style kinds)."""
-    if fires(kind, step=step):
+    if fires(kind, step=step, site=site):
         raise exc(msg or f"injected fault: {kind}"
                   + (f" at step {step}" if step is not None else ""))
 

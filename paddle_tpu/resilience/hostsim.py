@@ -37,9 +37,11 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-__all__ = ["SimCluster", "worker_main", "HOST_LOSS_EXIT"]
+__all__ = ["SimCluster", "worker_main", "HOST_LOSS_EXIT",
+           "HOST_HANG_EXIT"]
 
 HOST_LOSS_EXIT = 9   # a host_loss death (distinct from every runner code)
+HOST_HANG_EXIT = 10  # hang-watchdog self-termination (wedged step)
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -152,6 +154,9 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--hb-timeout", type=float, default=1.0)
     p.add_argument("--step-delay", type=float, default=0.15)
     p.add_argument("--max-remeshes", type=int, default=3)
+    p.add_argument("--hang-timeout", type=float, default=0.0,
+                   help="arm the hang watchdog with this step deadline "
+                        "(seconds); a firing exits with HOST_HANG_EXIT")
     p.add_argument("--fault", action="append", default=[],
                    metavar="KIND:STEP",
                    help="arm a deterministic fault, e.g. host_loss:12")
@@ -161,7 +166,7 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     from .. import telemetry
     from ..distributed.checkpoint import CheckpointManager
     from ..distributed.fleet.elastic import ElasticManager
-    from . import faults
+    from . import faults, integrity
     from .elastic import ElasticRuntime, FileCoordinator, \
         data_parallel_remesh_fn
     from .runner import run_resilient
@@ -183,6 +188,10 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
 
     def _beat():
         while not hb_stop.is_set():
+            if integrity.hang_event.is_set():
+                # the hang watchdog fired: this host is wedged — stop
+                # advertising liveness so peers reclassify it as lost
+                return
             try:
                 em.heartbeat()
             except Exception:
@@ -222,7 +231,9 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
             stack.enter_context(faults.inject(kind, at_step=int(at)))
         try:
             res = run_resilient(trainer, loader, args.steps, manager=mgr,
-                                save_every=1, elastic=runtime)
+                                save_every=1, elastic=runtime,
+                                hang_timeout=args.hang_timeout or None,
+                                hang_exit=HOST_HANG_EXIT)
         except faults.HostLost:
             # abrupt machine death: no deregister, no flush, no result
             os._exit(HOST_LOSS_EXIT)
@@ -266,7 +277,8 @@ class SimCluster:
 
     def __init__(self, root: str, n_hosts: int = 3, np_spec: str = "2:3",
                  steps: int = 24, hb_timeout: float = 1.0,
-                 step_delay: float = 0.15, seed: int = 7):
+                 step_delay: float = 0.15, seed: int = 7,
+                 hang_timeout: float = 0.0):
         self.root = os.path.abspath(root)
         self.n_hosts = n_hosts
         self.np_spec = np_spec
@@ -274,6 +286,7 @@ class SimCluster:
         self.hb_timeout = hb_timeout
         self.step_delay = step_delay
         self.seed = seed
+        self.hang_timeout = hang_timeout
         os.makedirs(self.root, exist_ok=True)
 
     def host_ckpt_dir(self, i: int) -> str:
@@ -292,6 +305,8 @@ class SimCluster:
                "--steps", str(self.steps), "--seed", str(self.seed),
                "--hb-timeout", str(self.hb_timeout),
                "--step-delay", str(self.step_delay)]
+        if self.hang_timeout:
+            cmd += ["--hang-timeout", str(self.hang_timeout)]
         for kind, at in faults_for:
             cmd += ["--fault", f"{kind}:{at}"]
         env = dict(os.environ)
@@ -332,8 +347,11 @@ class SimCluster:
                 results[h] = None
         hosts_lost = sum(1 for c in exit_codes.values()
                          if c == HOST_LOSS_EXIT)
+        hosts_hung = sum(1 for c in exit_codes.values()
+                         if c == HOST_HANG_EXIT)
         return {"exit_codes": exit_codes, "results": results,
-                "hosts_lost": hosts_lost, "stderr": stderr}
+                "hosts_lost": hosts_lost, "hosts_hung": hosts_hung,
+                "stderr": stderr}
 
 
 if __name__ == "__main__":

@@ -1,0 +1,549 @@
+"""Silent-corruption defense (ISSUE 9): fingerprints, deep verify,
+replay, hang watchdog.
+
+The resilience stack through PR 8 handles *loud* failures — NaN grads,
+torn checkpoints, killed hosts. This module is the quiet-failure layer:
+
+- **In-graph replica fingerprints** (:func:`fingerprint_array`): a
+  bf16-safe chunked uint32 checksum computed INSIDE the jitted step on
+  check steps. The engine compares fingerprints of data-replicated
+  leaves across ranks with ``pmin``/``pmax`` (two scalar collectives
+  per leaf, no host callback); a min/max mismatch means some replica's
+  bytes differ — a flipped bit, a bad chip, a divergent update.
+- **Majority-vote quarantine** (:func:`quarantine_outliers`): once the
+  step flags divergence, host-side shard digests identify WHICH replica
+  disagrees; the majority fingerprint wins and the outlier's host is
+  evicted (multi-host) or the state is rolled back (single-host).
+- **Host content digests** (:func:`tree_digests`): per-array crc32
+  recorded into MANIFEST.json at save time so
+  ``CheckpointManager.verify(step, deep=True)`` can catch write-path
+  rot that the file-level CRC cannot (that CRC hashes already-written
+  bytes).
+- **Deterministic replay** (:func:`replay_step`): re-execute step *s*
+  from checkpoint *s−1* with the saved data cursor + RNG key and
+  compare digests against the ones recorded at step *s* — run it twice
+  and SDC (replays agree with each other, disagree with the record)
+  separates from software nondeterminism (replays disagree).
+- **Hang watchdog** (:class:`HangWatchdog`): a heartbeat-backed
+  deadline around the staged step. A wedged collective can't be
+  interrupted from a thread, but the watchdog CAN stop renewing the
+  host's heartbeat — peers then reclassify it as lost through the
+  existing staleness reaping and remesh around it — and optionally
+  ``os._exit`` so the sim supervisor sees a distinct exit code.
+
+Checksum design: values are bitcast to uint32 lanes (never summed in
+float), multiplied by odd position-dependent weights and accumulated
+with wrap-around uint32 addition. Wrap-add is associative and
+commutative, so the result is bit-identical no matter how XLA
+reorders the reduction — a hard requirement for cross-replica
+comparison. The position weights make permutations detectable; the
+dtype/length mix-in distinguishes same-bytes-different-shape leaves.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import random as _pyrandom
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+import numpy as np
+from jax import lax
+
+__all__ = [
+    "FINGERPRINT_COLLECTIVES", "fingerprint_array", "fingerprint_tree",
+    "count_fingerprint_collectives", "array_digest", "tree_digests",
+    "compare_digests", "replica_coords", "quarantine_outliers",
+    "inject_param_flip", "HangWatchdog", "hang_event", "simulate_hang",
+    "replay_step",
+]
+
+# the only collective primitives the fingerprint check program emits —
+# chaos_smoke asserts the NON-check program contains zero of these
+# (walked recursively, so a pmin hidden inside a pjit still counts).
+FINGERPRINT_COLLECTIVES = ("pmin", "pmax")
+
+# odd 32-bit mixing constants (Knuth / xxhash primes)
+_P1 = 2654435761
+_P2 = 0x9E3779B9
+_P3 = 0x85EBCA6B
+
+
+# -- in-graph fingerprint ---------------------------------------------------
+
+def _as_uint32(x):
+    """Reinterpret any array's bytes as uint32 lanes (trace-safe).
+
+    Sub-4-byte dtypes widen losslessly after a same-width bitcast;
+    8-byte dtypes bitcast to a trailing lane pair. Never converts
+    through float, so NaN payloads and signed zeros fingerprint too.
+    """
+    x = jnp.asarray(x)
+    dt = x.dtype
+    if dt == jnp.bool_:
+        return x.astype(jnp.uint32)
+    size = dt.itemsize
+    if size == 4:
+        return x if dt == jnp.uint32 else lax.bitcast_convert_type(x, jnp.uint32)
+    if size == 2:
+        u = x if dt == jnp.uint16 else lax.bitcast_convert_type(x, jnp.uint16)
+        return u.astype(jnp.uint32)
+    if size == 1:
+        u = x if dt == jnp.uint8 else lax.bitcast_convert_type(x, jnp.uint8)
+        return u.astype(jnp.uint32)
+    # 8-byte dtypes: bitcast adds a trailing lane dim of size 2
+    return lax.bitcast_convert_type(x, jnp.uint32)
+
+
+def fingerprint_array(x, chunk: int = 1024) -> jnp.ndarray:
+    """Deterministic uint32 checksum of an array's bytes (jit-safe).
+
+    Chunked so XLA fuses it into one pass; position-weighted so
+    permutations change the sum; closed under uint32 wrap-add so the
+    value is reduction-order independent (bit-identical across
+    replicas holding identical bytes, on any backend).
+    """
+    x = jnp.asarray(x)
+    dt_mix = zlib.crc32(str(x.dtype).encode()) & 0xFFFFFFFF
+    u = _as_uint32(x).reshape(-1)
+    n = int(u.size)
+    meta = jnp.uint32((n * _P2 + dt_mix) & 0xFFFFFFFF)
+    if n == 0:
+        return meta
+    pad = (-n) % chunk
+    if pad:
+        u = jnp.concatenate([u, jnp.zeros((pad,), jnp.uint32)])
+    m = u.reshape(-1, chunk)
+    w = (jnp.arange(chunk, dtype=jnp.uint32) * jnp.uint32(_P1)
+         + jnp.uint32(_P2)) | jnp.uint32(1)
+    rows = jnp.sum(m * w[None, :], axis=1, dtype=jnp.uint32)
+    rw = (jnp.arange(rows.size, dtype=jnp.uint32) * jnp.uint32(_P3)
+          + jnp.uint32(_P2)) | jnp.uint32(1)
+    return jnp.sum(rows * rw, dtype=jnp.uint32) + meta
+
+
+def fingerprint_tree(tree, chunk: int = 1024) -> Dict[str, jnp.ndarray]:
+    """Per-leaf fingerprints keyed by the leaf's keystr path."""
+    flat, _ = jtu.tree_flatten_with_path(tree)
+    return {jtu.keystr(path): fingerprint_array(v, chunk) for path, v in flat}
+
+
+def count_fingerprint_collectives(closed) -> int:
+    """How many FINGERPRINT pmin/pmax equations a (Closed)Jaxpr
+    contains, walked recursively with the canonical analysis walker —
+    the acceptance probe that the non-check program stays clean.
+    Fingerprints are the only uint32 pmin/pmax users in the step (the
+    int8/int4 exchange pmax-reduces FLOAT block scales), so the dtype
+    disambiguates."""
+    from ..analysis.walker import unwrap, walk
+    jaxpr, _ = unwrap(closed)
+    n = 0
+    for site in walk(jaxpr):
+        if site.eqn.primitive.name not in FINGERPRINT_COLLECTIVES:
+            continue
+        avals = [getattr(v, "aval", None) for v in site.eqn.outvars]
+        if any(getattr(a, "dtype", None) == jnp.uint32 for a in avals):
+            n += 1
+    return n
+
+
+# -- host-side content digests (deep checkpoint verify) ---------------------
+
+def array_digest(x) -> str:
+    """Content digest of one array: crc32 over dtype, shape and raw
+    bytes — ``"crc32:<8 hex>:<nbytes>"``. Computed from the IN-MEMORY
+    value, so a write path that rots bytes between device and disk is
+    caught when the on-disk payload re-hashes differently."""
+    a = np.asarray(jax.device_get(x))
+    c = zlib.crc32(str(a.dtype).encode())
+    c = zlib.crc32(repr(a.shape).encode(), c)
+    c = zlib.crc32(np.ascontiguousarray(a).tobytes(), c)
+    return "crc32:%08x:%d" % (c & 0xFFFFFFFF, a.nbytes)
+
+
+def tree_digests(tree) -> Dict[str, str]:
+    """Per-leaf :func:`array_digest`, keyed by keystr path. Leaves whose
+    bytes this process cannot materialize (non-addressable multi-host
+    shards) are skipped — each host attests what it holds."""
+    out: Dict[str, str] = {}
+    flat, _ = jtu.tree_flatten_with_path(tree)
+    for path, v in flat:
+        try:
+            out[jtu.keystr(path)] = array_digest(v)
+        except Exception:
+            continue
+    return out
+
+
+def compare_digests(recorded: Dict[str, str],
+                    actual: Dict[str, str]) -> List[str]:
+    """Keys present in both maps whose digests differ, sorted."""
+    return sorted(k for k in recorded
+                  if k in actual and recorded[k] != actual[k])
+
+
+# -- replica geometry / quarantine ------------------------------------------
+
+def replica_coords(mesh, axes: Sequence[str]) -> Dict[Any, int]:
+    """device -> linearized replica rank over the given mesh axes."""
+    arr = np.asarray(mesh.devices)
+    names = list(mesh.axis_names)
+    idxs = [names.index(ax) for ax in axes if ax in names]
+    out = {}
+    for pos in np.ndindex(arr.shape):
+        r = 0
+        for i in idxs:
+            r = r * arr.shape[i] + pos[i]
+        out[arr[pos]] = int(r)
+    return out
+
+
+def _voting_leaves(trainer) -> List[str]:
+    """Trainable params fully replicated over the check axes — the
+    leaves whose per-replica bytes MUST agree, hence can vote."""
+    axes = tuple(getattr(trainer, "integrity_axes", ()) or ())
+    names = []
+    for k, spec in trainer.param_specs.items():
+        if not trainer.trainable.get(k, False):
+            continue
+        if any(_spec_mentions(spec, ax) for ax in axes):
+            continue
+        names.append(k)
+    return names
+
+
+def _spec_mentions(spec, axis: str) -> bool:
+    return any(ax == axis or (isinstance(ax, tuple) and axis in ax)
+               for ax in spec)
+
+
+def quarantine_outliers(trainer, leaves: Optional[List[str]] = None,
+                        elastic=None) -> Dict[str, Any]:
+    """Identify which replica(s) diverged and decide the eviction.
+
+    Digests every data-replicated trainable param per replica
+    (host-side crc32 over one representative device's shard bytes) and
+    majority-votes: replicas whose digest chain differs from the
+    majority are outliers. Ties break toward the group containing
+    replica 0 (the save-source replica). Returns::
+
+        {"outlier_replicas": [...], "outlier_hosts": [process ids],
+         "quarantined": n, "action": "rollback"|"self_evict"|"peer_evict",
+         "leaves": [...]}
+
+    ``action`` is "rollback" single-process (the sim maps replicas to
+    virtual hosts: rollback through the restore barrier replaces every
+    replica's bytes from the last clean checkpoint, which is exactly
+    the quarantine-and-recover semantics collapsed onto one host);
+    multi-process, the outlier host self-evicts (raises HostLost in the
+    runner) and the survivors remesh around it.
+    """
+    from .. import telemetry
+    axes = tuple(getattr(trainer, "integrity_axes", ()) or ())
+    mesh = trainer.mesh
+    n_rep = 1
+    for ax in axes:
+        n_rep *= int(mesh.shape.get(ax, 1))
+    base = {"outlier_replicas": [], "outlier_hosts": [], "quarantined": 0,
+            "action": "rollback", "leaves": list(leaves or [])}
+    if n_rep <= 1:
+        return base
+    coords = replica_coords(mesh, axes)
+    rep_dev: Dict[int, Any] = {}
+    for d, r in coords.items():
+        rep_dev.setdefault(r, d)
+    crcs = {r: 0 for r in rep_dev}
+    for name in _voting_leaves(trainer):
+        v = trainer.state["params"][name]
+        try:
+            by_dev = {s.device: s for s in v.addressable_shards}
+        except Exception:
+            continue
+        for r, d in rep_dev.items():
+            s = by_dev.get(d)
+            if s is None:
+                continue
+            a = np.ascontiguousarray(np.asarray(s.data))
+            crcs[r] = zlib.crc32(a.tobytes(), crcs[r])
+    votes: Dict[int, List[int]] = {}
+    for r, c in crcs.items():
+        votes.setdefault(c, []).append(r)
+    if len(votes) <= 1:
+        return base
+    majority = max(votes, key=lambda c: (len(votes[c]), 0 in votes[c]))
+    outliers = sorted(r for c, rs in votes.items() if c != majority
+                      for r in rs)
+    outlier_hosts = sorted({rep_dev[r].process_index for r in outliers})
+    try:
+        me, n_proc = jax.process_index(), jax.process_count()
+    except Exception:
+        me, n_proc = 0, 1
+    action = "rollback"
+    if n_proc > 1 and outlier_hosts:
+        action = "self_evict" if me in outlier_hosts else "peer_evict"
+    if outliers and telemetry.enabled():
+        telemetry.counter(
+            "hosts_quarantined_total",
+            "replicas/hosts evicted by majority-vote divergence quarantine",
+        ).inc(len(outliers))
+    return {"outlier_replicas": outliers, "outlier_hosts": outlier_hosts,
+            "quarantined": len(outliers), "action": action,
+            "leaves": list(leaves or [])}
+
+
+def inject_param_flip(trainer, seed: int = 0, step: Optional[int] = None,
+                      leaf: Optional[str] = None,
+                      replica: Optional[int] = None,
+                      bit: Optional[int] = None) -> Dict[str, Any]:
+    """Flip one low mantissa bit of one param element on ONE replica —
+    the ``param_flip`` fault body (simulated SDC from a bad chip).
+
+    Deterministic in (seed, step). Targets a non-zero replica by
+    default so checkpoints saved from shard 0 between the flip and its
+    detection stay clean and rollback genuinely recovers. The flipped
+    bit is in the low mantissa (harmless magnitude) — the point is that
+    the FINGERPRINT sees what the loss curve never would.
+    """
+    axes = tuple(getattr(trainer, "integrity_axes", ()) or ())
+    mesh = trainer.mesh
+    n_rep = 1
+    for ax in axes:
+        n_rep *= int(mesh.shape.get(ax, 1))
+    rng = _pyrandom.Random((int(seed) * 1000003) ^ (0 if step is None
+                                                    else int(step)))
+    cands = [k for k in _voting_leaves(trainer)
+             if jnp.issubdtype(trainer.state["params"][k].dtype,
+                               jnp.floating)]
+    if not cands:
+        raise ValueError("no data-replicated floating param to flip")
+    name = leaf if leaf is not None else cands[rng.randrange(len(cands))]
+    v = trainer.state["params"][name]
+    if replica is None:
+        replica = rng.randrange(1, n_rep) if n_rep > 1 else 0
+    if bit is None:
+        bit = rng.randrange(0, 3)  # lowest mantissa bits
+    elem = rng.randrange(max(1, int(np.prod(v.shape))))
+    coords = replica_coords(mesh, axes)
+    lane = {2: np.uint16, 4: np.uint32, 8: np.uint64}[v.dtype.itemsize]
+    arrays = []
+    for s in v.addressable_shards:
+        a = np.array(s.data)
+        if coords.get(s.device) == replica:
+            a.reshape(-1).view(lane)[elem % max(1, a.size)] ^= lane(1) << bit
+        arrays.append(jax.device_put(a, s.device))
+    trainer.state["params"][name] = jax.make_array_from_single_device_arrays(
+        v.shape, v.sharding, arrays)
+    return {"leaf": name, "replica": int(replica), "element": int(elem),
+            "bit": int(bit)}
+
+
+# -- hang watchdog ----------------------------------------------------------
+
+# Module-level latch: set when any watchdog fires. Heartbeat pumps (the
+# watchdog's own, hostsim's _beat thread) consult it and STOP renewing
+# the host's liveness file — which is the whole eviction mechanism: a
+# hung host can't be interrupted, but its silence is what peers act on.
+hang_event = threading.Event()
+
+
+class HangWatchdog:
+    """Deadline monitor around the staged step.
+
+    While armed, a daemon thread pumps ``heartbeat_fn`` (the elastic
+    membership heartbeat) every ``poll`` seconds; if ``timeout``
+    elapses without a :meth:`disarm`, it fires ONCE per arm:
+    counts ``hang_watchdog_fired_total``, sets :data:`hang_event`
+    (stopping every heartbeat pump in the process so peers reclassify
+    this host as lost), runs ``on_fire``, and — when ``exit_code`` is
+    given (hostsim) — ``os._exit``\\ s so the supervisor can tell a
+    hang from a crash. A fired watchdog cannot unwedge XLA; eviction +
+    remesh by the survivors is the recovery, not interruption.
+    """
+
+    def __init__(self, timeout: float, heartbeat_fn: Optional[Callable] = None,
+                 on_fire: Optional[Callable] = None,
+                 exit_code: Optional[int] = None,
+                 poll: Optional[float] = None):
+        self.timeout = float(timeout)
+        self.heartbeat_fn = heartbeat_fn
+        self.on_fire = on_fire
+        self.exit_code = exit_code
+        self.poll = poll if poll is not None else max(
+            0.02, min(0.25, self.timeout / 8.0))
+        self.fired = 0
+        self._deadline: Optional[float] = None
+        self._step: Optional[int] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HangWatchdog":
+        hang_event.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="hang-watchdog", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def arm(self, step: Optional[int] = None):
+        with self._lock:
+            self._deadline = time.monotonic() + self.timeout
+            self._step = step
+
+    def disarm(self):
+        with self._lock:
+            self._deadline = None
+
+    @contextlib.contextmanager
+    def guarding(self, step: Optional[int] = None):
+        self.arm(step)
+        try:
+            yield self
+        finally:
+            self.disarm()
+
+    def _run(self):
+        while not self._stop.is_set():
+            with self._lock:
+                deadline, step = self._deadline, self._step
+            if deadline is not None and time.monotonic() > deadline:
+                with self._lock:
+                    self._deadline = None  # one fire per arm
+                self._fire(step)
+            elif self.heartbeat_fn is not None and not hang_event.is_set():
+                try:
+                    self.heartbeat_fn()
+                except Exception:
+                    pass
+            self._stop.wait(self.poll)
+
+    def _fire(self, step):
+        from .. import telemetry
+        self.fired += 1
+        if telemetry.enabled():
+            telemetry.counter(
+                "hang_watchdog_fired_total",
+                "steps whose watchdog deadline expired (host presumed hung)",
+            ).inc()
+        hang_event.set()
+        if self.on_fire is not None:
+            try:
+                self.on_fire(step)
+            except Exception:
+                pass
+        if self.exit_code is not None:
+            os._exit(self.exit_code)
+
+
+def simulate_hang(max_seconds: float = 120.0):
+    """The ``host_hang`` fault body: block like a wedged collective.
+
+    Returns once a watchdog fires (:data:`hang_event`) or after
+    ``max_seconds`` (test safety net). Under hostsim the armed watchdog
+    carries an ``exit_code``, so the process dies inside this call —
+    mid-"collective" — exactly like the real failure.
+    """
+    deadline = time.monotonic() + max_seconds
+    while not hang_event.is_set() and time.monotonic() < deadline:
+        time.sleep(0.02)
+
+
+# -- deterministic step replay ----------------------------------------------
+
+def _fast_forward(loader, batch: int):
+    """Mirror runner._iter_from_cursor: skip `batch` items; a short
+    epoch restarts the iterator (same semantics as the live run)."""
+    it = iter(loader)
+    for _ in range(batch):
+        try:
+            next(it)
+        except StopIteration:
+            return iter(loader)
+    return it
+
+
+def replay_step(ckpt_dir, step: int, trainer_factory: Callable,
+                loader, repeats: int = 2, lr=None) -> Dict[str, Any]:
+    """Re-execute global step ``step`` from checkpoint ``step - 1`` and
+    compare the post-step state digests against the ones recorded in
+    step ``step``'s MANIFEST.
+
+    Each repeat builds a FRESH trainer (same mesh/config as the run,
+    via ``trainer_factory``), restores step−1, restores the saved RNG
+    key and data cursor, fetches the same batch with the runner's
+    epoch-rollover semantics, and runs one train step. Verdicts:
+
+    - ``"ok"``              — replays match each other AND the record.
+    - ``"sdc"``             — replays agree with each other but differ
+      from the record: the recorded state couldn't have come from this
+      software on these inputs → hardware corruption at record time.
+    - ``"nondeterminism"``  — replays disagree with each other: the
+      step itself isn't reproducible; no SDC verdict is possible.
+    - ``"no_reference"``    — step's manifest has no per-array digests.
+    """
+    from ..distributed.checkpoint import MANIFEST_NAME, CheckpointManager
+    from .runner import _meta, _set_rng_key_data
+    if hasattr(ckpt_dir, "restore"):
+        mgr = ckpt_dir
+    else:
+        mgr = CheckpointManager(str(ckpt_dir), use_async=False)
+    manifest = os.path.join(mgr._step_dir(step), MANIFEST_NAME)
+    try:
+        with open(manifest, "r", encoding="utf-8") as f:
+            recorded = json.load(f).get("arrays") or {}
+    except (OSError, ValueError):
+        recorded = {}
+    report: Dict[str, Any] = {"step": int(step), "repeats": int(repeats),
+                              "restored_from": int(step) - 1}
+    if not recorded:
+        report.update(verdict="no_reference", mismatched_keys=[],
+                      replay_mismatch_keys=[])
+        return report
+    runs: List[Dict[str, str]] = []
+    for _ in range(max(1, int(repeats))):
+        trainer = trainer_factory()
+        template = {"trainer": trainer.state, "meta": _meta(0, 0, 0)}
+        restored = mgr.restore(step=step - 1, template=template)
+        if restored is None:
+            report.update(verdict="no_reference", mismatched_keys=[],
+                          replay_mismatch_keys=[],
+                          error="checkpoint %d unrestorable" % (step - 1))
+            return report
+        trainer.state = restored["trainer"]
+        meta = restored["meta"]
+        _set_rng_key_data(meta["rng"])
+        epoch, batch = int(meta["epoch"]), int(meta["batch"])
+        it = _fast_forward(loader, batch)
+        try:
+            inputs, labels = next(it)
+        except StopIteration:
+            epoch, batch = epoch + 1, 0
+            it = iter(loader)
+            inputs, labels = next(it)
+        trainer.train_step(inputs, labels, lr=lr)
+        if hasattr(trainer, "consume_divergence"):
+            trainer.consume_divergence()
+        runs.append(tree_digests(
+            {"trainer": trainer.state, "meta": _meta(step, epoch, batch + 1)}))
+    replay_mismatch = (compare_digests(runs[0], runs[1])
+                       if len(runs) > 1 else [])
+    record_mismatch = compare_digests(recorded, runs[0])
+    if replay_mismatch:
+        verdict = "nondeterminism"
+    elif record_mismatch:
+        verdict = "sdc"
+    else:
+        verdict = "ok"
+    report.update(verdict=verdict, mismatched_keys=record_mismatch,
+                  replay_mismatch_keys=replay_mismatch)
+    return report

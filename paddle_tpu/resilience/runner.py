@@ -30,6 +30,20 @@ transparent resume, the launcher's SIGTERM drain):
   does the skipping. ``host_loss`` raises ``faults.HostLost`` through the
   loop (abrupt death: only a supervisor recovers it); ``host_join``
   materializes a synthetic elastic member.
+- **silent corruption** — when the trainer runs with
+  ``integrity_check_every`` set, its in-graph replica fingerprint check
+  flags diverging replicas; the loop quarantines the outlier
+  (``integrity.quarantine_outliers`` majority vote), skips saving the
+  corrupt state, and ROLLS BACK through the restore path to the last
+  clean checkpoint — bounded by ``max_rollbacks``. Multi-process, the
+  outlier host self-evicts (``HostLost``) and the survivors remesh. The
+  ``param_flip`` fault injects the corruption deterministically.
+- **hang watchdog** — with ``hang_timeout`` set, each step runs under a
+  deadline (``integrity.HangWatchdog``). A wedged step cannot be
+  interrupted host-side, so on firing the watchdog stops pumping
+  heartbeats (peers reclassify this host as lost and remesh) and, with
+  ``hang_exit``, hard-exits the process. The ``host_hang`` fault wedges
+  a step on purpose (``integrity.simulate_hang``).
 """
 from __future__ import annotations
 
@@ -66,6 +80,11 @@ class RunResult:
     remeshes: int = 0      # in-place elastic remeshes (runtime lifetime total)
     barrier_steps: List[int] = dataclasses.field(default_factory=list)
                            # common step of each restore-barrier entry
+    divergences: int = 0   # fingerprint-check replica divergences observed
+    hosts_quarantined: int = 0   # outlier replicas/hosts quarantined
+    rollback_steps: List[int] = dataclasses.field(default_factory=list)
+                           # checkpoint step each divergence rolled back to
+    hangs: int = 0         # hang-watchdog firings
 
 
 class _StopFlag:
@@ -134,12 +153,22 @@ def run_resilient(trainer, loader: Iterable, steps: int,
                   manager=None, save_every: int = 1,
                   elastic=None, lr: Optional[float] = None,
                   max_restarts: int = 2,
-                  handle_signals: bool = True) -> RunResult:
+                  handle_signals: bool = True,
+                  max_rollbacks: int = 2,
+                  hang_timeout: Optional[float] = None,
+                  hang_exit: Optional[int] = None) -> RunResult:
     """Run ``steps`` training steps with checkpoint/resume, signal drain,
     retry-wrapped fetches, and fault-injection hooks. ``loader`` must be
     re-iterable with a deterministic order (the epoch/batch cursor
-    fast-forwards it on resume)."""
+    fast-forwards it on resume).
+
+    ``max_rollbacks`` bounds divergence-quarantine rollbacks (past the
+    bound the run proceeds on the corrupt state rather than live-lock).
+    ``hang_timeout`` (seconds) arms a :class:`integrity.HangWatchdog`
+    around each step; ``hang_exit`` makes a firing hard-exit the process
+    with that code (the supervisor observes it — hostsim's hang path)."""
     from .. import telemetry
+    from . import integrity
     from ..distributed.fleet.elastic import ElasticManager, ElasticStatus
     tel = telemetry.enabled()
     if elastic is True:
@@ -151,8 +180,18 @@ def run_resilient(trainer, loader: Iterable, steps: int,
     if handle_signals:
         stop.install()
     restarts = 0
+    rollbacks = 0
+    divergences = 0
+    quarantined = 0
+    rollback_steps: List[int] = []
     step, epoch, batch = 0, 0, 0
     last_loss = None
+    watchdog = None
+    if hang_timeout:
+        watchdog = integrity.HangWatchdog(
+            hang_timeout,
+            heartbeat_fn=getattr(elastic, "heartbeat", None),
+            exit_code=hang_exit).start()
 
     def _result(exit_code, status, loss=None):
         return RunResult(
@@ -163,7 +202,10 @@ def run_resilient(trainer, loader: Iterable, steps: int,
             restore_fallbacks=getattr(manager, "restore_fallbacks_total", 0),
             remeshes=runtime.remeshes if runtime is not None else 0,
             barrier_steps=(list(runtime.barrier_steps)
-                           if runtime is not None else []))
+                           if runtime is not None else []),
+            divergences=divergences, hosts_quarantined=quarantined,
+            rollback_steps=list(rollback_steps),
+            hangs=watchdog.fired if watchdog is not None else 0)
 
     def _enter(template_comm=None):
         """(Re)entry through the runtime's restore barrier; plain
@@ -216,11 +258,11 @@ def run_resilient(trainer, loader: Iterable, steps: int,
         _resume()
         it = _iter_from_cursor()
         while step < steps:
-            if faults.fires("sigterm", step):
+            if faults.fires("sigterm", step, site="runner_loop"):
                 signal.raise_signal(signal.SIGTERM)
-            if faults.fires("host_loss", step):
+            if faults.fires("host_loss", step, site="runner_loop"):
                 raise faults.HostLost(f"injected host_loss at step {step}")
-            if faults.fires("host_join", step) and \
+            if faults.fires("host_join", step, site="runner_loop") and \
                     hasattr(elastic, "simulate_join"):
                 elastic.simulate_join()
             if stop.signum is not None:
@@ -255,6 +297,7 @@ def run_resilient(trainer, loader: Iterable, steps: int,
             def _fetch():
                 nonlocal it, epoch, batch
                 faults.maybe_raise("data_fetch", step=step,
+                                   site="dataloader_fetch",
                                    msg=f"injected data_fetch at step {step}")
                 try:
                     return next(it)
@@ -267,10 +310,56 @@ def run_resilient(trainer, loader: Iterable, steps: int,
             inputs, labels = call_with_retry(
                 _fetch, site="dataloader_fetch", tries=3, base_delay=0.01)
 
-            taint = float("nan") if faults.fires("nan_grad", step) else None
+            taint = float("nan") if faults.fires(
+                "nan_grad", step, site="train_step") else None
+            flip = faults.fire_spec("param_flip", step, site="train_step")
+            if flip is not None:
+                # deterministic SDC: one mantissa bit on one replica —
+                # only the in-graph fingerprint check can see it
+                integrity.inject_param_flip(trainer, seed=flip.seed,
+                                            step=step)
             try:
-                last_loss = trainer.train_step(inputs, labels, lr=lr,
-                                               grad_taint=taint)
+                if watchdog is not None:
+                    watchdog.arm(step)
+                try:
+                    if faults.fires("host_hang", step, site="train_step"):
+                        # wedge like a stuck collective; released by the
+                        # watchdog firing (or its timeout backstop)
+                        integrity.simulate_hang()
+                    last_loss = trainer.train_step(inputs, labels, lr=lr,
+                                                   grad_taint=taint)
+                finally:
+                    if watchdog is not None:
+                        watchdog.disarm()
+                diverged = (trainer.consume_divergence()
+                            if hasattr(trainer, "consume_divergence")
+                            else [])
+                if diverged:
+                    divergences += 1
+                    q = integrity.quarantine_outliers(
+                        trainer, leaves=diverged, elastic=elastic)
+                    quarantined += q["quarantined"]
+                    if q["action"] == "self_evict":
+                        # this host carries the corrupt replica: die so the
+                        # survivors' elastic runtime remeshes around us
+                        raise faults.HostLost(
+                            f"quarantined after replica divergence at "
+                            f"step {step}: {diverged}")
+                    if rollbacks < max_rollbacks:
+                        # never checkpoint the corrupt state; rewind to the
+                        # last clean step through the restore path
+                        rollbacks += 1
+                        cur = _enter()
+                        rollback_steps.append(
+                            cur[0] if cur is not None else -1)
+                        if cur is not None:
+                            step, epoch, batch = cur[0] + 1, cur[1], cur[2]
+                        else:
+                            step, epoch, batch = 0, 0, 0
+                        it = _iter_from_cursor()
+                        continue
+                    # rollback budget exhausted: proceed (observably —
+                    # the divergence counters keep climbing)
                 batch += 1
                 if manager is not None and (
                         step % save_every == 0 or step == steps - 1):
@@ -300,5 +389,7 @@ def run_resilient(trainer, loader: Iterable, steps: int,
             ).set(skipped)
         return _result(EXIT_OK, "completed", loss=loss_val)
     finally:
+        if watchdog is not None:
+            watchdog.stop()
         if handle_signals:
             stop.uninstall()

@@ -60,9 +60,11 @@ retry_bytes_abandoned_total    counter    resilience.retry byte budget
                                           {site=...}
 ckpt_retry_bytes_abandoned_total counter  checkpoint saves degraded to
                                           local staging
-ckpt_restore_fallbacks_total   counter    CheckpointManager.restore (torn
-                                          checkpoints skipped over)
-resilience_faults_injected_total counter  resilience.faults {kind=...}
+ckpt_restore_fallbacks_total   counter    CheckpointManager.restore steps
+                                          skipped over {reason=manifest|
+                                          deep|restore|staged}
+resilience_faults_injected_total counter  resilience.faults {kind=...,
+                                          site=...}
 resilience_restarts_total      counter    run_resilient crash recoveries
 resilience_resumes_total       counter    run_resilient checkpoint resumes
 resilience_steps_skipped       gauge      run_resilient (NaN-guard skips)
@@ -75,6 +77,16 @@ elastic_remesh_failed_total    counter    remesh attempts that fell back
                                           to the relaunch path (exit 75)
 elastic_residual_dropped_norm_total counter  L2 norm of comm_err rows
                                           dropped by a scale-down remap
+integrity_check_steps_total    counter    engine train steps that ran the
+                                          fingerprint-check program
+replica_divergence_total       counter    replicas disagreeing on a
+                                          parameter fingerprint {leaf=...}
+hosts_quarantined_total        counter    resilience.integrity replicas /
+                                          hosts quarantined by majority
+                                          vote
+hang_watchdog_fired_total      counter    HangWatchdog deadlines blown
+                                          (step armed but not disarmed in
+                                          time)
 =============================  =========  =================================
 
 Multi-host merge: ``telemetry.aggregate.gather_registries()`` allgathers

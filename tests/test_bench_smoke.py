@@ -161,6 +161,84 @@ def test_chaos_host_loss_scenario():
     assert res["merged_metric_count"] > 0
 
 
+def test_chaos_sdc_scenario():
+    """tools/chaos_smoke.py --scenario sdc: the ISSUE 9 acceptance path —
+    a flipped mantissa bit on replica 3 at step 5 is caught by the
+    step-6 in-graph fingerprint check, the outlier replica is
+    quarantined, the run rolls back to the step-4 checkpoint and
+    converges; the non-check program carries zero fingerprint
+    collectives."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_smoke.py"),
+         "--scenario", "sdc"],
+        capture_output=True, text=True, timeout=300, env=_env())
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert lines, f"no stdout; stderr: {proc.stderr[-2000:]}"
+    res = json.loads(lines[-1])
+    assert res["exit_code"] == 0, res
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert res["scenario"] == "sdc"
+    assert res["divergence_detected"] == 1
+    assert res["hosts_quarantined"] == 1
+    assert res["restored_step"] == 4
+    assert res["fingerprint_collectives_nocheck"] == 0
+    assert res["fingerprint_collectives_check"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.multihost(timeout=420)
+def test_chaos_host_hang_scenario():
+    """tools/chaos_smoke.py --scenario host_hang: host1 wedges at step
+    12, its watchdog fires and stops heartbeat pumping, the coordinator
+    reclassifies it as lost on staleness, and the survivors remesh and
+    finish."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_smoke.py"),
+         "--scenario", "host_hang"],
+        capture_output=True, text=True, timeout=400, env=_env())
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert lines, f"no stdout; stderr: {proc.stderr[-2000:]}"
+    res = json.loads(lines[-1])
+    assert res["exit_code"] == 0, res
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert res["hosts_hung"] == 1
+    assert res["remeshes"] >= 1
+
+
+def test_fsck_ckpt_smoke():
+    """tools/fsck_ckpt.py --smoke: shallow fsck catches truncation,
+    deep fsck additionally catches a bit flip whose file CRC was
+    re-attested, and latest_valid_step points at the newest clean step."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fsck_ckpt.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=300, env=_env())
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert lines, f"no stdout; stderr: {proc.stderr[-2000:]}"
+    res = json.loads(lines[-1])
+    assert res["exit_code"] == 0, res
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert res["smoke"] is True
+
+
+@pytest.mark.slow
+def test_replay_step_smoke():
+    """tools/replay_step.py --smoke: replay of a recorded step says
+    ``ok``; after tampering one recorded digest it says ``sdc`` with the
+    tampered key pinned."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "replay_step.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=400, env=_env())
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert lines, f"no stdout; stderr: {proc.stderr[-2000:]}"
+    res = json.loads(lines[-1])
+    assert res["exit_code"] == 0, res
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert res["clean_verdict"] == "ok"
+    assert res["tampered_verdict"] == "sdc"
+
+
 def test_numerics_smoke_cpu():
     """tools/numerics_smoke.py: all kernel-vs-dense checks pass on the
     CPU interpreter; on-chip runs reuse the same script (r3 item 10)."""

@@ -351,6 +351,46 @@ class TestRemesh:
                              "elastic_residual_dropped_norm_total")
         assert got == pytest.approx(dropped, rel=1e-5)
 
+    def test_remap_comm_err_shrink_then_grow_round_trip(
+            self, fresh_registry):
+        """R=2 -> R=1 -> R=2: the surviving prefix row is carried
+        bit-exact through BOTH remaps, the rejoined rank starts from
+        zero, and the shrink counted exactly the dropped row's norm."""
+        tr = _mlp_trainer(data=2)
+        x, y = _loader(n=1)[0]
+        tr.train_step(x, y)
+        tr.train_step(x, y)
+        orig = {k: np.asarray(jax.device_get(v))
+                for k, v in tr.state["comm_err"].items()}
+        assert any(np.abs(v).sum() > 0 for v in orig.values())
+        # shrink: host lost, degree 2 -> 1
+        tr.remesh(build_mesh({"data": 1}))
+        remap_comm_err(orig, tr)
+        mid = {k: np.asarray(jax.device_get(v))
+               for k, v in tr.state["comm_err"].items()}
+        for k in orig:
+            assert mid[k].shape[0] == 1
+            np.testing.assert_array_equal(mid[k][0], orig[k][0])
+        dropped = float(np.sqrt(sum(
+            float((v[1:].astype(np.float64) ** 2).sum())
+            for v in orig.values())))
+        assert _counter_total(
+            fresh_registry, "elastic_residual_dropped_norm_total"
+        ) == pytest.approx(dropped, rel=1e-5)
+        # grow back: replacement host joined, degree 1 -> 2
+        tr.remesh(build_mesh({"data": 2}))
+        remap_comm_err(mid, tr)
+        back = {k: np.asarray(jax.device_get(v))
+                for k, v in tr.state["comm_err"].items()}
+        for k in orig:
+            assert back[k].shape[0] == 2
+            np.testing.assert_array_equal(back[k][0], orig[k][0])
+            np.testing.assert_array_equal(back[k][1], 0.0)
+        # the grow dropped nothing: the counter did not move
+        assert _counter_total(
+            fresh_registry, "elastic_residual_dropped_norm_total"
+        ) == pytest.approx(dropped, rel=1e-5)
+
     def test_remap_comm_err_scale_up_zero_fills(self):
         tr = _mlp_trainer(data=2)
         x, y = _loader(n=1)[0]

@@ -1,0 +1,446 @@
+"""Silent-corruption defense (ISSUE 9): replica-divergence
+fingerprinting, deep checkpoint verify, deterministic step replay, and
+the hang watchdog — plus the runner's detect -> quarantine -> rollback
+loop and the fault kinds that drive it."""
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, telemetry
+from paddle_tpu.distributed import checkpoint as ck
+from paddle_tpu.distributed.checkpoint import CheckpointManager
+from paddle_tpu.distributed.engine import ParallelTrainer
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.resilience import faults, integrity, run_resilient
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    faults.reset()
+    yield
+    faults.reset()
+    integrity.hang_event.clear()
+
+
+@pytest.fixture()
+def fresh_registry():
+    old_reg = telemetry.get_registry()
+    old_on = telemetry.enabled()
+    reg = telemetry.Registry()
+    telemetry._set_registry(reg)
+    telemetry.enable(True)
+    yield reg
+    telemetry._set_registry(old_reg)
+    telemetry.enable(old_on)
+
+
+def _series_total(reg, name):
+    series = reg.to_dict().get(name, {}).get("series", {})
+    return sum(series.values())
+
+
+def _mlp_trainer(data=4, check_every=2, seed=7):
+    paddle.seed(seed)
+    mesh = build_mesh({"data": data})
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(8, 16)
+            self.l2 = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.l2(nn.functional.relu(self.l1(x)))
+
+    model = MLP()
+    opt = paddle.optimizer.Momentum(0.05, momentum=0.9,
+                                    parameters=model.parameters())
+    return ParallelTrainer(model, opt,
+                           lambda out, y: jnp.mean((out - y) ** 2),
+                           mesh=mesh, grad_sync="int8", grad_sync_block=8,
+                           integrity_check_every=check_every)
+
+
+def _loader(n=8, batch=8, seed=3):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(batch, 8).astype(np.float32),
+             rng.randn(batch, 4).astype(np.float32)) for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# fingerprint + digest primitives
+# ---------------------------------------------------------------------------
+
+class TestFingerprint:
+    def test_deterministic_and_order_stable(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(37, 5),
+                        dtype=jnp.float32)
+        a = int(integrity.fingerprint_array(x))
+        b = int(integrity.fingerprint_array(x))
+        assert a == b
+        assert int(jax.jit(integrity.fingerprint_array)(x)) == a
+
+    def test_single_low_bit_flip_changes_fingerprint(self):
+        x = np.random.RandomState(1).randn(64).astype(np.float32)
+        base = int(integrity.fingerprint_array(jnp.asarray(x)))
+        flipped = x.copy()
+        flipped.view(np.uint32)[17] ^= np.uint32(1)  # lowest mantissa bit
+        assert int(integrity.fingerprint_array(jnp.asarray(flipped))) != base
+
+    def test_bf16_and_int_dtypes_supported(self):
+        for arr in (jnp.asarray([1.5, -2.25, 3.0], dtype=jnp.bfloat16),
+                    jnp.asarray([1, 2, 3], dtype=jnp.int32),
+                    jnp.asarray([True, False, True]),
+                    jnp.asarray([1.0, 2.0], dtype=jnp.float64)
+                    if jax.config.jax_enable_x64 else
+                    jnp.asarray([1.0, 2.0], dtype=jnp.float16)):
+            fp = integrity.fingerprint_array(arr)
+            assert fp.dtype == jnp.uint32
+            assert int(fp) == int(integrity.fingerprint_array(arr))
+
+    def test_same_bytes_different_dtype_differ(self):
+        # dtype is mixed into the checksum: a bitcast must not collide
+        f = jnp.asarray([1.0, 2.0, 3.0], dtype=jnp.float32)
+        i = jax.lax.bitcast_convert_type(f, jnp.int32)
+        assert int(integrity.fingerprint_array(f)) != int(
+            integrity.fingerprint_array(i))
+
+    def test_tree_digests_and_compare(self):
+        tree = {"a": np.arange(6, dtype=np.float32),
+                "b": {"c": np.ones(3, dtype=np.int32)}}
+        d1 = integrity.tree_digests(tree)
+        assert set(d1) == {"['a']", "['b']['c']"}
+        tree2 = {"a": tree["a"].copy(), "b": {"c": tree["b"]["c"].copy()}}
+        tree2["a"][2] += 1.0
+        d2 = integrity.tree_digests(tree2)
+        assert integrity.compare_digests(d1, d2) == ["['a']"]
+        assert integrity.compare_digests(d1, d1) == []
+
+
+# ---------------------------------------------------------------------------
+# in-graph divergence check (engine integration)
+# ---------------------------------------------------------------------------
+
+class TestDivergenceCheck:
+    def test_two_program_cache_and_zero_overhead_contract(self):
+        tr = _mlp_trainer()
+        x, y = _loader(n=1)[0]
+        for _ in range(5):
+            tr.train_step(x, y)
+            assert tr.consume_divergence() == []
+        # cadence 2 over 5 steps: exactly two programs, no recompiles
+        assert len(tr._step_cache) == 2
+        # the plain program carries ZERO fingerprint collectives; the
+        # check program carries them (walker-verified on the jaxpr)
+        assert integrity.count_fingerprint_collectives(
+            tr.staged_jaxpr(x, y, do_check=False)) == 0
+        assert integrity.count_fingerprint_collectives(
+            tr.staged_jaxpr(x, y, do_check=True)) > 0
+
+    def test_flip_detected_on_next_check_step_and_quarantined(
+            self, fresh_registry):
+        tr = _mlp_trainer()
+        x, y = _loader(n=1)[0]
+        tr.train_step(x, y)
+        tr.train_step(x, y)  # steps_run=2 (check) — clean
+        assert tr.consume_divergence() == []
+        info = integrity.inject_param_flip(tr, seed=3, step=5)
+        assert info["replica"] >= 1  # replica 0 (the save source) stays clean
+        tr.train_step(x, y)          # steps_run=3: no check, flip invisible
+        assert tr.consume_divergence() == []
+        tr.train_step(x, y)          # steps_run=4: check — flip detected
+        diverged = tr.consume_divergence()
+        assert diverged and any(info["leaf"] in n for n in diverged)
+        assert tr.consume_divergence() == []  # consumed
+        q = integrity.quarantine_outliers(tr, diverged)
+        assert q["outlier_replicas"] == [info["replica"]]
+        assert q["action"] == "rollback"  # single process
+        assert q["quarantined"] == 1
+        assert _series_total(fresh_registry, "hosts_quarantined_total") == 1
+
+    def test_param_flip_is_deterministic_in_seed_and_step(self):
+        tr = _mlp_trainer()
+        a = integrity.inject_param_flip(tr, seed=11, step=5)
+        b = integrity.inject_param_flip(_mlp_trainer(), seed=11, step=5)
+        assert (a["leaf"], a["replica"], a["element"], a["bit"]) == \
+            (b["leaf"], b["replica"], b["element"], b["bit"])
+
+    def test_runner_detects_quarantines_and_rolls_back(
+            self, fresh_registry, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), use_async=False,
+                                max_to_keep=12)
+        with faults.inject("param_flip", at_step=5, seed=11) as f:
+            res = run_resilient(_mlp_trainer(), _loader(), steps=8,
+                                manager=mgr, save_every=1,
+                                handle_signals=False)
+        assert f.fired == 1
+        assert res.exit_code == 0
+        assert res.divergences == 1
+        assert res.hosts_quarantined == 1
+        assert res.rollback_steps and res.rollback_steps[0] >= 3
+        assert res.steps_done == 8
+        assert _series_total(
+            fresh_registry, "replica_divergence_total") >= 1
+        assert _series_total(
+            fresh_registry, "integrity_check_steps_total") >= 1
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# deep checkpoint verify
+# ---------------------------------------------------------------------------
+
+def _largest_payload(step_dir):
+    """The merged-d/ data blob reads actually hit (NOT the per-process
+    ocdbt duplicate)."""
+    best, size = None, -1
+    for r, _d, names in os.walk(step_dir):
+        if "ocdbt.process_" in r:
+            continue
+        for n in names:
+            if n.startswith("MANIFEST"):
+                continue
+            p = os.path.join(r, n)
+            sz = os.path.getsize(p)
+            if sz > size:
+                best, size = p, sz
+    return best
+
+
+def _tamper_reattested(step_dir):
+    """Flip one payload byte, then re-attest the file CRC: the shallow
+    layer passes, only content digests can catch it."""
+    p = _largest_payload(step_dir)
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0x01]))
+    mpath = os.path.join(step_dir, ck.MANIFEST_NAME)
+    with open(mpath) as f:
+        man = json.load(f)
+    man["files"][os.path.relpath(p, step_dir)] = {
+        "size": os.path.getsize(p), "crc32": ck._crc_file(p)}
+    with open(mpath, "w") as f:
+        json.dump(man, f)
+
+
+class TestDeepVerify:
+    def _saved_mgr(self, tmp_path, steps=(1, 2, 3)):
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), use_async=False,
+                                max_to_keep=8)
+        rng = np.random.RandomState(0)
+        state = {"w": rng.randn(64, 8).astype(np.float32),
+                 "b": rng.randn(8).astype(np.float32)}
+        for s in steps:
+            assert mgr.save(s, state)
+        return mgr, state
+
+    def test_manifest_records_array_digests_and_sizes(self, tmp_path):
+        mgr, state = self._saved_mgr(tmp_path)
+        with open(os.path.join(mgr._step_dir(2), ck.MANIFEST_NAME)) as f:
+            man = json.load(f)
+        assert man["files"] and all(
+            "size" in m and "crc32" in m for m in man["files"].values())
+        assert set(man["arrays"]) == {"['w']", "['b']"}
+        assert man["arrays"]["['w']"] == integrity.array_digest(state["w"])
+        mgr.close()
+
+    def test_shallow_passes_deep_catches_reattested_tamper(self, tmp_path):
+        mgr, _ = self._saved_mgr(tmp_path)
+        assert mgr.verify(2) is True
+        assert mgr.verify(2, deep=True) is True
+        _tamper_reattested(mgr._step_dir(2))
+        assert mgr.verify(2) is True            # file layer is fooled
+        assert mgr.verify(2, deep=True) is False  # content digests are not
+        mgr.close()
+
+    def test_restore_falls_back_past_deep_corrupt_with_reason(
+            self, tmp_path, fresh_registry):
+        mgr, _ = self._saved_mgr(tmp_path)
+        _tamper_reattested(mgr._step_dir(3))
+        out = mgr.restore(deep=True)
+        assert out is not None
+        assert mgr.last_restored_step == 2
+        reg = fresh_registry.get("ckpt_restore_fallbacks_total")
+        assert reg.value(reason="deep") == 1
+        mgr.close()
+
+    def test_explicit_step_deep_restore_raises_on_mismatch(self, tmp_path):
+        mgr, _ = self._saved_mgr(tmp_path)
+        mpath = os.path.join(mgr._step_dir(2), ck.MANIFEST_NAME)
+        with open(mpath) as f:
+            man = json.load(f)
+        man["arrays"]["['w']"] = "crc32:00000000:0"
+        with open(mpath, "w") as f:
+            json.dump(man, f)
+        with pytest.raises(OSError, match="deep verification"):
+            mgr.restore(step=2, deep=True)
+        mgr.restore(step=2)  # shallow path still restores
+        mgr.close()
+
+    def test_latest_valid_step_size_prereject(self, tmp_path):
+        mgr, _ = self._saved_mgr(tmp_path)
+        assert mgr.latest_valid_step() == 3
+        p = _largest_payload(mgr._step_dir(3))
+        with open(p, "r+b") as f:
+            f.truncate(max(1, os.path.getsize(p) // 2))
+        mgr._vcache.clear()
+        # the size-only pre-pass rejects step 3 without any CRC read
+        assert ck.verify_manifest(mgr._step_dir(3), level="size") is False
+        assert mgr.latest_valid_step() == 2
+        mgr.close()
+
+    def test_deep_digests_opt_out(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), use_async=False,
+                                deep_digests=False)
+        mgr.save(1, {"w": np.ones(4, dtype=np.float32)})
+        with open(os.path.join(mgr._step_dir(1), ck.MANIFEST_NAME)) as f:
+            man = json.load(f)
+        assert "arrays" not in man
+        # verify(deep=True) degrades to the shallow verdict, not False
+        assert mgr.verify(1, deep=True) is True
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# deterministic step replay
+# ---------------------------------------------------------------------------
+
+class TestReplay:
+    def test_replay_ok_then_sdc_on_tampered_record(self, tmp_path):
+        root = str(tmp_path / "ckpt")
+        loader = _loader(n=3)  # short epoch: exercises cursor rollover
+
+        def factory():
+            return _mlp_trainer(check_every=0)
+
+        mgr = CheckpointManager(root, use_async=False, max_to_keep=8)
+        res = run_resilient(factory(), loader, steps=4, manager=mgr,
+                            save_every=1, handle_signals=False)
+        mgr.close()
+        assert res.exit_code == 0
+        rep = integrity.replay_step(root, 3, factory, loader)
+        assert rep["verdict"] == "ok", rep
+        assert rep["restored_from"] == 2
+        assert rep["mismatched_keys"] == []
+        # tamper ONE recorded digest: replays still agree with each
+        # other, so the mismatch is pinned on the record — SDC
+        mpath = os.path.join(root, "3", ck.MANIFEST_NAME)
+        with open(mpath) as f:
+            man = json.load(f)
+        key = sorted(k for k in man["arrays"] if "params" in k)[0]
+        man["arrays"][key] = "crc32:deadbeef:1"
+        with open(mpath, "w") as f:
+            json.dump(man, f)
+        rep2 = integrity.replay_step(root, 3, factory, loader)
+        assert rep2["verdict"] == "sdc", rep2
+        assert rep2["mismatched_keys"] == [key]
+        assert rep2["replay_mismatch_keys"] == []
+
+    def test_replay_without_digests_reports_no_reference(self, tmp_path):
+        root = str(tmp_path / "ckpt")
+        loader = _loader(n=3)
+
+        def factory():
+            return _mlp_trainer(check_every=0)
+
+        mgr = CheckpointManager(root, use_async=False, deep_digests=False)
+        run_resilient(factory(), loader, steps=3, manager=mgr,
+                      save_every=1, handle_signals=False)
+        mgr.close()
+        rep = integrity.replay_step(root, 2, factory, loader)
+        assert rep["verdict"] == "no_reference"
+
+
+# ---------------------------------------------------------------------------
+# hang watchdog
+# ---------------------------------------------------------------------------
+
+class TestHangWatchdog:
+    def test_fires_on_deadline_and_sets_event(self, fresh_registry):
+        beats = []
+        wd = integrity.HangWatchdog(0.15, heartbeat_fn=lambda:
+                                    beats.append(time.monotonic()),
+                                    poll=0.02).start()
+        try:
+            wd.arm(step=0)
+            integrity.simulate_hang(max_seconds=5.0)
+            assert integrity.hang_event.is_set()
+            assert wd.fired == 1
+        finally:
+            wd.stop()
+        assert beats  # it pumped heartbeats while healthy
+        assert _series_total(
+            fresh_registry, "hang_watchdog_fired_total") == 1
+
+    def test_disarm_prevents_firing(self):
+        wd = integrity.HangWatchdog(0.1, poll=0.02).start()
+        try:
+            wd.arm(step=0)
+            wd.disarm()
+            time.sleep(0.3)
+            assert wd.fired == 0
+            assert not integrity.hang_event.is_set()
+        finally:
+            wd.stop()
+
+    def test_heartbeats_stop_after_firing(self):
+        beats = []
+        wd = integrity.HangWatchdog(0.1, heartbeat_fn=lambda:
+                                    beats.append(1), poll=0.02).start()
+        try:
+            wd.arm(step=0)
+            integrity.simulate_hang(max_seconds=5.0)
+            n = len(beats)
+            time.sleep(0.2)
+            assert len(beats) == n  # a fired watchdog stops advertising
+        finally:
+            wd.stop()
+
+    def test_runner_host_hang_in_process(self, fresh_registry, tmp_path):
+        """host_hang wedges one step; with hang_exit=None the watchdog
+        firing releases the wedge and the run completes, counting the
+        hang."""
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), use_async=False)
+        with faults.inject("host_hang", at_step=2) as f:
+            res = run_resilient(_mlp_trainer(check_every=0), _loader(),
+                                steps=4, manager=mgr, save_every=2,
+                                handle_signals=False, hang_timeout=0.3)
+        assert f.fired == 1
+        assert res.exit_code == 0
+        assert res.hangs >= 1
+        assert res.steps_done == 4
+        assert _series_total(
+            fresh_registry, "hang_watchdog_fired_total") >= 1
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# fault kinds + site labels
+# ---------------------------------------------------------------------------
+
+class TestFaultKinds:
+    def test_new_kinds_registered(self):
+        assert "param_flip" in faults.KINDS
+        assert "host_hang" in faults.KINDS
+
+    def test_fire_spec_returns_the_spec(self):
+        with faults.inject("param_flip", at_step=5, seed=42) as f:
+            assert faults.fire_spec("param_flip", step=4) is None
+            spec = faults.fire_spec("param_flip", step=5)
+            assert spec is f and spec.seed == 42
+
+    def test_site_label_recorded(self, fresh_registry):
+        with faults.inject("host_hang", at_step=0):
+            assert faults.fires("host_hang", step=0, site="train_step")
+        c = fresh_registry.get("resilience_faults_injected_total")
+        assert c.value(kind="host_hang", site="train_step") == 1

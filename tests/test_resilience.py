@@ -84,8 +84,13 @@ class TestFaults:
         try:
             with faults.inject("nan_grad", at_step=0):
                 faults.fires("nan_grad", step=0)
+            with faults.inject("nan_grad", at_step=1):
+                faults.fires("nan_grad", step=1, site="train_step")
+            # the fired-fault series records the consulting SITE too
             assert reg.get("resilience_faults_injected_total").value(
-                kind="nan_grad") == 1
+                kind="nan_grad", site="unspecified") == 1
+            assert reg.get("resilience_faults_injected_total").value(
+                kind="nan_grad", site="train_step") == 1
         finally:
             telemetry.disable()
             telemetry._set_registry(prev)

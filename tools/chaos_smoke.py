@@ -24,9 +24,25 @@ stale heartbeat, remesh, and resume to completion::
     {"scenario": "host_loss", "hosts_lost": 1, "remeshes": 1,
      "barrier_steps": [8, ...], "restored_step": 8, ...}
 
+``--scenario sdc`` runs the silent-corruption defense end-to-end: a
+4-replica trainer with the in-graph fingerprint check every 2 steps, a
+``param_flip`` fault flipping one mantissa bit on one replica at step 5.
+The check must detect the divergence (naming the leaf), quarantine the
+outlier replica by majority vote, roll back to the last clean
+checkpoint, and converge — and the NON-check step's jaxpr must carry
+zero fingerprint collectives::
+
+    {"scenario": "sdc", "divergence_detected": 1, "hosts_quarantined": 1,
+     "restored_step": 4, "fingerprint_collectives_nocheck": 0, ...}
+
+``--scenario host_hang`` wedges host1 mid-step at step 12 (a stuck
+collective); its hang watchdog fires, stops its heartbeats, and exits
+with code 10 — the survivors detect staleness and remesh exactly as for
+a machine loss.
+
 Run: ``python tools/chaos_smoke.py [--steps 10] [--ckpt-dir DIR]``
 (also wired as a ``-m 'not slow'`` pytest in tests/test_resilience.py;
-the host_loss scenario in tests/test_bench_smoke.py).
+the host_loss/sdc/host_hang scenarios in tests/test_bench_smoke.py).
 """
 from __future__ import annotations
 
@@ -62,6 +78,29 @@ def build_trainer(seed: int = 0):
         model, opt,
         lambda logits, lbl: nn.functional.cross_entropy(logits, lbl),
         mesh=mesh, grad_sync="int8", grad_sync_block=64), jnp
+
+
+def build_sdc_trainer(seed: int = 0, check_every: int = 2):
+    """4-way data-replicated GPT with the in-graph integrity check armed
+    — enough replicas for an unambiguous majority vote."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.engine import ParallelTrainer
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.text.models import GPTForPretraining
+
+    paddle.seed(seed)
+    mesh = build_mesh({"data": 4})
+    model = GPTForPretraining(
+        tensor_parallel=False, vocab_size=128, hidden_size=32,
+        num_layers=1, num_heads=2, max_position_embeddings=16,
+        attn_dropout=0.0, hidden_dropout=0.0)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    return ParallelTrainer(
+        model, opt,
+        lambda logits, lbl: nn.functional.cross_entropy(logits, lbl),
+        mesh=mesh, grad_sync="int8", grad_sync_block=64,
+        integrity_check_every=check_every)
 
 
 def make_loader(n_batches: int = 4, batch: int = 4, seq: int = 16,
@@ -157,6 +196,78 @@ def run_host_loss(steps: int, root: str):
     }
 
 
+def run_sdc(steps: int, ckpt_dir: str):
+    """Silent-corruption scenario (see module docstring). Returns the
+    one-line summary dict."""
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+    from paddle_tpu.resilience import faults, integrity, run_resilient
+
+    trainer = build_sdc_trainer()
+    loader = make_loader()
+    manager = CheckpointManager(ckpt_dir, max_to_keep=steps + 2,
+                                use_async=False)
+    # zero-overhead contract: the plain program must carry NO fingerprint
+    # collectives; the check program must carry them
+    x, y = loader[0]
+    nocheck = integrity.count_fingerprint_collectives(
+        trainer.staged_jaxpr(x, y, do_check=False))
+    check = integrity.count_fingerprint_collectives(
+        trainer.staged_jaxpr(x, y, do_check=True))
+    with faults.inject("param_flip", at_step=5, seed=11) as f_flip:
+        res = run_resilient(trainer, loader, steps, manager=manager,
+                            save_every=1)
+    ok = (res.exit_code == 0 and f_flip.fired == 1
+          and res.divergences >= 1 and res.hosts_quarantined >= 1
+          and bool(res.rollback_steps)
+          and nocheck == 0 and check > 0)
+    return {
+        "scenario": "sdc",
+        "divergence_detected": int(res.divergences > 0),
+        "hosts_quarantined": res.hosts_quarantined,
+        "restored_step": res.rollback_steps[0] if res.rollback_steps
+        else None,
+        "fingerprint_collectives_nocheck": nocheck,
+        "fingerprint_collectives_check": check,
+        "divergences": res.divergences,
+        "steps_done": res.last_step + 1,
+        "loss": res.loss,
+        "exit_code": 0 if ok else 1,
+    }
+
+
+def run_host_hang(steps: int, root: str):
+    """Hang-watchdog scenario: host1 wedges mid-step at step 12; its
+    watchdog must fire (exit 10, heartbeats stop) and the survivors must
+    remesh around it like a machine loss."""
+    from paddle_tpu.resilience import hostsim
+
+    # hang detection is inherently slower than a crash: the watchdog
+    # must time out (3s) and THEN the heartbeat must go stale (1s) —
+    # pace the survivors so that lands mid-run, not after they finish
+    cluster = hostsim.SimCluster(root, n_hosts=3, np_spec="2:3",
+                                 steps=max(steps, 30), hb_timeout=1.0,
+                                 step_delay=0.3, hang_timeout=3.0)
+    out = cluster.run(faults={1: [("host_hang", 12)]}, timeout=280)
+    survivors = [r for r in out["results"].values() if r]
+    if not survivors:
+        return {"scenario": "host_hang", "hosts_hung": out["hosts_hung"],
+                "exit_code": 1, "error": "no surviving host wrote results",
+                "worker_exit_codes": out["exit_codes"],
+                "stderr": out["stderr"]}
+    ok = (out["hosts_hung"] == 1 and len(survivors) == 2
+          and all(r["exit_code"] == 0 for r in survivors)
+          and max(r["remeshes"] for r in survivors) >= 1)
+    return {
+        "scenario": "host_hang",
+        "hosts_hung": out["hosts_hung"],
+        "hosts_lost": out["hosts_lost"],
+        "remeshes": max(r["remeshes"] for r in survivors),
+        "steps_done": min(r["steps_done"] for r in survivors),
+        "worker_exit_codes": out["exit_codes"],
+        "exit_code": 0 if ok else 1,
+    }
+
+
 def run_plain(steps: int, ckpt_dir: str):
     """Fault-free twin of run_chaos (same seed/data) for loss comparison."""
     from paddle_tpu.distributed.checkpoint import CheckpointManager
@@ -179,14 +290,21 @@ def main(argv=None) -> int:
                    help="telemetry run dir (metrics.prom / events.jsonl)")
     p.add_argument("--plain", action="store_true",
                    help="fault-free reference run instead of the chaos loop")
-    p.add_argument("--scenario", choices=["faults", "host_loss"],
+    p.add_argument("--scenario",
+                   choices=["faults", "host_loss", "sdc", "host_hang"],
                    default="faults",
                    help="faults: the in-process chaos loop (default); "
-                        "host_loss: the 3-subprocess elastic scenario")
+                        "host_loss: the 3-subprocess elastic scenario; "
+                        "sdc: silent-corruption detect/quarantine/rollback; "
+                        "host_hang: wedged host + hang watchdog")
     args = p.parse_args(argv)
     ckpt = args.ckpt_dir or tempfile.mkdtemp(prefix="chaos_ckpt_")
     if args.scenario == "host_loss":
         out = run_host_loss(max(args.steps, 24), ckpt)
+    elif args.scenario == "sdc":
+        out = run_sdc(max(args.steps, 10), ckpt)
+    elif args.scenario == "host_hang":
+        out = run_host_hang(max(args.steps, 24), ckpt)
     elif args.plain:
         out = run_plain(args.steps, ckpt)
     else:

@@ -1,0 +1,168 @@
+"""Offline checkpoint integrity checker (fsck for checkpoint trees).
+
+Walks every step directory under a CheckpointManager root and reports a
+three-valued verdict per step:
+
+- ``ok``        manifest present, every attested file matches (and, with
+  ``--deep``, the restored arrays re-hash to the content digests the
+  manifest recorded at save time)
+- ``corrupt``   the file layer or the decoded values fail verification
+- ``unattested`` no manifest (a legacy step) or no content digests
+  recorded (``--deep`` on a shallow-only manifest)
+
+Shallow checks read bytes (size + CRC32); ``--deep`` additionally
+restores each step's payload host-side and re-hashes every array — the
+only level that catches rot which decodes cleanly into wrong values.
+
+Prints ONE line of JSON and exits 0 (all steps ok), 1 (any corrupt), or
+2 (usage/unreadable root)::
+
+    {"root": ..., "steps": {"8": "ok", "9": "corrupt"},
+     "latest_valid_step": 8, "corrupt": 1, "exit_code": 1}
+
+``--smoke`` self-tests the checker on a throwaway tree: three saved
+steps, one tampered so the file layer still passes but the decoded
+values do not (deep-only catch), one truncated (shallow catch).
+
+Run: ``python tools/fsck_ckpt.py CKPT_DIR [--deep] [--json]``
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+from _mesh_setup import ensure_repo_on_path, force_host_devices
+
+ensure_repo_on_path()
+force_host_devices(8)
+
+
+def fsck(root: str, deep: bool = False) -> dict:
+    """Check every step under ``root``; returns the summary dict."""
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+    if not os.path.isdir(root):
+        return {"root": root, "error": "not a directory", "exit_code": 2}
+    mgr = CheckpointManager(root, use_async=False, deep_digests=False)
+    steps = sorted(mgr.all_steps() or [])
+    verdicts = {}
+    for s in steps:
+        v = mgr.verify(s, deep=deep)
+        verdicts[str(s)] = ("ok" if v is True
+                            else "corrupt" if v is False else "unattested")
+    corrupt = sum(1 for v in verdicts.values() if v == "corrupt")
+    # newest step this run did NOT prove corrupt (at the checked depth —
+    # the manager's own latest_valid_step() is shallow-only)
+    latest_valid = next((s for s in reversed(steps)
+                         if verdicts[str(s)] != "corrupt"), None)
+    out = {
+        "root": os.path.abspath(root),
+        "deep": deep,
+        "steps": verdicts,
+        "steps_checked": len(steps),
+        "latest_valid_step": latest_valid,
+        "corrupt": corrupt,
+        "exit_code": 0 if corrupt == 0 and steps else (1 if corrupt else 2),
+    }
+    mgr.close()
+    return out
+
+
+def _smoke() -> dict:
+    """Self-test: the checker must pass a clean tree, catch a deep-only
+    value corruption, and catch a truncation."""
+    import numpy as np
+
+    from paddle_tpu.distributed import checkpoint as ck
+
+    root = tempfile.mkdtemp(prefix="fsck_smoke_")
+    mgr = ck.CheckpointManager(root, use_async=False, max_to_keep=5)
+    rng = np.random.RandomState(0)
+    state = {"w": rng.randn(64, 8).astype(np.float32),
+             "b": rng.randn(8).astype(np.float32)}
+    for s in (1, 2, 3):
+        mgr.save(s, state)
+    mgr.close()
+
+    clean = fsck(root, deep=True)
+
+    def _largest_payload(step: int) -> str:
+        best, size = None, -1
+        sdir = os.path.join(root, str(step))
+        for r, _d, names in os.walk(sdir):
+            if "ocdbt.process_" in r:
+                continue  # per-process duplicate; reads go to merged d/
+            for n in names:
+                if n.startswith("MANIFEST"):
+                    continue
+                p = os.path.join(r, n)
+                sz = os.path.getsize(p)
+                if sz > size:
+                    best, size = p, sz
+        return best
+
+    # step 2: flip a payload byte, then re-attest the file CRC so the
+    # shallow layer passes — only --deep can catch it
+    p2 = _largest_payload(2)
+    with open(p2, "r+b") as f:
+        f.seek(os.path.getsize(p2) // 2)
+        b = f.read(1)
+        f.seek(os.path.getsize(p2) // 2)
+        f.write(bytes([b[0] ^ 0x01]))
+    sdir2 = os.path.join(root, "2")
+    mpath = os.path.join(sdir2, ck.MANIFEST_NAME)
+    with open(mpath) as f:
+        man = json.load(f)
+    rel = os.path.relpath(p2, sdir2)
+    man["files"][rel] = {"size": os.path.getsize(p2),
+                         "crc32": ck._crc_file(p2)}
+    with open(mpath, "w") as f:
+        json.dump(man, f)
+    # step 3: truncate — the shallow size check alone must catch it
+    p3 = _largest_payload(3)
+    with open(p3, "r+b") as f:
+        f.truncate(max(1, os.path.getsize(p3) // 2))
+
+    shallow = fsck(root)
+    deep = fsck(root, deep=True)
+    ok = (clean["exit_code"] == 0
+          and all(v == "ok" for v in clean["steps"].values())
+          and shallow["steps"]["2"] == "ok"       # shallow is fooled
+          and shallow["steps"]["3"] == "corrupt"
+          and deep["steps"]["1"] == "ok"
+          and deep["steps"]["2"] == "corrupt"     # deep is not
+          and deep["steps"]["3"] == "corrupt"
+          and deep["latest_valid_step"] == 1)
+    return {"smoke": True, "clean": clean["steps"],
+            "shallow": shallow["steps"], "deep": deep["steps"],
+            "latest_valid_step_deep": deep["latest_valid_step"],
+            "exit_code": 0 if ok else 1}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("root", nargs="?", default=None,
+                   help="CheckpointManager directory to check")
+    p.add_argument("--deep", action="store_true",
+                   help="restore payloads and re-hash arrays against the "
+                        "manifest content digests")
+    p.add_argument("--smoke", action="store_true",
+                   help="self-test on a throwaway checkpoint tree")
+    p.add_argument("--json", action="store_true",
+                   help="(default) print the one-line JSON summary")
+    args = p.parse_args(argv)
+    if args.smoke:
+        out = _smoke()
+    elif args.root is None:
+        p.error("root directory required (or --smoke)")
+    else:
+        out = fsck(args.root, deep=args.deep)
+    print(json.dumps(out))
+    return out["exit_code"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())
