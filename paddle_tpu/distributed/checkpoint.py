@@ -338,12 +338,19 @@ class CheckpointManager:
     ``restore()`` (no explicit step) scans newest→oldest, skipping steps
     that fail verification or error mid-restore, counting each skip in
     ``ckpt_restore_fallbacks_total``.
+
+    ``deep_digests=True`` (opt-in) records per-array content digests in
+    the manifest so ``verify(step, deep=True)`` / ``restore(deep=True)``
+    and ``replay_step`` have a value-level reference. The digests are
+    computed from the live state on the save path — a full device→host
+    transfer plus CRC32 per save, which serializes against async writes
+    — so it stays off unless the integrity features are wanted.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  save_interval_steps: int = 1, use_async: bool = True,
                  staging_dir: Optional[str] = None,
-                 deep_digests: bool = True):
+                 deep_digests: bool = False):
         import orbax.checkpoint as ocp
         self._dir = os.path.abspath(directory)
         self._staging = staging_dir or os.path.join(
@@ -523,20 +530,26 @@ class CheckpointManager:
         except (OSError, ValueError):
             return None
 
-    def _deep_verify(self, step: int) -> Optional[bool]:
+    def _deep_verify(self, step: int, template: Optional[Any] = None):
         """Restore the step's payload and re-hash every array against the
-        digests recorded at save time. ``True`` — all match; ``False`` —
-        a mismatch or an unreadable payload (rot the file CRCs re-encoded
-        away, or plain corruption); ``None`` — no digests recorded."""
+        digests recorded at save time. Returns ``(verdict, payload)``:
+        ``True`` — all match, and ``payload`` is the restored tree so a
+        deep restore can reuse it instead of reading the step a second
+        time; ``False`` — a mismatch or an unreadable payload (rot the
+        file CRCs re-encoded away, or plain corruption); ``None`` — no
+        digests recorded. ``payload`` is None unless the verdict is
+        ``True``."""
         from ..resilience.integrity import compare_digests, tree_digests
         recorded = self._manifest_arrays(step)
         if not recorded:
-            return None
+            return None, None
         try:
-            out = self._restore_step(step, None)
+            out = self._restore_step(step, template)
         except Exception:
-            return False
-        return not compare_digests(recorded, tree_digests(out))
+            return False, None
+        if compare_digests(recorded, tree_digests(out)):
+            return False, None
+        return True, out
 
     def verify(self, step: int, deep: bool = False) -> Optional[bool]:
         """On-demand integrity check of a committed step. Shallow verifies
@@ -548,7 +561,7 @@ class CheckpointManager:
         shallow = self._verify(step)
         if shallow is False or not deep:
             return shallow
-        dv = self._deep_verify(step)
+        dv, _ = self._deep_verify(step)
         if dv is None:  # no digests recorded: report the shallow verdict
             return shallow
         return dv
@@ -586,11 +599,20 @@ class CheckpointManager:
             if self._verify(s) is False:
                 self._count_fallbacks(1, reason="manifest")
                 continue
-            if deep and self._deep_verify(s) is False:
-                # bytes check out but the decoded values do not — silent
-                # corruption between the file layer and the arrays
-                self._count_fallbacks(1, reason="deep")
-                continue
+            if deep:
+                t0 = time.perf_counter()
+                dv, out = self._deep_verify(s, template)
+                if dv is False:
+                    # bytes check out but the decoded values do not —
+                    # silent corruption between file layer and arrays
+                    self._count_fallbacks(1, reason="deep")
+                    continue
+                if dv:
+                    # the verified payload IS the restore — one read
+                    _record("restore", time.perf_counter() - t0, out)
+                    self.last_restored_step = s
+                    return out
+                # dv None: no digests recorded — plain restore below
             try:
                 t0 = time.perf_counter()
                 out = call_with_retry(self._restore_step, s, template,

@@ -12,8 +12,12 @@ torn checkpoints, killed hosts. This module is the quiet-failure layer:
   bytes differ — a flipped bit, a bad chip, a divergent update.
 - **Majority-vote quarantine** (:func:`quarantine_outliers`): once the
   step flags divergence, host-side shard digests identify WHICH replica
-  disagrees; the majority fingerprint wins and the outlier's host is
-  evicted (multi-host) or the state is rolled back (single-host).
+  disagrees; each host attests the replicas it can address, the chains
+  are allgathered over the elastic coordinator, and the majority
+  fingerprint wins — the outlier's host is evicted (multi-host) or the
+  state is rolled back (single-host). Without a coordinator the vote
+  abstains from eviction unless the local view already proves a global
+  majority.
 - **Host content digests** (:func:`tree_digests`): per-array crc32
   recorded into MANIFEST.json at save time so
   ``CheckpointManager.verify(step, deep=True)`` can catch write-path
@@ -59,9 +63,9 @@ from jax import lax
 __all__ = [
     "FINGERPRINT_COLLECTIVES", "fingerprint_array", "fingerprint_tree",
     "count_fingerprint_collectives", "array_digest", "tree_digests",
-    "compare_digests", "replica_coords", "quarantine_outliers",
-    "inject_param_flip", "HangWatchdog", "hang_event", "simulate_hang",
-    "replay_step",
+    "compare_digests", "replica_coords", "vote_outliers",
+    "quarantine_outliers", "inject_param_flip", "HangWatchdog",
+    "hang_event", "simulate_hang", "replay_step",
 ]
 
 # the only collective primitives the fingerprint check program emits —
@@ -223,42 +227,36 @@ def _spec_mentions(spec, axis: str) -> bool:
                for ax in spec)
 
 
-def quarantine_outliers(trainer, leaves: Optional[List[str]] = None,
-                        elastic=None) -> Dict[str, Any]:
-    """Identify which replica(s) diverged and decide the eviction.
+def vote_outliers(chains: Dict[int, int],
+                  n_rep: int) -> Tuple[List[int], bool]:
+    """Majority vote over *observed* per-replica digest chains.
 
-    Digests every data-replicated trainable param per replica
-    (host-side crc32 over one representative device's shard bytes) and
-    majority-votes: replicas whose digest chain differs from the
-    majority are outliers. Ties break toward the group containing
-    replica 0 (the save-source replica). Returns::
+    Returns ``(outliers, quorum)``: replicas whose chain differs from
+    the largest agreeing group (ties break toward the group containing
+    replica 0, the save-source replica), and whether that group is a
+    provable majority of ALL ``n_rep`` replicas — not merely of the
+    observed subset. Without quorum an eviction verdict would rest on a
+    partial view (e.g. only the local host's shards) and must not be
+    acted on."""
+    votes: Dict[int, List[int]] = {}
+    for r, c in chains.items():
+        votes.setdefault(c, []).append(r)
+    if len(votes) <= 1:
+        return [], bool(votes) and 2 * len(chains) > n_rep
+    majority = max(votes, key=lambda c: (len(votes[c]), 0 in votes[c]))
+    outliers = sorted(r for c, rs in votes.items() if c != majority
+                      for r in rs)
+    return outliers, 2 * len(votes[majority]) > n_rep
 
-        {"outlier_replicas": [...], "outlier_hosts": [process ids],
-         "quarantined": n, "action": "rollback"|"self_evict"|"peer_evict",
-         "leaves": [...]}
 
-    ``action`` is "rollback" single-process (the sim maps replicas to
-    virtual hosts: rollback through the restore barrier replaces every
-    replica's bytes from the last clean checkpoint, which is exactly
-    the quarantine-and-recover semantics collapsed onto one host);
-    multi-process, the outlier host self-evicts (raises HostLost in the
-    runner) and the survivors remesh around it.
-    """
-    from .. import telemetry
-    axes = tuple(getattr(trainer, "integrity_axes", ()) or ())
-    mesh = trainer.mesh
-    n_rep = 1
-    for ax in axes:
-        n_rep *= int(mesh.shape.get(ax, 1))
-    base = {"outlier_replicas": [], "outlier_hosts": [], "quarantined": 0,
-            "action": "rollback", "leaves": list(leaves or [])}
-    if n_rep <= 1:
-        return base
-    coords = replica_coords(mesh, axes)
-    rep_dev: Dict[int, Any] = {}
-    for d, r in coords.items():
-        rep_dev.setdefault(r, d)
-    crcs = {r: 0 for r in rep_dev}
+def _local_digest_chains(trainer, rep_dev: Dict[int, Any]) -> Dict[int, int]:
+    """crc32 chain per replica over the representative device's shard
+    bytes, leaf order fixed by :func:`_voting_leaves`. Only replicas
+    whose representative device is addressable from this process appear
+    — each host attests exactly what it can observe (the representative
+    choice is deterministic and identical on every host, so a replica is
+    attested by precisely one process)."""
+    crcs: Dict[int, int] = {}
     for name in _voting_leaves(trainer):
         v = trainer.state["params"][name]
         try:
@@ -270,31 +268,107 @@ def quarantine_outliers(trainer, leaves: Optional[List[str]] = None,
             if s is None:
                 continue
             a = np.ascontiguousarray(np.asarray(s.data))
-            crcs[r] = zlib.crc32(a.tobytes(), crcs[r])
-    votes: Dict[int, List[int]] = {}
-    for r, c in crcs.items():
-        votes.setdefault(c, []).append(r)
-    if len(votes) <= 1:
+            crcs[r] = zlib.crc32(a.tobytes(), crcs.get(r, 0))
+    return crcs
+
+
+def _gather_digest_chains(local: Dict[int, int], elastic) -> Dict[int, int]:
+    """Merge every process's locally observed chains through the elastic
+    coordinator's file-KV allgather (all hosts reach this point on the
+    same check step — the divergence flag is itself a collective result,
+    so the round is naturally synchronized). Returns the local view
+    unchanged when no coordinator is reachable; the caller's quorum
+    check then decides whether that partial view may evict anyone."""
+    coord = getattr(elastic, "coordinator", None)
+    if coord is None:
+        return dict(local)
+    hosts_fn = getattr(elastic, "_coord_hosts", None)
+    if hosts_fn is None:
+        mgr = getattr(elastic, "manager", None)
+        hosts_fn = getattr(mgr, "hosts", None)
+    if hosts_fn is None:
+        return dict(local)
+    try:
+        gathered = coord.allgather(
+            "integrity_digests",
+            {str(r): int(c) for r, c in local.items()}, hosts_fn)
+    except Exception:
+        return dict(local)
+    merged: Dict[int, int] = {}
+    for h in sorted(gathered):
+        for k, c in (gathered[h] or {}).items():
+            merged.setdefault(int(k), int(c))
+    merged.update(local)
+    return merged
+
+
+def quarantine_outliers(trainer, leaves: Optional[List[str]] = None,
+                        elastic=None) -> Dict[str, Any]:
+    """Identify which replica(s) diverged and decide the eviction.
+
+    Digests every data-replicated trainable param per replica
+    (host-side crc32 over one representative device's shard bytes),
+    allgathers each host's locally observed chains through the elastic
+    coordinator when one is available (``elastic`` being an
+    ``ElasticRuntime``), and majority-votes: replicas whose digest chain
+    differs from the majority are outliers. Ties break toward the group
+    containing replica 0 (the save-source replica). Returns::
+
+        {"outlier_replicas": [...], "outlier_hosts": [process ids],
+         "quarantined": n, "action": "rollback"|"self_evict"|"peer_evict",
+         "abstained": bool, "leaves": [...]}
+
+    ``action`` is "rollback" single-process (the sim maps replicas to
+    virtual hosts: rollback through the restore barrier replaces every
+    replica's bytes from the last clean checkpoint, which is exactly
+    the quarantine-and-recover semantics collapsed onto one host);
+    multi-process, the outlier host self-evicts (raises HostLost in the
+    runner) and the survivors remesh around it. When the digest exchange
+    is unavailable and the agreeing group cannot be proven a majority of
+    ALL replicas from this host's partial view, the vote ABSTAINS from
+    eviction (``abstained=True``, action "rollback") — a partial view
+    must never vote a host off the fleet, least of all this one.
+    """
+    from .. import telemetry
+    axes = tuple(getattr(trainer, "integrity_axes", ()) or ())
+    mesh = trainer.mesh
+    n_rep = 1
+    for ax in axes:
+        n_rep *= int(mesh.shape.get(ax, 1))
+    base = {"outlier_replicas": [], "outlier_hosts": [], "quarantined": 0,
+            "action": "rollback", "abstained": False,
+            "leaves": list(leaves or [])}
+    if n_rep <= 1:
         return base
-    majority = max(votes, key=lambda c: (len(votes[c]), 0 in votes[c]))
-    outliers = sorted(r for c, rs in votes.items() if c != majority
-                      for r in rs)
-    outlier_hosts = sorted({rep_dev[r].process_index for r in outliers})
+    coords = replica_coords(mesh, axes)
+    rep_dev: Dict[int, Any] = {}
+    for d, r in coords.items():
+        rep_dev.setdefault(r, d)
     try:
         me, n_proc = jax.process_index(), jax.process_count()
     except Exception:
         me, n_proc = 0, 1
+    chains = _local_digest_chains(trainer, rep_dev)
+    if n_proc > 1:
+        chains = _gather_digest_chains(chains, elastic)
+    outliers, quorum = vote_outliers(chains, n_rep)
+    if not outliers:
+        return base
+    outlier_hosts = sorted({rep_dev[r].process_index for r in outliers})
     action = "rollback"
     if n_proc > 1 and outlier_hosts:
+        if not quorum:
+            base["abstained"] = True
+            return base
         action = "self_evict" if me in outlier_hosts else "peer_evict"
-    if outliers and telemetry.enabled():
+    if telemetry.enabled():
         telemetry.counter(
             "hosts_quarantined_total",
             "replicas/hosts evicted by majority-vote divergence quarantine",
         ).inc(len(outliers))
     return {"outlier_replicas": outliers, "outlier_hosts": outlier_hosts,
             "quarantined": len(outliers), "action": action,
-            "leaves": list(leaves or [])}
+            "abstained": False, "leaves": list(leaves or [])}
 
 
 def inject_param_flip(trainer, seed: int = 0, step: Optional[int] = None,

@@ -133,6 +133,13 @@ def _save(manager, trainer, step: int, epoch: int, batch: int) -> bool:
                                "meta": _meta(step, epoch, batch)})
 
 
+def _checked(trainer) -> bool:
+    """Whether the step that just ran executed the fingerprint-check
+    program (the trainer's cadence counter landed on a check step)."""
+    ce = int(getattr(trainer, "integrity_check_every", 0) or 0)
+    return bool(ce) and int(getattr(trainer, "_steps_run", 0)) % ce == 0
+
+
 def _restore(manager, trainer):
     """Newest-valid restore; returns (step, epoch, batch) of the restored
     cursor or None when starting fresh. Falls back past torn checkpoints
@@ -162,8 +169,10 @@ def run_resilient(trainer, loader: Iterable, steps: int,
     re-iterable with a deterministic order (the epoch/batch cursor
     fast-forwards it on resume).
 
-    ``max_rollbacks`` bounds divergence-quarantine rollbacks (past the
-    bound the run proceeds on the corrupt state rather than live-lock).
+    ``max_rollbacks`` bounds divergence-quarantine rollbacks; past the
+    bound — or when divergence strikes before anything was committed —
+    the run proceeds on the corrupt state rather than live-lock, but
+    stops checkpointing it until a later check step passes clean.
     ``hang_timeout`` (seconds) arms a :class:`integrity.HangWatchdog`
     around each step; ``hang_exit`` makes a firing hard-exit the process
     with that code (the supervisor observes it — hostsim's hang path)."""
@@ -183,14 +192,23 @@ def run_resilient(trainer, loader: Iterable, steps: int,
     rollbacks = 0
     divergences = 0
     quarantined = 0
+    # live state is known-divergent and was NOT rolled back (nothing
+    # restorable, or the rollback budget ran out): keep training but
+    # never checkpoint it — a save would launder the corruption into a
+    # "clean" restore. Cleared when a later check step passes clean or
+    # a rollback restores verified state.
+    dirty = False
     rollback_steps: List[int] = []
     step, epoch, batch = 0, 0, 0
     last_loss = None
     watchdog = None
     if hang_timeout:
+        # ElasticRuntime has no heartbeat of its own — the membership
+        # heartbeat lives on its wrapped manager
+        beat_src = getattr(elastic, "manager", elastic)
         watchdog = integrity.HangWatchdog(
             hang_timeout,
-            heartbeat_fn=getattr(elastic, "heartbeat", None),
+            heartbeat_fn=getattr(beat_src, "heartbeat", None),
             exit_code=hang_exit).start()
 
     def _result(exit_code, status, loss=None):
@@ -235,9 +253,10 @@ def run_resilient(trainer, loader: Iterable, steps: int,
         return (int(meta["step"]), int(meta["epoch"]), int(meta["batch"]))
 
     def _resume():
-        nonlocal step, epoch, batch
+        nonlocal step, epoch, batch, dirty
         cur = _enter()
         if cur is not None:
+            dirty = False  # restored state comes from a committed save
             step, epoch, batch = cur[0] + 1, cur[1], cur[2]
             if tel:
                 telemetry.counter(
@@ -266,7 +285,7 @@ def run_resilient(trainer, loader: Iterable, steps: int,
                     hasattr(elastic, "simulate_join"):
                 elastic.simulate_join()
             if stop.signum is not None:
-                if manager is not None and step > 0:
+                if manager is not None and step > 0 and not dirty:
                     _save(manager, trainer, step - 1, epoch, batch)
                     manager.wait_until_finished()
                 sig = stop.signum
@@ -277,7 +296,7 @@ def run_resilient(trainer, loader: Iterable, steps: int,
             if elastic is not None:
                 st = elastic.watch()
                 if st == ElasticStatus.RESTART:
-                    if manager is not None and step > 0:
+                    if manager is not None and step > 0 and not dirty:
                         _save(manager, trainer, step - 1, epoch, batch)
                         manager.wait_until_finished()
                     # pre-remesh residual buffers, captured so the drain
@@ -287,6 +306,7 @@ def run_resilient(trainer, loader: Iterable, steps: int,
                     if runtime is not None and runtime.on_restart(trainer):
                         cur = _enter(template_comm=old_comm)
                         if cur is not None:
+                            dirty = False
                             step, epoch, batch = cur[0] + 1, cur[1], cur[2]
                         # else: coordinated fresh start on a joiner —
                         # keep the live cursor, never rewind on RESTART
@@ -353,15 +373,25 @@ def run_resilient(trainer, loader: Iterable, steps: int,
                         rollback_steps.append(
                             cur[0] if cur is not None else -1)
                         if cur is not None:
+                            dirty = False
                             step, epoch, batch = cur[0] + 1, cur[1], cur[2]
-                        else:
-                            step, epoch, batch = 0, 0, 0
-                        it = _iter_from_cursor()
-                        continue
-                    # rollback budget exhausted: proceed (observably —
-                    # the divergence counters keep climbing)
+                            it = _iter_from_cursor()
+                            continue
+                        # nothing restorable (divergence before the first
+                        # commit): keep the live cursor and state, but
+                        # dirty — restarting from (0,0,0) would checkpoint
+                        # the corrupt state at step 0 and replay the whole
+                        # run on it
+                        dirty = True
+                    else:
+                        # rollback budget exhausted: proceed (observably —
+                        # the divergence counters keep climbing) but never
+                        # save the corrupt state
+                        dirty = True
+                elif dirty and _checked(trainer):
+                    dirty = False  # a later check step came back clean
                 batch += 1
-                if manager is not None and (
+                if manager is not None and not dirty and (
                         step % save_every == 0 or step == steps - 1):
                     _save(manager, trainer, step, epoch, batch)
                 step += 1

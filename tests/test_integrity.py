@@ -194,6 +194,54 @@ class TestDivergenceCheck:
 
 
 # ---------------------------------------------------------------------------
+# quarantine vote (partial views, quorum)
+# ---------------------------------------------------------------------------
+
+class TestQuarantineVote:
+    def test_unobserved_replicas_do_not_vote(self):
+        # multi-process regression: remote replicas this host cannot
+        # address must be absent from the vote, not counted as digest 0
+        # (which made every host vote itself the outlier)
+        outliers, quorum = integrity.vote_outliers({2: 123}, n_rep=4)
+        assert outliers == [] and quorum is False
+
+    def test_partial_view_has_no_quorum(self):
+        # two observed chains out of four replicas: an outlier is named
+        # but the agreeing group cannot be proven a global majority
+        outliers, quorum = integrity.vote_outliers({0: 7, 1: 9}, n_rep=4)
+        assert outliers == [1] and quorum is False
+
+    def test_full_view_majority_has_quorum(self):
+        outliers, quorum = integrity.vote_outliers(
+            {0: 7, 1: 7, 2: 7, 3: 9}, n_rep=4)
+        assert outliers == [3] and quorum is True
+
+    def test_tie_breaks_toward_save_source_replica(self):
+        outliers, quorum = integrity.vote_outliers({0: 7, 1: 9}, n_rep=2)
+        assert outliers == [1]   # replica 0's group wins the tie
+        assert quorum is False   # 1 of 2 is not a majority
+
+    def test_gather_merges_coordinator_views(self):
+        class FakeCoord:
+            def allgather(self, name, value, hosts_fn):
+                assert hosts_fn() == ["h0", "h1"]
+                return {"h0": value, "h1": {"2": 7, "3": 7}}
+
+        class FakeRuntime:
+            coordinator = FakeCoord()
+
+            def _coord_hosts(self):
+                return ["h0", "h1"]
+
+        merged = integrity._gather_digest_chains({0: 7, 1: 9},
+                                                 FakeRuntime())
+        assert merged == {0: 7, 1: 9, 2: 7, 3: 7}
+
+    def test_gather_without_coordinator_keeps_local_view(self):
+        assert integrity._gather_digest_chains({0: 7}, None) == {0: 7}
+
+
+# ---------------------------------------------------------------------------
 # deep checkpoint verify
 # ---------------------------------------------------------------------------
 
@@ -236,7 +284,7 @@ def _tamper_reattested(step_dir):
 class TestDeepVerify:
     def _saved_mgr(self, tmp_path, steps=(1, 2, 3)):
         mgr = CheckpointManager(str(tmp_path / "ckpt"), use_async=False,
-                                max_to_keep=8)
+                                max_to_keep=8, deep_digests=True)
         rng = np.random.RandomState(0)
         state = {"w": rng.randn(64, 8).astype(np.float32),
                  "b": rng.randn(8).astype(np.float32)}
@@ -299,9 +347,10 @@ class TestDeepVerify:
         assert mgr.latest_valid_step() == 2
         mgr.close()
 
-    def test_deep_digests_opt_out(self, tmp_path):
-        mgr = CheckpointManager(str(tmp_path / "ckpt"), use_async=False,
-                                deep_digests=False)
+    def test_deep_digests_off_by_default(self, tmp_path):
+        # digests cost a full device->host transfer + CRC per save, so
+        # they are opt-in: a default manager records none
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), use_async=False)
         mgr.save(1, {"w": np.ones(4, dtype=np.float32)})
         with open(os.path.join(mgr._step_dir(1), ck.MANIFEST_NAME)) as f:
             man = json.load(f)
@@ -323,7 +372,8 @@ class TestReplay:
         def factory():
             return _mlp_trainer(check_every=0)
 
-        mgr = CheckpointManager(root, use_async=False, max_to_keep=8)
+        mgr = CheckpointManager(root, use_async=False, max_to_keep=8,
+                                deep_digests=True)
         res = run_resilient(factory(), loader, steps=4, manager=mgr,
                             save_every=1, handle_signals=False)
         mgr.close()

@@ -79,7 +79,8 @@ def _smoke() -> dict:
     from paddle_tpu.distributed import checkpoint as ck
 
     root = tempfile.mkdtemp(prefix="fsck_smoke_")
-    mgr = ck.CheckpointManager(root, use_async=False, max_to_keep=5)
+    mgr = ck.CheckpointManager(root, use_async=False, max_to_keep=5,
+                               deep_digests=True)
     rng = np.random.RandomState(0)
     state = {"w": rng.randn(64, 8).astype(np.float32),
              "b": rng.randn(8).astype(np.float32)}

@@ -1,8 +1,9 @@
 """Deterministic step replay: re-execute a recorded training step and
 compare state digests against the checkpoint record.
 
-Given a checkpoint tree written by ``run_resilient`` (per-array content
-digests in each step's MANIFEST), replays global step N from checkpoint
+Given a checkpoint tree written by ``run_resilient`` with a
+``CheckpointManager(deep_digests=True)`` (per-array content digests in
+each step's MANIFEST), replays global step N from checkpoint
 N−1 — fresh trainer, restored params/opt/residuals, restored RNG key and
 data cursor, the same batch — ``--repeats`` times, and prints the
 verdict:
@@ -63,7 +64,8 @@ def _smoke() -> dict:
     def trainer_factory():
         return hostsim._tiny_trainer(seed=7, data_degree=2)
 
-    mgr = ck.CheckpointManager(root, use_async=False, max_to_keep=8)
+    mgr = ck.CheckpointManager(root, use_async=False, max_to_keep=8,
+                               deep_digests=True)
     res = run_resilient(trainer_factory(), loader, steps=4, manager=mgr,
                         save_every=1, handle_signals=False)
     mgr.close()
