@@ -13,6 +13,7 @@ matches the reference's zero-copy tensor handles.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -35,10 +36,18 @@ class Config:
         self._device = None  # default backend
         self._enable_profile = False
         self._memory_pool_mb = 0
+        self._weight_quant = None  # (policy, block) once enabled
 
     # -- model ---------------------------------------------------------------
     def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        """Point the config at a new model WITHOUT wiping the device /
+        profiling / quantization choices already made on it (the
+        reference keeps those orthogonal to the model path)."""
+        kept = (self._device, self._enable_profile, self._memory_pool_mb,
+                self._weight_quant)
         self.__init__(prog_file, params_file)
+        (self._device, self._enable_profile, self._memory_pool_mb,
+         self._weight_quant) = kept
 
     def prog_file(self) -> str:
         return (self._prefix or "") + ".stablehlo"
@@ -66,6 +75,17 @@ class Config:
 
     def enable_profile(self):
         self._enable_profile = True
+
+    # -- weight quantization ---------------------------------------------
+    def enable_weight_quantize(self, policy: str = "int8",
+                               block: Optional[int] = None):
+        """Serve with block-quantized weights (int8/int4 at rest, see
+        ``inference.quant``) — the EQuARX block quantizer applied to the
+        loaded parameters instead of the gradient wire."""
+        if policy not in ("int8", "int4"):
+            raise ValueError(f"weight quant policy must be int8/int4, "
+                             f"got {policy!r}")
+        self._weight_quant = (policy, block)
 
     # accepted-but-inert reference toggles (XLA owns these optimizations).
     # Each warns once per process so callers porting reference configs are
@@ -127,29 +147,72 @@ class Tensor:
         return list(np.shape(store[self._name]))
 
 
+# Per-prefix load cache: N predictors over the same artifact share ONE
+# loaded layer (exported program + params), so a PredictorPool genuinely
+# shares the compiled executable instead of re-running jit.load per
+# member. Keyed on (abspath, mtime_ns, size) of both artifact files so a
+# re-saved model is reloaded, not served stale. Weight-quantized views
+# are cached next to the raw layer under the quant spec.
+_LAYER_CACHE: Dict[tuple, object] = {}
+_LAYER_CACHE_LOCK = threading.Lock()
+
+
+def _layer_cache_key(prefix: str, quant=None) -> tuple:
+    key = [os.path.abspath(prefix), quant]
+    for suffix in (".stablehlo", ".pdiparams"):
+        try:
+            st = os.stat(prefix + suffix)
+            key.append((st.st_mtime_ns, st.st_size))
+        except OSError:
+            key.append(None)
+    return tuple(key)
+
+
+def _load_layer(prefix: str, quant=None):
+    key = _layer_cache_key(prefix, quant)
+    with _LAYER_CACHE_LOCK:
+        layer = _LAYER_CACHE.get(key)
+    if layer is not None:
+        return layer
+    if quant is None:
+        from .. import jit
+        layer = jit.load(prefix)
+    else:
+        from . import quant as quant_mod
+        layer, _ = quant_mod.quantized_layer(
+            _load_layer(prefix), policy=quant[0], block=quant[1])
+    with _LAYER_CACHE_LOCK:
+        return _LAYER_CACHE.setdefault(key, layer)
+
+
+def clear_layer_cache():
+    with _LAYER_CACHE_LOCK:
+        _LAYER_CACHE.clear()
+
+
 class Predictor:
-    """reference: AnalysisPredictor. Loads the StableHLO artifact once;
-    ``run`` executes the compiled program on the serving device."""
+    """reference: AnalysisPredictor. Loads the StableHLO artifact once
+    (shared per prefix across a pool); ``run`` executes the compiled
+    program on the serving device."""
 
     def __init__(self, config: Config):
-        from .. import jit
         self._config = config
-        self._layer = jit.load(config._prefix)
-        exported = self._layer._exported
-        # input names: positional args after (params, buffers)
-        n_in = len(exported.in_avals) if hasattr(exported, "in_avals") else 1
+        self._layer = _load_layer(config._prefix, config._weight_quant)
         self._input_names = [f"x{i}" for i in range(self._n_user_inputs())]
         self._output_names = ["out0"]
         self._inputs: Dict[str, np.ndarray] = {}
         self._outputs: Dict[str, np.ndarray] = {}
 
     def _n_user_inputs(self) -> int:
-        import jax
         exported = self._layer._exported
-        tree = exported.in_tree
-        # in_tree is ((params, buffers, *args), kwargs)
-        args = tree.children()[0].children()
-        return max(len(args) - 2, 1)
+        try:
+            # jit.save exports with in_tree ((params, buffers, *args), kwargs)
+            args = exported.in_tree.children()[0].children()
+            return max(len(args) - 2, 1)
+        except Exception:
+            # non-conforming export (foreign tree layout): serve one
+            # positional input rather than crash on an IndexError
+            return 1
 
     def get_input_names(self) -> List[str]:
         return list(self._input_names)
@@ -185,7 +248,8 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
-__all__ = ["Config", "Predictor", "Tensor", "create_predictor"]
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "clear_layer_cache"]
 
 
 class DataType:
@@ -255,3 +319,14 @@ class PredictorPool:
 
 __all__ += ["DataType", "PlaceType", "PrecisionType", "PredictorPool",
             "get_num_bytes_of_data_type", "get_version"]
+
+
+def __getattr__(name):
+    # lazy submodules: the serving runtime / weight quantizer are only
+    # imported when asked for, keeping the base handle API import-light
+    if name in ("serving", "quant"):
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

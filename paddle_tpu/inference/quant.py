@@ -1,0 +1,146 @@
+"""Int8/int4 block weight quantization for served models.
+
+Reuses the EQuARX-style block quantizer from
+``distributed/compressed.py`` (arXiv:2506.17615) on the *weights* of a
+loaded ``jit.load`` model instead of the gradient wire: each float
+parameter is flattened, padded to a block multiple, and stored as int8
+(or nibble-packed int4) plus one fp32 scale per block — ~3.9x (int8) /
+~7x (int4) smaller at rest than fp32. The serving path keeps the
+quantized form in the shared per-prefix load cache (so N replicas pay
+the compressed footprint once) and dequantizes to the exported
+program's expected dtype at predictor-materialization time.
+
+This is weight-only quantization: the compute still runs in the
+exported program's dtype, so accuracy loss is the block-rounding error
+alone (bounded by amax/127 resp. amax/7 per block).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["QuantizedArray", "quantize_array", "dequantize_array",
+           "quantize_state", "dequantize_state", "state_bytes",
+           "quantized_layer"]
+
+
+class QuantizedArray:
+    """One block-quantized tensor: ``q`` (int8, or packed uint8 nibbles
+    for int4) + per-block fp32 ``scale`` + the original shape/dtype."""
+
+    __slots__ = ("policy", "block", "q", "scale", "shape", "dtype", "size")
+
+    def __init__(self, policy: str, block: int, q: np.ndarray,
+                 scale: np.ndarray, shape: Tuple[int, ...], dtype, size: int):
+        self.policy = policy
+        self.block = block
+        self.q = q
+        self.scale = scale
+        self.shape = shape
+        self.dtype = dtype
+        self.size = size  # unpadded element count
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.q.nbytes + self.scale.nbytes)
+
+
+def quantize_array(x, policy: str = "int8",
+                   block: Optional[int] = None) -> QuantizedArray:
+    """Block-quantize one array (any shape, any float dtype)."""
+    from ..distributed import compressed as C
+
+    if policy not in ("int8", "int4"):
+        raise ValueError(f"weight quant policy must be int8/int4, "
+                         f"got {policy!r}")
+    block = C.resolve_block(policy, block)
+    arr = np.asarray(x)
+    flat = np.asarray(arr, np.float32).reshape(-1)
+    size = flat.size
+    pad = (-size) % block
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    if policy == "int8":
+        q, scale = C.quantize_int8_blocks(flat, block)
+        q = np.asarray(q, np.int8)
+    else:
+        q, scale = C.quantize_int4_blocks(flat, block)
+        q = np.asarray(C.pack_int4(np.asarray(q, np.int8).reshape(-1)),
+                      np.uint8)
+    return QuantizedArray(policy, block, q, np.asarray(scale, np.float32),
+                          tuple(arr.shape), arr.dtype, size)
+
+
+def dequantize_array(qa: QuantizedArray) -> np.ndarray:
+    from ..distributed import compressed as C
+
+    if qa.policy == "int8":
+        flat = np.asarray(
+            C.dequantize_int8_blocks(qa.q, qa.scale, qa.block), np.float32)
+    else:
+        vals = np.asarray(C.unpack_int4(qa.q), np.int8)
+        flat = np.asarray(
+            C.dequantize_int4_blocks(vals, qa.scale, qa.block), np.float32)
+    return flat.reshape(-1)[:qa.size].reshape(qa.shape).astype(qa.dtype)
+
+
+def _quantizable(x, block: int) -> bool:
+    a = np.asarray(x)
+    return np.issubdtype(a.dtype, np.floating) and a.size >= block
+
+
+def quantize_state(params: Dict[str, object], policy: str = "int8",
+                   block: Optional[int] = None) -> Dict[str, object]:
+    """Quantize every float parameter large enough to amortize a scale
+    block; small / integer leaves pass through unchanged."""
+    from ..distributed import compressed as C
+
+    rblock = C.resolve_block(policy, block)
+    out: Dict[str, object] = {}
+    for k, v in params.items():
+        out[k] = (quantize_array(v, policy, rblock)
+                  if _quantizable(v, rblock) else np.asarray(v))
+    return out
+
+
+def dequantize_state(state: Dict[str, object]) -> Dict[str, np.ndarray]:
+    return {k: dequantize_array(v) if isinstance(v, QuantizedArray)
+            else np.asarray(v) for k, v in state.items()}
+
+
+def state_bytes(state: Dict[str, object]) -> int:
+    return int(sum(v.nbytes for v in state.values()))
+
+
+def quantized_layer(layer, policy: str = "int8",
+                    block: Optional[int] = None):
+    """Return (a TranslatedLayer with dequantized-weight params,
+    stats dict). Buffers are left exact; the exported program is shared
+    with the source layer."""
+    from .. import jit
+
+    import jax.numpy as jnp
+
+    raw = {k: np.asarray(v) for k, v in layer._params.items()}
+    qstate = quantize_state(raw, policy, block)
+    deq = dequantize_state(qstate)
+    fp32_bytes = state_bytes(raw)
+    q_bytes = state_bytes(qstate)
+    stats = {
+        "policy": policy,
+        "params_bytes_fp": fp32_bytes,
+        "params_bytes_quant": q_bytes,
+        "compression_x": (fp32_bytes / q_bytes) if q_bytes else 1.0,
+        "n_quantized": sum(1 for v in qstate.values()
+                           if isinstance(v, QuantizedArray)),
+    }
+    from .. import telemetry
+    if telemetry.enabled():
+        telemetry.gauge(
+            "serving_weight_compression_x",
+            "fp weight bytes / quantized weight bytes").set(
+                stats["compression_x"], policy=policy)
+    params = {k: jnp.asarray(v) for k, v in deq.items()}
+    return jit.TranslatedLayer(layer._exported, params,
+                               dict(layer._buffers)), stats

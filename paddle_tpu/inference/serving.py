@@ -1,0 +1,872 @@
+"""Overload-robust inference serving runtime (ISSUE 10).
+
+The reference platform serves Paddle models through a C++ server
+(paddle_serving / fleet serving) whose core loop is: bounded admission,
+dynamic batching, a worker pool per model replica, and health-driven
+failover. This module is that loop over the repo's ``Predictor`` /
+``jit.load`` path, built robustness-first — overload, stragglers, and
+replica death degrade gracefully instead of cascading:
+
+- **Admission control + deadlines** — ``submit`` places a request in a
+  bounded queue. A request carries an absolute deadline; it is rejected
+  at admission when the queue is full (``queue_full``) or when the
+  queue's *modeled* wait (EWMA service rate over recent batches) already
+  exceeds the deadline (``deadline_infeasible``). A request whose
+  deadline passes while it waits is dropped, never executed
+  (``deadline_expired_in_queue``). Every shed cause is counted in
+  ``serving_requests_shed_total{cause=...}``.
+
+- **Continuous batching** — a batcher thread coalesces compatible
+  requests (same per-row input signature) into padded batches whose row
+  counts are bucketed to powers of two (``ops.pallas.tuner.shape_bucket``
+  semantics), so every batch hits one of a small closed set of compiled
+  programs. ``serving_recompiles_total`` counts first-seen
+  (signature, bucket) pairs — it must stop growing after warmup.
+
+- **Replica health + failover** — batches round-robin over healthy
+  replicas. Each dispatch arms a per-call deadline (the
+  ``integrity.HangWatchdog`` semantics: a timer that fires once if the
+  call does not finish in time); on fire the replica is marked
+  unhealthy, its worker is respawned (the wedged thread is abandoned —
+  a stuck device call cannot be interrupted), its in-flight requests
+  are requeued to the survivors at the front of the queue, and the
+  replica re-enters through jittered-backoff probation
+  (``resilience.retry`` backoff math, strikes lengthen the sentence).
+  ``serving_io`` (transient IOError) and ``replica_stall`` (wedged
+  call) faults from ``resilience.faults`` make both paths
+  deterministically testable.
+
+- **Graceful drain** — ``shutdown(drain=True)`` (or the installed
+  SIGTERM handler) stops admitting (``draining`` shed cause) while
+  accepted work runs to completion.
+
+Accounting invariant: every submitted request terminates in exactly one
+of ``completed`` / ``shed`` / ``expired`` / ``failed`` — nothing is
+silently lost, including requests in flight on a replica that dies.
+
+Typical use::
+
+    server = InferenceServer.from_config(config, replicas=2)
+    with server:
+        req = server.submit([x], deadline_s=0.2)
+        out = req.result(timeout=1.0)   # raises RequestShed / DeadlineExpired
+"""
+from __future__ import annotations
+
+import collections
+import queue as _queue_mod
+import signal
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..ops.pallas.tuner import shape_bucket
+from ..resilience import faults
+from ..resilience.retry import _backoff
+
+__all__ = [
+    "InferenceServer", "ServingConfig", "Request",
+    "RequestShed", "DeadlineExpired", "ServingError",
+    "SHED_CAUSES", "predictor_executor",
+]
+
+# terminal request states (the accounting universe)
+PENDING = "pending"
+COMPLETED = "completed"
+SHED = "shed"
+EXPIRED = "expired"
+FAILED = "failed"
+
+SHED_CAUSES = ("queue_full", "deadline_infeasible",
+               "deadline_expired_in_queue", "draining")
+
+
+class RequestShed(RuntimeError):
+    """The request was rejected by admission control / drain."""
+
+    def __init__(self, cause: str):
+        super().__init__(f"request shed: {cause}")
+        self.cause = cause
+
+
+class DeadlineExpired(RuntimeError):
+    """The request's deadline passed before it could execute."""
+
+
+class ServingError(RuntimeError):
+    """The request failed terminally (executor error past recovery)."""
+
+
+class Request:
+    """One inference request: a list of arrays sharing a leading row
+    dim, an optional absolute deadline, and a future-style result."""
+
+    _ids = iter(range(1, 2 ** 62))
+    _ids_lock = threading.Lock()
+
+    def __init__(self, inputs: Sequence[np.ndarray],
+                 deadline_s: Optional[float] = None,
+                 tokens: Optional[int] = None):
+        self.inputs = [np.ascontiguousarray(x) for x in inputs]
+        if not self.inputs:
+            raise ValueError("a request needs at least one input array")
+        for x in self.inputs:
+            if x.ndim < 1:
+                raise ValueError("request inputs must have a leading "
+                                 "batch (row) dimension")
+        rows = self.inputs[0].shape[0]
+        if any(x.shape[0] != rows for x in self.inputs):
+            raise ValueError("all request inputs must share the leading "
+                             "row dimension")
+        with Request._ids_lock:
+            self.id = next(Request._ids)
+        self.rows = int(rows)
+        self.tokens = int(tokens) if tokens is not None else self.rows
+        self.arrival = time.monotonic()
+        self.deadline = (None if deadline_s is None
+                         else self.arrival + float(deadline_s))
+        self.state = PENDING
+        self.attempts = 0  # dispatches that ended in a failover requeue
+        self.cause: Optional[str] = None
+        self.outputs: Optional[List[np.ndarray]] = None
+        self.error: Optional[BaseException] = None
+        self.t_dispatch: Optional[float] = None  # first dispatch only
+        self.t_done: Optional[float] = None
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+
+    def signature(self):
+        """Batch-compatibility key: per-row shape + dtype of each input."""
+        return tuple((x.shape[1:], x.dtype.str) for x in self.inputs)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None
+                and (now if now is not None else time.monotonic())
+                > self.deadline)
+
+    def _seal(self, state: str, outputs=None, error=None,
+              cause=None) -> bool:
+        """Move to a terminal state exactly once (first sealer wins —
+        the requeue path and a late result from a wedged replica race)."""
+        with self._lock:
+            if self.state != PENDING:
+                return False
+            self.state = state
+            self.outputs = outputs
+            self.error = error
+            self.cause = cause
+            self.t_done = time.monotonic()
+        self._done.set()
+        return True
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[np.ndarray]:
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.id} still pending")
+        if self.state == COMPLETED:
+            return self.outputs
+        if self.state == SHED:
+            raise RequestShed(self.cause)
+        if self.state == EXPIRED:
+            raise DeadlineExpired(
+                f"request {self.id} expired ({self.cause})")
+        raise ServingError(
+            f"request {self.id} failed: {self.error!r}") from self.error
+
+    @property
+    def latency(self) -> Optional[float]:
+        return (None if self.t_done is None
+                else self.t_done - self.arrival)
+
+
+class ServingConfig:
+    """Knobs for :class:`InferenceServer` (defaults sized for tests /
+    CPU smoke; production raises queue depth and call timeout)."""
+
+    def __init__(self, max_queue: int = 256, max_batch: int = 8,
+                 batch_wait_s: float = 0.002,
+                 call_timeout_s: float = 2.0,
+                 admission_safety: float = 1.0,
+                 probation_base_s: float = 0.05,
+                 probation_factor: float = 2.0,
+                 probation_max_s: float = 2.0,
+                 probation_jitter: float = 0.5,
+                 rate_ewma: float = 0.3,
+                 default_deadline_s: Optional[float] = None,
+                 max_attempts: int = 6,
+                 seed: int = 0):
+        if max_queue < 1 or max_batch < 1:
+            raise ValueError("max_queue and max_batch must be >= 1")
+        self.max_queue = int(max_queue)
+        self.max_batch = int(max_batch)
+        self.batch_wait_s = float(batch_wait_s)
+        self.call_timeout_s = float(call_timeout_s)
+        self.admission_safety = float(admission_safety)
+        self.probation_base_s = float(probation_base_s)
+        self.probation_factor = float(probation_factor)
+        self.probation_max_s = float(probation_max_s)
+        self.probation_jitter = float(probation_jitter)
+        self.rate_ewma = float(rate_ewma)
+        self.default_deadline_s = default_deadline_s
+        self.max_attempts = int(max_attempts)
+        self.seed = int(seed)
+
+
+class _BatchJob:
+    """One padded batch in flight on a replica. ``try_finish`` /
+    ``try_cancel`` are mutually exclusive: whichever side wins decides
+    whether the results are published or the requests requeued."""
+
+    def __init__(self, requests: List[Request], arrays: List[np.ndarray],
+                 bucket: int, rows: int, seq: int):
+        self.requests = requests
+        self.arrays = arrays
+        self.bucket = bucket
+        self.rows = rows
+        self.seq = seq
+        self.timer: Optional[threading.Timer] = None
+        self._lock = threading.Lock()
+        self._done = False
+        self._cancelled = False
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def try_finish(self) -> bool:
+        with self._lock:
+            if self._cancelled or self._done:
+                return False
+            self._done = True
+            return True
+
+    def try_cancel(self) -> bool:
+        with self._lock:
+            if self._done or self._cancelled:
+                return False
+            self._cancelled = True
+            return True
+
+
+class _Replica:
+    """One executor: a callable over padded input arrays, a work queue,
+    and a worker thread. Health is probation-based: a strike benches
+    the replica for a jittered-backoff interval, then it is optimistically
+    re-admitted (a still-wedged replica strikes again, longer)."""
+
+    def __init__(self, idx: int, fn: Callable, server: "InferenceServer"):
+        self.idx = idx
+        self.fn = fn
+        self.server = server
+        self.healthy = True
+        self.strikes = 0
+        self.probation_until = 0.0
+        self.generation = 0
+        self.lock = threading.Lock()
+        self.queue: _queue_mod.Queue = _queue_mod.Queue()
+        self.thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._spawn(self.generation, self.queue)
+
+    def _spawn(self, gen: int, q: _queue_mod.Queue):
+        self.thread = threading.Thread(
+            target=self.server._worker_loop, args=(self, gen, q),
+            name=f"serving-replica-{self.idx}-g{gen}", daemon=True)
+        self.thread.start()
+
+    def mark_unhealthy(self, reason: str, respawn: bool = False):
+        abandoned = None
+        with self.lock:
+            self.strikes += 1
+            self.healthy = False
+            delay = _backoff(self.strikes, self.server.cfg.probation_base_s,
+                             self.server.cfg.probation_factor,
+                             self.server.cfg.probation_max_s,
+                             self.server.cfg.probation_jitter,
+                             site=f"replica{self.idx}",
+                             seed=self.server.cfg.seed)
+            self.probation_until = time.monotonic() + delay
+            if respawn:
+                # the old worker is wedged inside the executor; abandon
+                # it together with its queue so a fresh thread serves
+                # the replica when probation ends
+                abandoned = self.queue
+                self.generation += 1
+                self.queue = _queue_mod.Queue()
+                self._spawn(self.generation, self.queue)
+        if abandoned is not None:
+            abandoned.put(None)  # stop the old worker if it ever returns
+            self.server._abandon_queue(abandoned)
+        self.server._count("serving_replica_unhealthy_total", reason=reason)
+        self.server._set_healthy_gauge()
+
+    def pending(self) -> int:
+        return self.queue.qsize()
+
+    def maybe_readmit(self, now: float) -> bool:
+        with self.lock:
+            if not self.healthy and now >= self.probation_until:
+                self.healthy = True
+                self.server._set_healthy_gauge()
+            return self.healthy
+
+
+class InferenceServer:
+    """The serving runtime: admission queue -> continuous batcher ->
+    replica dispatch. ``model_fns`` is one callable per replica taking
+    the padded input arrays and returning the output arrays (leading
+    dim = batch); use :meth:`from_config` to build them from the
+    ``Predictor`` path."""
+
+    def __init__(self, model_fns, replicas: Optional[int] = None,
+                 config: Optional[ServingConfig] = None):
+        if callable(model_fns):
+            model_fns = [model_fns] * (replicas or 1)
+        model_fns = list(model_fns)
+        if not model_fns:
+            raise ValueError("need at least one replica")
+        self.cfg = config or ServingConfig()
+        self.replicas = [_Replica(i, fn, self) for i, fn in
+                         enumerate(model_fns)]
+        self._cv = threading.Condition()
+        self._deque: collections.deque = collections.deque()
+        self._inflight: set = set()
+        self._inflight_rows = 0
+        self._seen_shapes: set = set()
+        self._seq = 0
+        self._rr = 0
+        self._ewma_rows_per_s: Optional[float] = None
+        self._ewma_batch_s: Optional[float] = None
+        self._draining = False
+        self._stopped = False
+        self._started = False
+        self._batcher: Optional[threading.Thread] = None
+        self._prev_sigterm = None
+        # server-owned accounting (mirrored to telemetry when enabled)
+        self._clock = threading.Lock()
+        self.counts: Dict[str, int] = collections.defaultdict(int)
+        self.shed_causes: Dict[str, int] = collections.defaultdict(int)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "InferenceServer":
+        if self._started:
+            return self
+        self._started = True
+        for r in self.replicas:
+            r.start()
+        self._batcher = threading.Thread(
+            target=self._batcher_loop, name="serving-batcher", daemon=True)
+        self._batcher.start()
+        self._set_healthy_gauge()
+        return self
+
+    def __enter__(self) -> "InferenceServer":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=not any(exc))
+
+    def shutdown(self, drain: bool = True, timeout: float = 30.0):
+        """Stop the server. With ``drain`` accepted work finishes first
+        while new admissions are shed with cause ``draining``."""
+        self._draining = True
+        if drain:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                with self._cv:
+                    idle = not self._deque and not self._inflight
+                if idle:
+                    break
+                time.sleep(0.005)
+        self._stopped = True
+        with self._cv:
+            self._cv.notify_all()
+        for r in self.replicas:
+            r.queue.put(None)  # poison
+        if self._batcher is not None:
+            self._batcher.join(timeout=2.0)
+        for r in self.replicas:
+            if r.thread is not None:
+                r.thread.join(timeout=0.5)
+        # anything still queued can no longer run
+        with self._cv:
+            leftovers = list(self._deque)
+            self._deque.clear()
+        for req in leftovers:
+            self._terminal(req, SHED, cause="draining")
+
+    def install_sigterm_drain(self):
+        """SIGTERM -> graceful drain (finish accepted work, reject new
+        admissions), chaining any previous handler."""
+        self._prev_sigterm = signal.getsignal(signal.SIGTERM)
+
+        def _handler(signum, frame):
+            self._draining = True
+            threading.Thread(target=self.shutdown,
+                             kwargs={"drain": True}, daemon=True).start()
+            prev = self._prev_sigterm
+            if callable(prev) and prev not in (signal.SIG_IGN,
+                                               signal.SIG_DFL):
+                prev(signum, frame)
+
+        signal.signal(signal.SIGTERM, _handler)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, inputs: Sequence[np.ndarray],
+               deadline_s: Optional[float] = None,
+               tokens: Optional[int] = None) -> Request:
+        """Admit a request (or shed it — the returned request is then
+        already terminal with the shed cause recorded)."""
+        if deadline_s is None:
+            deadline_s = self.cfg.default_deadline_s
+        req = Request(inputs, deadline_s=deadline_s, tokens=tokens)
+        self._count_only("submitted")
+        if self._draining or self._stopped:
+            self._terminal(req, SHED, cause="draining")
+            return req
+        with self._cv:
+            if len(self._deque) >= self.cfg.max_queue:
+                cause = "queue_full"
+            elif req.deadline is not None and self._modeled_wait_locked(
+                    req.rows) * self.cfg.admission_safety \
+                    + req.arrival > req.deadline:
+                cause = "deadline_infeasible"
+            else:
+                self._deque.append(req)
+                self._gauge("serving_queue_depth", len(self._deque))
+                self._cv.notify_all()
+                return req
+        self._terminal(req, SHED, cause=cause)
+        return req
+
+    def _modeled_wait_locked(self, rows: int) -> float:
+        """Expected wait for a request of ``rows`` arriving now: queued +
+        in-flight rows over the EWMA service rate, plus one batch
+        latency. The EWMA is a PER-REPLICA rate (one batch over its own
+        execute time), so the drain rate scales with the healthy replica
+        count — admission tightens by itself while a replica sits in
+        probation. Cold start (no completed batch yet) models zero wait —
+        admission cannot reject what it cannot estimate."""
+        if self._ewma_rows_per_s is None or self._ewma_rows_per_s <= 0:
+            return 0.0
+        healthy = max(1, sum(1 for r in self.replicas if r.healthy))
+        ahead = sum(r.rows for r in self._deque) + self._inflight_rows
+        return (ahead + rows) / (self._ewma_rows_per_s * healthy) \
+            + (self._ewma_batch_s or 0.0)
+
+    def modeled_wait(self, rows: int = 1) -> float:
+        with self._cv:
+            return self._modeled_wait_locked(rows)
+
+    # -- batcher -------------------------------------------------------------
+
+    def _bucket(self, rows: int) -> int:
+        return min(shape_bucket(rows, floor=1), self.cfg.max_batch)
+
+    def _batcher_loop(self):
+        while True:
+            batch = self._form_batch()
+            if batch is None:
+                return
+            if batch:
+                self._dispatch(batch)
+
+    def _pop_expired_locked(self, now: float) -> List[Request]:
+        expired = [r for r in self._deque if r.expired(now)]
+        if expired:
+            for r in expired:
+                self._deque.remove(r)
+        return expired
+
+    def _form_batch(self) -> Optional[List[Request]]:
+        """Pull a head-of-line-compatible group from the queue (waiting
+        up to ``batch_wait_s`` to coalesce more rows), dropping expired
+        requests. Returns None when the server stops."""
+        expired: List[Request] = []
+        try:
+            with self._cv:
+                while not self._deque and not self._stopped:
+                    self._cv.wait(0.05)
+                if self._stopped:
+                    return None
+                now = time.monotonic()
+                expired.extend(self._pop_expired_locked(now))
+                if not self._deque:
+                    return []
+                first = self._deque[0]
+                sig = first.signature()
+                batch = [first]
+                rows = first.rows
+                deadline = now + self.cfg.batch_wait_s
+                while rows < self.cfg.max_batch:
+                    # take every queued compatible request that fits
+                    for r in list(self._deque):
+                        if r is first or r in batch:
+                            continue
+                        if (r.signature() == sig
+                                and rows + r.rows <= self.cfg.max_batch):
+                            batch.append(r)
+                            rows += r.rows
+                    remaining = deadline - time.monotonic()
+                    if rows >= self.cfg.max_batch or remaining <= 0 \
+                            or self._stopped:
+                        break
+                    self._cv.wait(remaining)
+                for r in batch:
+                    self._deque.remove(r)
+                self._gauge("serving_queue_depth", len(self._deque))
+        finally:
+            for r in expired:
+                self._terminal(r, EXPIRED, cause="deadline_expired_in_queue")
+        return batch
+
+    def _dispatch(self, batch: List[Request]):
+        now = time.monotonic()
+        live = [r for r in batch if not r.expired(now) and not r.done()]
+        for r in batch:
+            if r not in live:
+                self._terminal(r, EXPIRED,
+                               cause="deadline_expired_in_queue")
+        if not live:
+            return
+        rows = sum(r.rows for r in live)
+        bucket = self._bucket(rows)
+        arrays = self._pad_concat(live, bucket)
+        sig = live[0].signature()
+        if (sig, bucket) not in self._seen_shapes:
+            self._seen_shapes.add((sig, bucket))
+            self._count("serving_recompiles_total")
+        replica = self._pick_replica(live)
+        if replica is None:
+            return  # everyone expired while no replica was healthy
+        with self._cv:
+            self._seq += 1
+            job = _BatchJob(live, arrays, bucket, rows, self._seq)
+            self._inflight.add(job)
+            self._inflight_rows += rows
+        for r in live:
+            if r.t_dispatch is None:
+                r.t_dispatch = time.monotonic()
+                self._observe("serving_queue_wait_seconds",
+                              r.t_dispatch - r.arrival)
+        self._count("serving_batches_total")
+        self._gauge("serving_batch_occupancy", rows / float(bucket))
+        replica.queue.put(job)
+
+    def _pick_replica(self, live: List[Request]) -> Optional[_Replica]:
+        """Round-robin over healthy replicas; unhealthy ones are probed
+        out of probation. Blocks while none is available (requests keep
+        their deadlines and are shed here if they all expire)."""
+        while not self._stopped:
+            now = time.monotonic()
+            n = len(self.replicas)
+            for k in range(n):
+                r = self.replicas[(self._rr + k) % n]
+                # the pending cap is backpressure: excess work waits in
+                # the admission queue (where deadlines apply), not in a
+                # replica queue where a slow replica would strand it
+                if r.maybe_readmit(now) and r.pending() < 2:
+                    self._rr = (self._rr + k + 1) % n
+                    return r
+            still = [r for r in live if not r.expired(now)]
+            if not still:
+                for r in live:
+                    self._terminal(r, EXPIRED,
+                                   cause="deadline_expired_in_queue")
+                return None
+            # while blocked here the batcher is not forming batches, so
+            # reap deadline-expired queue entries in place — an expired
+            # request must terminate promptly, not wait for capacity
+            with self._cv:
+                reap = self._pop_expired_locked(now)
+            for r in reap:
+                self._terminal(r, EXPIRED, cause="deadline_expired_in_queue")
+            time.sleep(0.005)
+        return None
+
+    @staticmethod
+    def _pad_concat(batch: List[Request], bucket: int) -> List[np.ndarray]:
+        n_inputs = len(batch[0].inputs)
+        arrays = []
+        for i in range(n_inputs):
+            cat = np.concatenate([r.inputs[i] for r in batch], axis=0)
+            pad = bucket - cat.shape[0]
+            if pad > 0:
+                # repeat the last row: stays in-domain for token inputs
+                cat = np.concatenate(
+                    [cat, np.repeat(cat[-1:], pad, axis=0)], axis=0)
+            arrays.append(cat)
+        return arrays
+
+    # -- replica execution ---------------------------------------------------
+
+    def _worker_loop(self, replica: _Replica, gen: int,
+                     q: _queue_mod.Queue):
+        while not self._stopped:
+            try:
+                job = q.get(timeout=0.1)
+            except _queue_mod.Empty:
+                continue
+            if job is None:
+                return
+            with replica.lock:
+                stale = replica.generation != gen
+            if stale:
+                # abandoned generation: this worker raced the respawn's
+                # queue drain and won the get() — requeue, never drop
+                if job.try_cancel():
+                    if job.timer is not None:
+                        job.timer.cancel()
+                    self._finish_inflight(job)
+                    self._requeue(job.requests)
+                continue
+            self._execute_on(replica, job)
+
+    def _execute_on(self, replica: _Replica, job: _BatchJob):
+        # the per-call deadline measures EXECUTION, not queue time — it
+        # is armed here, when the worker picks the job up, so a busy
+        # (healthy) replica with queued work is never mistaken for a
+        # wedged one
+        job.timer = threading.Timer(self.cfg.call_timeout_s,
+                                    self._on_call_timeout, (replica, job))
+        job.timer.daemon = True
+        job.timer.start()
+        t0 = time.monotonic()
+        try:
+            spec = faults.fire_spec("replica_stall", step=job.seq,
+                                    site="serving_execute")
+            if spec is not None:
+                # simulated wedged device call: block until the per-call
+                # deadline cancels the job (or the server stops)
+                while not (job.cancelled or self._stopped):
+                    time.sleep(0.005)
+                return
+            faults.maybe_raise("serving_io", step=job.seq,
+                               site="serving_execute")
+            outs = replica.fn(job.arrays)
+        except Exception as e:  # noqa: BLE001 - any executor error fails over
+            self._on_execute_error(replica, job, e)
+            return
+        self._on_batch_done(replica, job, outs, time.monotonic() - t0)
+
+    def _on_batch_done(self, replica: _Replica, job: _BatchJob,
+                       outs, dt: float):
+        if not job.try_finish():
+            return  # per-call deadline already fired; requests requeued
+        if job.timer is not None:
+            job.timer.cancel()
+        self._finish_inflight(job)
+        outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+        outs = [np.asarray(o) for o in outs]
+        self._observe("serving_execute_seconds", dt)
+        # service-rate EWMA feeds the admission wait model
+        a = self.cfg.rate_ewma
+        rate = job.rows / max(dt, 1e-9)
+        with self._cv:
+            self._ewma_rows_per_s = rate if self._ewma_rows_per_s is None \
+                else a * rate + (1 - a) * self._ewma_rows_per_s
+            self._ewma_batch_s = dt if self._ewma_batch_s is None \
+                else a * dt + (1 - a) * self._ewma_batch_s
+        off = 0
+        for r in job.requests:
+            sl = [o[off:off + r.rows] for o in outs]
+            off += r.rows
+            if r._seal(COMPLETED, outputs=sl):
+                self._count_outcome(COMPLETED)
+                self._count("serving_tokens_total", n=r.tokens)
+                self._observe("serving_e2e_seconds", r.t_done - r.arrival)
+
+    def _on_execute_error(self, replica: _Replica, job: _BatchJob,
+                          err: BaseException):
+        if not job.try_cancel():
+            return
+        if job.timer is not None:
+            job.timer.cancel()
+        self._finish_inflight(job)
+        self._count("serving_execute_errors_total",
+                    error=type(err).__name__)
+        self._count("serving_replica_failover_total")
+        self._count_only("failovers")
+        replica.mark_unhealthy("io_error")
+        self._requeue(job.requests)
+
+    def _on_call_timeout(self, replica: _Replica, job: _BatchJob):
+        if not job.try_cancel():
+            return
+        self._finish_inflight(job)
+        self._count("serving_replica_failover_total")
+        self._count_only("failovers")
+        # the wedged thread cannot be interrupted — bench the replica
+        # and serve it with a fresh worker after probation
+        replica.mark_unhealthy("stall", respawn=True)
+        self._requeue(job.requests)
+
+    def _abandon_queue(self, q: _queue_mod.Queue):
+        """Requeue every job still sitting in a dead replica's queue —
+        nothing in an abandoned queue may be silently lost."""
+        while True:
+            try:
+                job = q.get_nowait()
+            except _queue_mod.Empty:
+                return
+            if job is None:
+                continue
+            if job.try_cancel():
+                if job.timer is not None:
+                    job.timer.cancel()
+                self._finish_inflight(job)
+                self._requeue(job.requests)
+
+    def _finish_inflight(self, job: _BatchJob):
+        with self._cv:
+            if job in self._inflight:
+                self._inflight.discard(job)
+                self._inflight_rows -= job.rows
+
+    def _requeue(self, requests: List[Request]):
+        """Return a failed batch's requests to the FRONT of the queue
+        (they were already admitted — no re-admission checks), shedding
+        the ones whose deadline has meanwhile passed."""
+        now = time.monotonic()
+        back: List[Request] = []
+        for r in requests:
+            if r.done():
+                continue
+            if r.expired(now):
+                self._terminal(r, EXPIRED,
+                               cause="deadline_expired_in_queue")
+                continue
+            r.attempts += 1
+            if r.attempts >= self.cfg.max_attempts:
+                # a deadline-less request must still terminate: cap the
+                # failover bounces so a poisoned batch cannot circulate
+                if r._seal(FAILED, error=ServingError(
+                        f"request {r.id} failed after {r.attempts} "
+                        f"dispatch attempts")):
+                    self._count_outcome(FAILED)
+                continue
+            back.append(r)
+        if not back:
+            return
+        self._count("serving_requeued_requests_total", n=len(back))
+        self._count_only("requeues", n=len(back))
+        with self._cv:
+            for r in reversed(back):
+                self._deque.appendleft(r)
+            self._gauge("serving_queue_depth", len(self._deque))
+            self._cv.notify_all()
+
+    # -- accounting / telemetry ---------------------------------------------
+
+    def _terminal(self, req: Request, state: str, cause: str):
+        if not req._seal(state, cause=cause):
+            return
+        self._count_outcome(state)
+        self._count("serving_requests_shed_total", cause=cause)
+        with self._clock:
+            self.shed_causes[cause] += 1
+
+    def _count_outcome(self, outcome: str):
+        with self._clock:
+            self.counts[outcome] += 1
+        from .. import telemetry
+        if telemetry.enabled():
+            telemetry.counter(
+                "serving_requests_total",
+                "serving requests by terminal outcome").inc(outcome=outcome)
+
+    def _count_only(self, key: str, n: int = 1):
+        with self._clock:
+            self.counts[key] += n
+
+    def _count(self, name: str, n: float = 1, **labels):
+        if name in ("serving_recompiles_total", "serving_batches_total",
+                    "serving_tokens_total"):
+            with self._clock:
+                self.counts[name.replace("serving_", "")
+                            .replace("_total", "")] += int(n)
+        from .. import telemetry
+        if telemetry.enabled():
+            telemetry.counter(name, "").inc(n, **labels)
+
+    def _gauge(self, name: str, v: float):
+        from .. import telemetry
+        if telemetry.enabled():
+            telemetry.gauge(name, "").set(v)
+
+    def _observe(self, name: str, v: float):
+        from .. import telemetry
+        if telemetry.enabled():
+            telemetry.histogram(name, "").observe(v)
+
+    def _set_healthy_gauge(self):
+        self._gauge("serving_replicas_healthy",
+                    sum(1 for r in self.replicas if r.healthy))
+
+    def stats(self) -> Dict[str, object]:
+        """Snapshot of the server-owned accounting (independent of the
+        telemetry registry so tests and the bench need no scope)."""
+        with self._clock:
+            counts = dict(self.counts)
+            causes = dict(self.shed_causes)
+        with self._cv:
+            depth = len(self._deque)
+            inflight = len(self._inflight)
+        return {
+            "submitted": counts.get("submitted", 0),
+            "completed": counts.get(COMPLETED, 0),
+            "shed": counts.get(SHED, 0),
+            "expired": counts.get(EXPIRED, 0),
+            "failed": counts.get(FAILED, 0),
+            "shed_causes": causes,
+            "failovers": counts.get("failovers", 0),
+            "requeues": counts.get("requeues", 0),
+            "batches": counts.get("batches", 0),
+            "recompiles": counts.get("recompiles", 0),
+            "tokens": counts.get("tokens", 0),
+            "queue_depth": depth,
+            "inflight_batches": inflight,
+            "replicas_healthy": sum(1 for r in self.replicas if r.healthy),
+        }
+
+    def accounted(self) -> bool:
+        """The zero-silent-loss invariant: every submitted request is in
+        a terminal bucket."""
+        s = self.stats()
+        return s["submitted"] == (s["completed"] + s["shed"]
+                                  + s["expired"] + s["failed"])
+
+    # -- constructors --------------------------------------------------------
+
+    @classmethod
+    def from_config(cls, config, replicas: int = 1,
+                    serving: Optional[ServingConfig] = None
+                    ) -> "InferenceServer":
+        """Build a server over ``replicas`` Predictors for an inference
+        ``Config`` (the pool shares one loaded layer via the per-prefix
+        load cache)."""
+        from . import PredictorPool
+        pool = PredictorPool(config, replicas)
+        fns = [predictor_executor(pool.retrieve(i))
+               for i in range(replicas)]
+        return cls(fns, config=serving)
+
+
+def predictor_executor(pred) -> Callable:
+    """Adapt a ``Predictor`` to the server's executor signature."""
+
+    def fn(arrays: List[np.ndarray]) -> List[np.ndarray]:
+        return pred.run(list(arrays))
+
+    return fn

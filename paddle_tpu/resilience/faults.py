@@ -40,6 +40,14 @@ kind            effect at the instrumented site
                 collective (integrity.simulate_hang); recovery is the
                 hang watchdog firing, heartbeats stopping, and peers
                 remeshing around the silent host
+``serving_io``  the serving replica's batch execute raises ``IOError``
+                (inference.serving); recovery is failover — the batch's
+                requests requeue to the surviving replicas and the
+                faulty one enters backoff probation
+``replica_stall``  the serving replica wedges inside the batch execute
+                like a stuck device call; recovery is the per-call
+                deadline firing, the wedged worker being abandoned, and
+                the requests requeuing to survivors
 ==============  ==========================================================
 
 Determinism: ``at_step`` fires exactly when the site reports that step;
@@ -64,7 +72,7 @@ __all__ = ["KINDS", "SimulatedCrash", "HostLost", "inject", "fires",
 
 KINDS = ("ckpt_io", "ckpt_torn", "nan_grad", "data_fetch", "sigterm",
          "host_loss", "host_join", "restore_divergence", "param_flip",
-         "host_hang")
+         "host_hang", "serving_io", "replica_stall")
 
 
 class SimulatedCrash(RuntimeError):

@@ -87,6 +87,32 @@ hosts_quarantined_total        counter    resilience.integrity replicas /
 hang_watchdog_fired_total      counter    HangWatchdog deadlines blown
                                           (step armed but not disarmed in
                                           time)
+serving_requests_total         counter    inference.serving request
+                                          outcomes {outcome=completed|
+                                          shed|expired|failed}
+serving_requests_shed_total    counter    admission rejections {cause=
+                                          queue_full|deadline_infeasible|
+                                          deadline_expired_in_queue|
+                                          draining}
+serving_queue_wait_seconds     histogram  admission -> first dispatch
+serving_execute_seconds        histogram  replica batch execute
+serving_e2e_seconds            histogram  admission -> terminal state
+serving_batch_occupancy        gauge      dispatched rows / bucket rows
+serving_queue_depth            gauge      admission deque length
+serving_batches_total          counter    batches dispatched
+serving_recompiles_total       counter    first-seen (signature, bucket)
+                                          pairs — stops growing once the
+                                          compiled set closes
+serving_tokens_total           counter    tokens completed
+serving_replica_failover_total counter    batches failed over to another
+                                          replica
+serving_replica_unhealthy_total counter   replicas benched {reason=
+                                          stall|io_error}
+serving_replicas_healthy       gauge      replicas currently in rotation
+serving_requeued_requests_total counter   requests requeued by failover
+serving_execute_errors_total   counter    executor exceptions {error=...}
+serving_weight_compression_x   gauge      fp weight bytes / quantized
+                                          bytes {policy=int8|int4}
 =============================  =========  =================================
 
 Multi-host merge: ``telemetry.aggregate.gather_registries()`` allgathers

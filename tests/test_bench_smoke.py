@@ -239,6 +239,36 @@ def test_replay_step_smoke():
     assert res["tampered_verdict"] == "sdc"
 
 
+def test_bench_serving_smoke():
+    """tools/bench_serving.py --smoke: the ISSUE 10 acceptance path —
+    Poisson open-loop traffic against the serving runtime: the 2x
+    overload phase sheds with the completed p99 within deadline, goodput
+    stays within a bounded band of baseline, an injected replica_stall
+    fails over with zero admitted-and-feasible requests lost, and the
+    recompile count stops growing after warmup (shape buckets closed)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_serving.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=400, env=_env())
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert lines, f"no stdout; stderr: {proc.stderr[-2000:]}"
+    res = json.loads(lines[-1])
+    extra = res["extra"]
+    assert extra["exit_code"] == 0, res
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert res["metric"] == "serving_overload_goodput_rps"
+    assert res["value"] > 0
+    assert all(extra["checks"].values()), extra["checks"]
+    assert extra["requests_shed_total"] > 0
+    assert extra["overload"]["p99_s"] <= extra["overload"]["deadline_s"]
+    assert extra["replica_failover_total"] >= 1
+    assert extra["failover"]["stall_fired"] == 1
+    assert extra["failover"]["failed"] == 0
+    assert extra["accounted"] is True
+    assert extra["serving_recompiles_total"]["closed"] is True
+    assert extra["telemetry"]["prometheus_bytes"] > 0
+
+
 def test_numerics_smoke_cpu():
     """tools/numerics_smoke.py: all kernel-vs-dense checks pass on the
     CPU interpreter; on-chip runs reuse the same script (r3 item 10)."""

@@ -1,0 +1,187 @@
+"""Base inference handle API + serving satellites: Config state
+preservation across set_model, Tensor handle direction checks, the
+per-prefix load cache a PredictorPool shares, the _n_user_inputs
+fallback for non-conforming exports, and int8/int4 weight-only
+quantization of served models (inference/quant.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference, nn
+from paddle_tpu.inference import quant
+from paddle_tpu.jit import InputSpec
+
+
+@pytest.fixture()
+def saved_model(tmp_path):
+    paddle.seed(3)
+    net = nn.Linear(32, 16)
+    net.eval()
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([None, 32], "float32")])
+    return net, prefix
+
+
+# -- Config -------------------------------------------------------------------
+
+def test_set_model_preserves_device_profile_and_quant():
+    cfg = inference.Config("/a/model")
+    cfg.disable_gpu()
+    cfg.enable_profile()
+    cfg.enable_weight_quantize("int8", block=64)
+    cfg.set_model("/b/other")
+    assert cfg.prog_file() == "/b/other.stablehlo"
+    assert cfg.params_file() == "/b/other.pdiparams"
+    assert cfg._device == "cpu"
+    assert cfg._enable_profile is True
+    assert cfg._weight_quant == ("int8", 64)
+    # suffixes are normalized away like in __init__
+    cfg.set_model("/c/m.pdmodel")
+    assert cfg.prog_file() == "/c/m.stablehlo"
+    assert cfg._device == "cpu"
+
+
+def test_enable_weight_quantize_validates_policy():
+    cfg = inference.Config("/a/model")
+    with pytest.raises(ValueError, match="int8/int4"):
+        cfg.enable_weight_quantize("fp8")
+    cfg.enable_weight_quantize("int4")
+    assert cfg._weight_quant == ("int4", None)
+
+
+# -- Tensor handles -----------------------------------------------------------
+
+def test_tensor_handle_direction_enforced(saved_model):
+    _, prefix = saved_model
+    pred = inference.create_predictor(inference.Config(prefix))
+    x = np.zeros((2, 32), "float32")
+    inp = pred.get_input_handle("x0")
+    inp.copy_from_cpu(x)
+    assert inp.shape() == [2, 32]
+    assert inp.name() == "x0"
+    with pytest.raises(AssertionError):
+        inp.copy_to_cpu()  # cannot read an input handle
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0])
+    assert out.copy_to_cpu().shape == (2, 16)
+    with pytest.raises(AssertionError):
+        out.copy_from_cpu(x)  # cannot write an output handle
+
+
+# -- load cache / pool sharing ------------------------------------------------
+
+def test_pool_shares_one_loaded_layer(saved_model, monkeypatch):
+    from paddle_tpu import jit as jit_mod
+    _, prefix = saved_model
+    inference.clear_layer_cache()
+    calls = []
+    real_load = jit_mod.load
+
+    def counting_load(p, *a, **kw):
+        calls.append(p)
+        return real_load(p, *a, **kw)
+
+    monkeypatch.setattr(jit_mod, "load", counting_load)
+    pool = inference.PredictorPool(inference.Config(prefix), 3)
+    assert len(calls) == 1, "pool members must share the cached layer"
+    layers = {id(pool.retrieve(i)._layer) for i in range(3)}
+    assert len(layers) == 1
+    # a different quant spec is a different cache entry over the SAME
+    # raw load (the quantized view derives from the cached fp layer)
+    qcfg = inference.Config(prefix)
+    qcfg.enable_weight_quantize("int8", block=64)
+    qpred = inference.create_predictor(qcfg)
+    assert len(calls) == 1
+    assert id(qpred._layer) not in layers
+    inference.clear_layer_cache()
+
+
+def test_stale_artifact_is_reloaded(saved_model, monkeypatch):
+    import os
+    from paddle_tpu import jit as jit_mod
+    _, prefix = saved_model
+    inference.clear_layer_cache()
+    calls = []
+    real_load = jit_mod.load
+
+    def counting_load(p, *a, **kw):
+        calls.append(p)
+        return real_load(p, *a, **kw)
+
+    monkeypatch.setattr(jit_mod, "load", counting_load)
+    inference.create_predictor(inference.Config(prefix))
+    # touching the artifact invalidates the cache key (mtime_ns changed)
+    st = os.stat(prefix + ".pdiparams")
+    os.utime(prefix + ".pdiparams",
+             ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+    inference.create_predictor(inference.Config(prefix))
+    assert len(calls) == 2
+    inference.clear_layer_cache()
+
+
+# -- _n_user_inputs fallback --------------------------------------------------
+
+def test_n_user_inputs_fallback_on_foreign_export():
+    class _Stub:
+        _exported = object()  # no in_tree at all
+
+    p = inference.Predictor.__new__(inference.Predictor)
+    p._layer = _Stub()
+    assert p._n_user_inputs() == 1
+
+
+# -- weight quantization ------------------------------------------------------
+
+def test_quantize_roundtrip_error_bounds():
+    rng = np.random.RandomState(0)
+    x = (rng.randn(37, 19) * 3.0).astype("float32")
+    for policy, levels in (("int8", 127.0), ("int4", 7.0)):
+        qa = quant.quantize_array(x, policy, block=32)
+        back = quant.dequantize_array(qa)
+        assert back.shape == x.shape and back.dtype == x.dtype
+        # per-block bound: |err| <= max|x| / levels (scale granularity)
+        assert np.max(np.abs(back - x)) <= np.abs(x).max() / levels + 1e-6
+    with pytest.raises(ValueError):
+        quant.quantize_array(x, "fp8")
+
+
+def test_quantize_state_passthrough_and_compression():
+    rng = np.random.RandomState(1)
+    state = {
+        "w": rng.randn(64, 32).astype("float32"),
+        "b": rng.randn(8).astype("float32"),      # smaller than a block
+        "steps": np.arange(100, dtype="int64"),   # not float
+    }
+    q = quant.quantize_state(state, "int8", block=32)
+    assert isinstance(q["w"], quant.QuantizedArray)
+    assert isinstance(q["b"], np.ndarray)         # passthrough
+    assert isinstance(q["steps"], np.ndarray)
+    assert quant.state_bytes(q) < quant.state_bytes(
+        {k: np.asarray(v) for k, v in state.items()})
+    back = quant.dequantize_state(q)
+    np.testing.assert_array_equal(back["b"], state["b"])
+    np.testing.assert_array_equal(back["steps"], state["steps"])
+    assert back["w"].shape == state["w"].shape
+    # int4 nibble-packing halves the payload vs int8
+    q4 = quant.quantize_state(state, "int4", block=32)
+    assert q4["w"].q.nbytes == q["w"].q.nbytes // 2
+
+
+def test_quantized_predictor_close_to_fp32(saved_model):
+    net, prefix = saved_model
+    inference.clear_layer_cache()
+    cfg = inference.Config(prefix)
+    cfg.enable_weight_quantize("int8", block=16)
+    pred = inference.create_predictor(cfg)
+    x = np.random.RandomState(5).rand(4, 32).astype("float32")
+    got = pred.run([x])[0]
+    want = np.asarray(net(x))
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=0.1, rtol=0.05)
+    assert not np.allclose(got, want, atol=1e-9)  # quantization happened
+    layer, stats = quant.quantized_layer(
+        inference._load_layer(prefix), "int8", block=16)
+    assert stats["n_quantized"] >= 1
+    assert stats["compression_x"] > 3.0
+    inference.clear_layer_cache()

@@ -1,0 +1,413 @@
+"""Serving runtime (paddle_tpu/inference/serving.py): admission control,
+continuous batching over shape buckets, deadline handling, replica
+failover, drain — every path driven deterministically with gate-blocked
+fake executors (the Predictor e2e at the bottom is the only jax user).
+
+The load-bearing invariant asserted throughout: every submitted request
+terminates in exactly ONE of completed / shed / expired / failed
+(``InferenceServer.accounted``) — overload and failover may shed, but
+never silently lose, an accepted request.
+"""
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import telemetry
+from paddle_tpu.inference import serving
+from paddle_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.reset()
+
+
+def wait_until(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def double_fn(arrays):
+    return [np.asarray(a) * 2.0 for a in arrays]
+
+
+class Gate:
+    """Executor that blocks every batch until released — makes queue /
+    backlog states reachable deterministically."""
+
+    def __init__(self):
+        self.entered = threading.Event()
+        self.release = threading.Event()
+        self.calls = 0
+        self._lock = threading.Lock()
+
+    def fn(self, arrays):
+        with self._lock:
+            self.calls += 1
+        self.entered.set()
+        assert self.release.wait(30), "gate never released"
+        return double_fn(arrays)
+
+
+def rows(n, dim=2, seed=0):
+    return [np.random.RandomState(seed).rand(n, dim).astype("float32")]
+
+
+# -- admission + completion ---------------------------------------------------
+
+def test_submit_complete_roundtrip():
+    with serving.InferenceServer(double_fn, replicas=2) as srv:
+        reqs = [srv.submit(rows(n, seed=n)) for n in (1, 2, 3)]
+        for n, r in zip((1, 2, 3), reqs):
+            out = r.result(timeout=10)
+            assert out[0].shape == (n, 2)
+            np.testing.assert_allclose(out[0], rows(n, seed=n)[0] * 2.0,
+                                       rtol=1e-6)
+            assert r.latency is not None and r.latency >= 0
+        s = srv.stats()
+        assert s["submitted"] == 3 and s["completed"] == 3
+        assert s["shed"] == s["expired"] == s["failed"] == 0
+        assert srv.accounted()
+
+
+def test_submit_input_validation():
+    with pytest.raises(ValueError):
+        serving.Request([])
+    with pytest.raises(ValueError):
+        serving.Request([np.zeros((2, 3)), np.zeros((3, 3))])  # row mismatch
+    with pytest.raises(ValueError):
+        serving.InferenceServer([])
+
+
+def test_request_signature_and_tokens():
+    r = serving.Request([np.zeros((2, 3), "float32"),
+                         np.zeros((2, 5), "int32")], tokens=17)
+    assert r.rows == 2 and r.tokens == 17
+    same = serving.Request([np.ones((4, 3), "float32"),
+                            np.ones((4, 5), "int32")])
+    assert r.signature() == same.signature()
+    assert same.tokens == 4  # defaults to rows
+    other = serving.Request([np.zeros((2, 4), "float32")])
+    assert r.signature() != other.signature()
+
+
+# -- batching + shape buckets -------------------------------------------------
+
+def test_coalescing_padding_and_bucket_closure():
+    """Backlogged same-signature requests coalesce into one padded
+    bucketed batch; once every bucket of a signature is seen, further
+    traffic causes ZERO new recompiles (the closed-bucket-set claim)."""
+    gate = Gate()
+    cfg = serving.ServingConfig(max_batch=4, batch_wait_s=0.005,
+                                call_timeout_s=60.0)
+    with serving.InferenceServer(gate.fn, replicas=1, config=cfg) as srv:
+        # wedge the pipeline: one batch executing (gate), two distinct-
+        # signature batches in the replica's pending slots, one parked
+        # in the dispatcher — new work can only accumulate in the deque
+        blockers = [srv.submit(rows(1, dim=d)) for d in (9, 5, 7)]
+        wait_until(gate.entered.is_set, msg="blocker executing")
+        wait_until(lambda: srv.replicas[0].pending() == 2,
+                   msg="pending slots full")
+        blockers.append(srv.submit(rows(1, dim=11)))
+        wait_until(lambda: srv.stats()["queue_depth"] == 0,
+                   msg="dispatcher parked")
+        # backlog 6 same-signature single-row requests while wedged
+        reqs = [srv.submit(rows(1, seed=i)) for i in range(6)]
+        assert srv.stats()["queue_depth"] == 6
+        gate.release.set()
+        outs = [r.result(timeout=10) for r in reqs]
+        for b in blockers:
+            b.result(timeout=10)
+        for i, o in enumerate(outs):
+            np.testing.assert_allclose(o[0], rows(1, seed=i)[0] * 2.0,
+                                       rtol=1e-6)
+        s = srv.stats()
+        # the 6 backlogged requests coalesced into exactly 2 padded
+        # batches (4-row bucket + 2-row bucket) after the 4 blockers
+        assert s["batches"] == 4 + 2
+        assert s["recompiles"] == len(srv._seen_shapes)
+        # warm the remaining buckets of the dim-2 signature, then prove
+        # the compiled set is CLOSED under more traffic
+        for n in (1, 2, 4):
+            srv.submit(rows(n)).result(timeout=10)
+        warm = srv.stats()["recompiles"]
+        more = [srv.submit(rows(1 + (i % 4), seed=i)) for i in range(12)]
+        for r in more:
+            r.result(timeout=10)
+        assert srv.stats()["recompiles"] == warm
+        assert srv.accounted()
+
+
+def test_padded_batch_slices_per_request_rows():
+    """A 3-row batch pads to bucket 4; each request gets exactly its own
+    rows back (the pad rows never leak into results)."""
+    seen = []
+
+    def spy(arrays):
+        seen.append(tuple(a.shape for a in arrays))
+        return double_fn(arrays)
+
+    cfg = serving.ServingConfig(max_batch=4, batch_wait_s=0.01,
+                                call_timeout_s=60.0)
+    with serving.InferenceServer(spy, replicas=1, config=cfg) as srv:
+        a = srv.submit(rows(1, seed=1))
+        b = srv.submit(rows(2, seed=2))
+        np.testing.assert_allclose(a.result(10)[0],
+                                   rows(1, seed=1)[0] * 2.0, rtol=1e-6)
+        np.testing.assert_allclose(b.result(10)[0],
+                                   rows(2, seed=2)[0] * 2.0, rtol=1e-6)
+        # every executed array was padded to a power-of-two bucket
+        assert all(shape[0][0] in (1, 2, 4) for shape in seen)
+
+
+# -- shedding -----------------------------------------------------------------
+
+def _backlogged_server(gate, max_queue=2):
+    """1 replica, max_batch=1: gate-block the replica, fill its pending
+    slots, park one batch in the dispatcher — the admission deque is now
+    the only place left for new work."""
+    cfg = serving.ServingConfig(max_queue=max_queue, max_batch=1,
+                                batch_wait_s=0.001, call_timeout_s=60.0)
+    srv = serving.InferenceServer(gate.fn, replicas=1, config=cfg).start()
+    srv.submit(rows(1))                       # executing, holds the gate
+    wait_until(gate.entered.is_set, msg="executor entered")
+    srv.submit(rows(1))
+    srv.submit(rows(1))
+    wait_until(lambda: srv.replicas[0].pending() == 2,
+               msg="replica pending slots full")
+    parked = srv.submit(rows(1))              # batcher parks in dispatch
+    wait_until(lambda: srv.stats()["queue_depth"] == 0,
+               msg="dispatcher holds the parked batch")
+    return srv, parked
+
+
+def test_queue_full_shed():
+    gate = Gate()
+    srv, _ = _backlogged_server(gate, max_queue=2)
+    try:
+        srv.submit(rows(1))
+        srv.submit(rows(1))                   # deque now at max_queue
+        rejected = srv.submit(rows(1))
+        assert rejected.done() and rejected.state == "shed"
+        with pytest.raises(serving.RequestShed) as ei:
+            rejected.result(timeout=1)
+        assert ei.value.cause == "queue_full"
+        gate.release.set()
+        wait_until(srv.accounted, msg="all requests terminal")
+        assert srv.stats()["shed_causes"]["queue_full"] == 1
+    finally:
+        gate.release.set()
+        srv.shutdown(drain=True, timeout=10)
+
+
+def test_deadline_infeasible_shed_uses_healthy_replica_count():
+    with serving.InferenceServer(double_fn, replicas=2) as srv:
+        # prime the service model: 1 row/s per replica, 5 s batch latency
+        with srv._cv:
+            srv._ewma_rows_per_s = 1.0
+            srv._ewma_batch_s = 5.0
+        assert srv.modeled_wait(1) == pytest.approx(0.5 + 5.0)
+        hopeless = srv.submit(rows(1), deadline_s=0.5)
+        assert hopeless.state == "shed"
+        with pytest.raises(serving.RequestShed) as ei:
+            hopeless.result(timeout=1)
+        assert ei.value.cause == "deadline_infeasible"
+        # a feasible deadline is admitted and completes
+        ok = srv.submit(rows(1), deadline_s=60.0)
+        assert ok.result(timeout=10)[0].shape == (1, 2)
+        # benching a replica halves the modeled drain rate
+        srv.replicas[1].healthy = False
+        with srv._cv:
+            srv._ewma_rows_per_s, srv._ewma_batch_s = 1.0, 5.0
+        assert srv.modeled_wait(1) == pytest.approx(1.0 + 5.0)
+        srv.replicas[1].healthy = True
+        assert srv.accounted()
+
+
+def test_deadline_expired_in_queue():
+    gate = Gate()
+    srv, _ = _backlogged_server(gate)
+    try:
+        doomed = srv.submit(rows(1), deadline_s=0.15)
+        # admitted: the EWMA is cold (no completed batch yet), and a
+        # blind admission model cannot reject
+        assert doomed.state == "pending"
+        with pytest.raises(serving.DeadlineExpired):
+            doomed.result(timeout=10)
+        assert doomed.cause == "deadline_expired_in_queue"
+        gate.release.set()
+        wait_until(srv.accounted, msg="all requests terminal")
+        assert srv.stats()["expired"] == 1
+    finally:
+        gate.release.set()
+        srv.shutdown(drain=True, timeout=10)
+
+
+# -- failover -----------------------------------------------------------------
+
+def test_replica_stall_failover_zero_loss():
+    """An injected wedged device call: the per-call deadline fires, the
+    wedged worker is abandoned (fresh generation), the batch requeues to
+    the survivor, and every request still completes."""
+    cfg = serving.ServingConfig(max_batch=4, call_timeout_s=0.15,
+                                probation_base_s=0.02,
+                                probation_max_s=0.2, seed=3)
+    with serving.InferenceServer(double_fn, replicas=2, config=cfg) as srv:
+        with faults.inject("replica_stall", at_step=1) as spec:
+            reqs = [srv.submit(rows(1, seed=i)) for i in range(4)]
+            for i, r in enumerate(reqs):
+                np.testing.assert_allclose(r.result(timeout=20)[0],
+                                           rows(1, seed=i)[0] * 2.0,
+                                           rtol=1e-6)
+        assert spec.fired == 1
+        s = srv.stats()
+        assert s["failovers"] >= 1
+        assert s["requeues"] >= 1
+        assert s["completed"] == 4 and s["failed"] == 0
+        assert srv.accounted()
+        # the benched replica re-admits after probation — the probe
+        # happens at dispatch, so keep traffic flowing while polling
+        deadline = time.monotonic() + 10.0
+        while (srv.stats()["replicas_healthy"] < 2
+               and time.monotonic() < deadline):
+            srv.submit(rows(1)).result(timeout=20)
+            time.sleep(0.01)
+        assert srv.stats()["replicas_healthy"] == 2
+
+
+def test_serving_io_failover():
+    """An injected executor IOError fails the batch over to the other
+    replica (no respawn needed — the worker survived the exception)."""
+    cfg = serving.ServingConfig(call_timeout_s=5.0, probation_base_s=0.02,
+                                probation_max_s=0.2)
+    with serving.InferenceServer(double_fn, replicas=2, config=cfg) as srv:
+        with faults.inject("serving_io", at_step=1) as spec:
+            r = srv.submit(rows(2))
+            np.testing.assert_allclose(r.result(timeout=20)[0],
+                                       rows(2)[0] * 2.0, rtol=1e-6)
+        assert spec.fired == 1
+        s = srv.stats()
+        assert s["failovers"] >= 1 and s["failed"] == 0
+        assert srv.accounted()
+
+
+def test_terminal_failure_after_max_attempts():
+    """With every replica broken, a deadline-less request must not
+    bounce forever: the attempts cap seals it FAILED (still accounted)."""
+
+    def broken(arrays):
+        raise RuntimeError("boom")
+
+    cfg = serving.ServingConfig(call_timeout_s=5.0, max_attempts=2,
+                                probation_base_s=0.005,
+                                probation_max_s=0.02)
+    with serving.InferenceServer(broken, replicas=1, config=cfg) as srv:
+        r = srv.submit(rows(1))
+        with pytest.raises(serving.ServingError,
+                           match="after 2 dispatch attempts"):
+            r.result(timeout=30)
+        s = srv.stats()
+        assert s["failed"] == 1 and s["completed"] == 0
+        assert s["failovers"] >= 1
+        assert srv.accounted()
+
+
+# -- drain --------------------------------------------------------------------
+
+def test_shutdown_drain_then_shed_draining():
+    srv = serving.InferenceServer(double_fn, replicas=1).start()
+    reqs = [srv.submit(rows(1, seed=i)) for i in range(3)]
+    srv.shutdown(drain=True, timeout=10)
+    for r in reqs:  # accepted work finished before stopping
+        assert r.state == "completed"
+    late = srv.submit(rows(1))
+    with pytest.raises(serving.RequestShed) as ei:
+        late.result(timeout=1)
+    assert ei.value.cause == "draining"
+    assert srv.stats()["shed_causes"]["draining"] == 1
+    assert srv.accounted()
+
+
+def test_sigterm_triggers_drain():
+    import os
+    prev = signal.getsignal(signal.SIGTERM)
+    # earlier tests can leave a process-global SIGTERM handler behind
+    # (fleet/elastic raises SystemExit) — pin a benign one so the chain
+    # install_sigterm_drain builds on top of it is inert here
+    signal.signal(signal.SIGTERM, lambda *a: None)
+    srv = serving.InferenceServer(double_fn, replicas=1).start()
+    try:
+        srv.install_sigterm_drain()
+        assert signal.getsignal(signal.SIGTERM) is not prev
+        done = srv.submit(rows(1))
+        done.result(timeout=10)
+        os.kill(os.getpid(), signal.SIGTERM)
+        wait_until(lambda: srv.draining, timeout=5, msg="drain flag")
+        wait_until(lambda: srv._stopped, timeout=10, msg="server stopped")
+        late = srv.submit(rows(1))
+        assert late.state == "shed" and late.cause == "draining"
+        assert srv.accounted()
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+        srv.shutdown(drain=False, timeout=5)
+
+
+# -- telemetry ----------------------------------------------------------------
+
+def test_serving_telemetry_series():
+    with telemetry.scope(profile=False) as sc:
+        cfg = serving.ServingConfig(max_batch=2)
+        with serving.InferenceServer(double_fn, replicas=1,
+                                     config=cfg) as srv:
+            for i in range(4):
+                srv.submit(rows(1, seed=i)).result(timeout=10)
+            hopeless = srv.submit(rows(1), deadline_s=-1.0)
+            assert hopeless.state in ("shed", "expired")
+        text = sc.prometheus_text()
+    for series in ("serving_requests_total", "serving_batches_total",
+                   "serving_recompiles_total", "serving_queue_wait_seconds",
+                   "serving_e2e_seconds", "serving_replicas_healthy"):
+        assert series in text, f"missing {series} in exposition"
+    assert 'outcome="completed"' in text
+
+
+# -- Predictor-backed end-to-end ---------------------------------------------
+
+def test_predictor_e2e_shape_polymorphic(tmp_path):
+    """from_config over a jit.saved shape-polymorphic model: varying row
+    counts serve correctly through padding/slicing, and the bucket set
+    closes after warmup."""
+    import paddle_tpu as paddle
+    from paddle_tpu import inference, nn
+    from paddle_tpu.jit import InputSpec
+
+    paddle.seed(11)
+    net = nn.Linear(6, 3)
+    net.eval()
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([None, 6], "float32")])
+    cfg = inference.Config(prefix)
+    direct = inference.create_predictor(cfg)
+    scfg = serving.ServingConfig(max_batch=4, call_timeout_s=30.0)
+    with serving.InferenceServer.from_config(cfg, replicas=2,
+                                             serving=scfg) as srv:
+        for n in (1, 2, 4):  # warm every bucket
+            srv.submit(rows(n, dim=6)).result(timeout=30)
+        warm = srv.stats()["recompiles"]
+        xs = [np.random.RandomState(i).rand(1 + i % 4, 6).astype("float32")
+              for i in range(10)]
+        reqs = [srv.submit([x]) for x in xs]
+        for x, r in zip(xs, reqs):
+            np.testing.assert_allclose(r.result(timeout=30)[0],
+                                       direct.run([x])[0], rtol=1e-5)
+        assert srv.stats()["recompiles"] == warm
+        assert srv.accounted()

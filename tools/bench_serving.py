@@ -1,0 +1,257 @@
+"""Serving bench: synthetic Poisson open-loop traffic against the
+inference serving runtime (paddle_tpu/inference/serving.py).
+
+One server (two Predictor replicas over a jit.save'd MLP, per-prefix
+load cache shared) is driven through four phases and ONE JSON line is
+printed:
+
+- **warmup** — sequential requests at every batch-bucket size, so the
+  compiled-program set is established (``serving_recompiles_total``
+  recorded here must NOT grow afterwards — shape buckets closed).
+- **baseline** — Poisson arrivals at 0.5x nominal capacity: the
+  no-overload goodput / latency reference.
+- **overload** — Poisson at 2x capacity: admission control must shed
+  (``requests_shed_total > 0``) while the p99 latency of requests that
+  completed stays within the deadline, and in-deadline goodput stays
+  within a bounded band of the baseline run.
+- **failover** — a ``replica_stall`` fault wedges one replica mid-run:
+  the per-call deadline fires, the batch requeues to the survivor
+  (``replica_failover_total >= 1``), and ZERO admitted-and-feasible
+  requests are silently lost — every submitted request terminates as
+  completed / shed / expired (``accounted``).
+
+Capacity is made deterministic on any machine by padding each batch
+execute with a fixed service time (the model itself is tiny), so
+"2x capacity" means the same thing in CI and on a workstation.
+
+Usage::
+
+    python tools/bench_serving.py            # full (longer phases)
+    python tools/bench_serving.py --smoke    # CI contract (~20 s)
+
+    {"metric": "serving_overload_goodput_rps", "value": ...,
+     "extra": {"requests_shed_total": ..., "replica_failover_total": ...,
+               "serving_recompiles_total": {"closed": true, ...}, ...}}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from _mesh_setup import ensure_repo_on_path, force_host_devices
+
+ensure_repo_on_path()
+force_host_devices(1)
+
+IN_DIM = 32
+
+
+def build_model(tmp: str):
+    """jit.save a tiny MLP with a shape-polymorphic batch dim (the
+    serving batcher pads rows to a small bucket set)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.jit import InputSpec
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(IN_DIM, 64)
+            self.fc2 = nn.Linear(64, 8)
+
+        def forward(self, x):
+            return self.fc2(nn.functional.relu(self.fc1(x)))
+
+    paddle.seed(0)
+    net = MLP()
+    net.eval()
+    prefix = tmp + "/model"
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([None, IN_DIM],
+                                                       "float32")])
+    return prefix
+
+
+def make_executor(pred, pad_s: float):
+    """Predictor executor with a fixed per-batch service pad, so nominal
+    capacity (= replicas * max_batch / pad) is machine-independent."""
+
+    def fn(arrays):
+        out = pred.run(list(arrays))
+        time.sleep(pad_s)
+        return out
+
+    return fn
+
+
+def _diff(before: dict, after: dict) -> dict:
+    out = {}
+    for k, v in after.items():
+        if isinstance(v, (int, float)) and isinstance(before.get(k), (int, float)):
+            out[k] = v - before[k]
+    for cause in set(after["shed_causes"]) | set(before["shed_causes"]):
+        out.setdefault("shed_causes", {})[cause] = (
+            after["shed_causes"].get(cause, 0)
+            - before["shed_causes"].get(cause, 0))
+    return out
+
+
+def run_phase(server, rate_rps: float, duration_s: float,
+              deadline_s: float, rng) -> dict:
+    """Open-loop Poisson traffic: arrivals are scheduled independently
+    of completions (the defining property of an overload test — a
+    closed loop would self-throttle)."""
+    before = server.stats()
+    reqs = []
+    t0 = time.monotonic()
+    next_t = t0
+    while True:
+        next_t += rng.exponential(1.0 / rate_rps)
+        if next_t - t0 > duration_s:
+            break
+        lag = next_t - time.monotonic()
+        if lag > 0:
+            time.sleep(lag)
+        x = rng.rand(1, IN_DIM).astype("float32")
+        reqs.append(server.submit([x], deadline_s=deadline_s))
+    elapsed = time.monotonic() - t0
+    settle = time.monotonic() + deadline_s + 10.0
+    for r in reqs:
+        r._done.wait(max(0.0, settle - time.monotonic()))
+    delta = _diff(before, server.stats())
+    lat = sorted(r.latency for r in reqs
+                 if r.state == "completed" and r.latency is not None)
+    in_deadline = sum(1 for r in reqs if r.state == "completed"
+                      and r.latency is not None and r.latency <= deadline_s)
+
+    def pct(p):
+        return lat[min(len(lat) - 1, int(p * len(lat)))] if lat else None
+
+    return {
+        "offered_rps": round(len(reqs) / elapsed, 1),
+        "duration_s": round(elapsed, 3),
+        "submitted": len(reqs),
+        "completed": delta.get("completed", 0),
+        "shed": delta.get("shed", 0),
+        "expired": delta.get("expired", 0),
+        "failed": delta.get("failed", 0),
+        "shed_causes": delta.get("shed_causes", {}),
+        "failovers": delta.get("failovers", 0),
+        "deadline_s": deadline_s,
+        "p50_s": pct(0.50),
+        "p99_s": pct(0.99),
+        "goodput_rps": round(in_deadline / elapsed, 1),
+    }
+
+
+def run_bench(smoke: bool, seed: int = 0) -> dict:
+    from paddle_tpu import inference, telemetry
+    from paddle_tpu.inference import serving
+    from paddle_tpu.resilience import faults
+
+    telemetry.enable()
+    replicas, max_batch = 2, 4
+    pad_s = 0.04
+    capacity = replicas * max_batch / pad_s          # nominal rows/sec
+    deadline_s = 0.4
+    duration = 1.5 if smoke else 6.0
+
+    tmp = tempfile.mkdtemp(prefix="bench_serving_")
+    prefix = build_model(tmp)
+    cfg = inference.Config(prefix)
+    pool = inference.PredictorPool(cfg, replicas)
+    scfg = serving.ServingConfig(
+        max_queue=512, max_batch=max_batch, batch_wait_s=0.004,
+        call_timeout_s=0.5, admission_safety=1.3, probation_base_s=0.05,
+        probation_max_s=0.5, seed=seed)
+    server = serving.InferenceServer(
+        [make_executor(pool.retrieve(i), pad_s) for i in range(replicas)],
+        config=scfg)
+    rng = np.random.RandomState(seed)
+
+    with server:
+        # -- warmup: touch every batch bucket so the compiled set closes
+        for rows in range(1, max_batch + 1):
+            server.submit([rng.rand(rows, IN_DIM).astype("float32")],
+                          deadline_s=30.0).result(timeout=60)
+        recompiles_warm = server.stats()["recompiles"]
+
+        baseline = run_phase(server, 0.5 * capacity, duration,
+                             deadline_s, rng)
+        overload = run_phase(server, 2.0 * capacity, duration,
+                             deadline_s, rng)
+
+        # -- failover: wedge one replica a few batches into the phase
+        stall_at = server.stats()["batches"] + 4
+        with faults.inject("replica_stall", at_step=stall_at) as spec:
+            failover = run_phase(server, 0.6 * capacity,
+                                 max(duration, 2.0), 2.0, rng)
+        failover["stall_fired"] = spec.fired
+        stats = server.stats()
+        recompiles_final = stats["recompiles"]
+        accounted = server.accounted()
+        server.shutdown(drain=True)
+
+    shed_total = (overload["shed"] + overload["expired"])
+    goodput_band_ok = (
+        baseline["goodput_rps"] > 0
+        and overload["goodput_rps"] >= 0.5 * baseline["goodput_rps"])
+    checks = {
+        "overload_sheds": shed_total > 0,
+        "overload_p99_within_deadline": (
+            overload["p99_s"] is not None
+            and overload["p99_s"] <= deadline_s),
+        "goodput_band": goodput_band_ok,
+        "failover_happened": failover["failovers"] >= 1
+        and failover["stall_fired"] == 1,
+        "zero_requests_lost": accounted and failover["failed"] == 0,
+        "buckets_closed": recompiles_final == recompiles_warm,
+    }
+    return {
+        "metric": "serving_overload_goodput_rps",
+        "value": overload["goodput_rps"],
+        "unit": "req/s",
+        "extra": {
+            "smoke": smoke,
+            "capacity_rps_nominal": capacity,
+            "service_pad_s": pad_s,
+            "replicas": replicas,
+            "max_batch": max_batch,
+            "baseline": baseline,
+            "overload": overload,
+            "failover": failover,
+            "requests_shed_total": shed_total,
+            "replica_failover_total": failover["failovers"],
+            "serving_recompiles_total": {
+                "after_warmup": recompiles_warm,
+                "final": recompiles_final,
+                "closed": checks["buckets_closed"],
+            },
+            "accounted": accounted,
+            "stats": stats,
+            "telemetry": {
+                "prometheus_bytes": len(telemetry.prometheus_text()),
+            },
+            "checks": checks,
+            "exit_code": 0 if all(checks.values()) else 1,
+        },
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="short phases + the CI self-check contract")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    res = run_bench(args.smoke, seed=args.seed)
+    print(json.dumps(res))
+    return res["extra"]["exit_code"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())
