@@ -520,7 +520,8 @@ def pallas_config_untuned(ctx):
         kernel_name = getattr(info, "name", "")
         # forward kernels only: the paired backward kernels of the same
         # call would re-report the identical missing entry
-        if kernel_name not in ("_fwd_kernel", "_ce_fwd_kernel"):
+        if kernel_name not in ("_fwd_kernel", "_ce_fwd_kernel",
+                               "_paged_decode_kernel"):
             continue
         grid = getattr(site.eqn.params.get("grid_mapping"), "grid", ())
         avals = [getattr(v, "aval", None) for v in site.eqn.invars]

@@ -324,7 +324,7 @@ __all__ += ["DataType", "PlaceType", "PrecisionType", "PredictorPool",
 def __getattr__(name):
     # lazy submodules: the serving runtime / weight quantizer are only
     # imported when asked for, keeping the base handle API import-light
-    if name in ("serving", "quant"):
+    if name in ("serving", "quant", "kv_cache", "decode_model"):
         import importlib
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod

@@ -1,0 +1,170 @@
+"""Toy autoregressive LM + the DecodeServer executor contract.
+
+A deliberately small single-layer transformer LM (embedding + learned
+positions, one attention layer with residual, greedy argmax head) whose
+decode step runs against the paged KV cache. It exists so the decode
+serving stack — scheduler, cache, paged attention — has a real
+autoregressive model to drive in tests and ``tools/bench_serving.py``
+without hauling in a checkpoint; the executor contract is what a real
+model would implement.
+
+Executor contract (``make_step_fn``): the DecodeServer hands the step
+function its flattened varlen batch —
+``[tokens (T,), row_id (T,), positions (T,), valid (T,),
+block_tables (R, W), ctx_lens (R,), last_idx (R,)]`` — and expects
+``[next_tokens (R,), k_new (1, T, H, D), v_new (1, T, H, D)]`` back.
+The step function only COMPUTES (attention reads cached KV through the
+block tables; the chunk's own K/V is returned, not written) — the
+server commits cache writes after the batch finishes, so failovers
+re-run steps idempotently.
+
+Pure-decode batches (every context-bearing row carries exactly one
+token) route to :func:`~paddle_tpu.ops.pallas.paged_attention.
+paged_decode_attention` (XLA gather or the Pallas kernel); mixed
+prefill/decode batches use the ragged XLA path. Both are jitted per
+(token-bucket, row-bucket) shape, so the compiled set closes with the
+server's bucket set.
+
+:func:`dense_generate` is the oracle: same parameters, full dense
+recompute each step, no cache — paged serving must reproduce its token
+stream exactly.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.pallas.paged_attention import (paged_decode_attention,
+                                          paged_prefill_attention)
+
+__all__ = ["init_decode_model", "make_step_fn", "dense_generate"]
+
+
+def init_decode_model(vocab: int = 128, num_heads: int = 2,
+                      head_dim: int = 32, max_len: int = 512,
+                      seed: int = 0) -> Dict[str, np.ndarray]:
+    """Parameters of the toy LM (embed dim = num_heads * head_dim)."""
+    e = num_heads * head_dim
+    rs = np.random.RandomState(seed)
+    s = 1.0 / math.sqrt(e)
+    return {
+        "embed": rs.randn(vocab, e).astype(np.float32) * 0.5,
+        "pos": rs.randn(max_len, e).astype(np.float32) * 0.1,
+        "wq": rs.randn(e, e).astype(np.float32) * s,
+        "wk": rs.randn(e, e).astype(np.float32) * s,
+        "wv": rs.randn(e, e).astype(np.float32) * s,
+        "wo": rs.randn(e, e).astype(np.float32) * s,
+        "head": rs.randn(e, vocab).astype(np.float32) * s,
+        "num_heads": np.int32(num_heads),
+        "head_dim": np.int32(head_dim),
+    }
+
+
+def _qkv(params, x):
+    h, d = int(params["num_heads"]), int(params["head_dim"])
+    t = x.shape[0]
+    q = (x @ params["wq"]).reshape(t, h, d)
+    k = (x @ params["wk"]).reshape(t, h, d)
+    v = (x @ params["wv"]).reshape(t, h, d)
+    return q, k, v
+
+
+def make_step_fn(params: Dict[str, np.ndarray], cache,
+                 kernel: str = "auto", interpret: bool = False):
+    """Build a DecodeServer executor over ``cache`` (single layer).
+
+    kernel/interpret select the decode attention path
+    (``paged_decode_attention``'s dispatcher); mixed batches always take
+    the ragged XLA path.
+    """
+    if cache.num_layers != 1:
+        raise ValueError("the toy decode model is single-layer")
+    h, d = int(params["num_heads"]), int(params["head_dim"])
+    e = h * d
+    emb = jnp.asarray(params["embed"])
+    pos = jnp.asarray(params["pos"])
+
+    @jax.jit
+    def _mixed(kp, vp, tokens, row_id, positions, valid, tables,
+               ctx_lens, last_idx):
+        x = emb[tokens] + pos[positions]                    # (T, E)
+        q, k, v = _qkv(params, x)
+        o = paged_prefill_attention(q, k, v, row_id, positions, valid,
+                                    kp, vp, tables, ctx_lens)
+        y = x + o.reshape(-1, e) @ params["wo"]
+        nxt = jnp.argmax((y @ params["head"])[last_idx],
+                         axis=-1).astype(jnp.int32)         # (R,)
+        return nxt, k[None], v[None]
+
+    @jax.jit
+    def _decode(kp, vp, tokens, row_id, positions, valid, tables,
+                ctx_lens, last_idx):
+        t_b = tokens.shape[0]
+        tok = tokens[last_idx]                              # (R,)
+        x = emb[tok] + pos[positions[last_idx]]             # (R, E)
+        q, k, v = _qkv(params, x)
+        o = paged_decode_attention(
+            q[:, None], kp, vp, tables, ctx_lens,
+            k_new=k[:, None], v_new=v[:, None],
+            kernel=kernel, interpret=interpret)             # (R, 1, H, D)
+        y = x + o[:, 0].reshape(-1, e) @ params["wo"]
+        nxt = jnp.argmax(y @ params["head"], axis=-1).astype(jnp.int32)
+        # scatter each row's K/V back to its flattened token slot;
+        # padded rows (ctx_lens == 0) are routed out of bounds + dropped
+        # so they cannot clobber slot 0
+        idx = jnp.where(ctx_lens > 0, last_idx, t_b)
+        k_flat = jnp.zeros((t_b, h, d), k.dtype).at[idx].set(k, mode="drop")
+        v_flat = jnp.zeros((t_b, h, d), v.dtype).at[idx].set(v, mode="drop")
+        return nxt, k_flat[None], v_flat[None]
+
+    def step(arrays: List[np.ndarray]) -> List[np.ndarray]:
+        tokens, row_id, positions, valid, tables, ctx_lens, last_idx = \
+            [np.asarray(a) for a in arrays]
+        kp, vp = cache.pools(0)
+        # pure decode <=> every valid token belongs to a row that already
+        # has context and carries exactly one token (semantically: each
+        # such row computes a single next position)
+        n_valid = int(valid.sum())
+        real_rows = int((ctx_lens > 0).sum())
+        pure_decode = n_valid > 0 and n_valid == real_rows
+        fn = _decode if pure_decode else _mixed
+        nxt, k_new, v_new = fn(kp, vp, tokens, row_id, positions, valid,
+                               tables, ctx_lens, last_idx)
+        return [np.asarray(nxt), np.asarray(k_new), np.asarray(v_new)]
+
+    # exposed so harnesses (tools/bench_serving.py) can measure the
+    # compiled-shape set directly via _cache_size()
+    step.jit_fns = (_mixed, _decode)
+    return step
+
+
+def dense_generate(params: Dict[str, np.ndarray], prompt_tokens,
+                   max_new: int) -> List[int]:
+    """Greedy-decode oracle: full dense recompute per step, no cache.
+    The paged serving stack must emit exactly this token stream."""
+    h, d = int(params["num_heads"]), int(params["head_dim"])
+    e = h * d
+    emb = jnp.asarray(params["embed"])
+    pos = jnp.asarray(params["pos"])
+    toks = [int(t) for t in prompt_tokens]
+    out: List[int] = []
+    scale = 1.0 / math.sqrt(d)
+    for _ in range(int(max_new)):
+        t = len(toks)
+        x = emb[jnp.asarray(toks, jnp.int32)] + pos[:t]
+        q, k, v = _qkv(params, x)
+        s = jnp.einsum("thd,uhd->htu", q.astype(jnp.float32) * scale,
+                       k.astype(jnp.float32))
+        causal = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(causal[None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("htu,uhd->thd", p, v.astype(jnp.float32))
+        y = x + o.reshape(t, e) @ params["wo"]
+        nxt = int(jnp.argmax(y[-1] @ params["head"]))
+        toks.append(nxt)
+        out.append(nxt)
+    return out

@@ -70,6 +70,7 @@ __all__ = [
     "InferenceServer", "ServingConfig", "Request",
     "RequestShed", "DeadlineExpired", "ServingError",
     "SHED_CAUSES", "predictor_executor",
+    "DecodeServer", "GenerationRequest",
 ]
 
 # terminal request states (the accounting universe)
@@ -134,6 +135,10 @@ class Request:
         self.error: Optional[BaseException] = None
         self.t_dispatch: Optional[float] = None  # first dispatch only
         self.t_done: Optional[float] = None
+        # invoked exactly once, after the request reaches ANY terminal
+        # state (resource owners — e.g. the KV cache — hook cleanup here
+        # so every seal path releases, not just the happy one)
+        self.on_terminal: Optional[Callable[["Request"], None]] = None
         self._done = threading.Event()
         self._lock = threading.Lock()
 
@@ -158,6 +163,14 @@ class Request:
             self.error = error
             self.cause = cause
             self.t_done = time.monotonic()
+        cb = self.on_terminal
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception as e:  # noqa: BLE001 - cleanup must not unseal
+                import warnings
+                warnings.warn(f"request {self.id} on_terminal hook "
+                              f"failed: {e!r}", stacklevel=2)
         self._done.set()
         return True
 
@@ -515,7 +528,7 @@ class InferenceServer:
                         if r is first or r in batch:
                             continue
                         if (r.signature() == sig
-                                and rows + r.rows <= self.cfg.max_batch):
+                                and self._fits(batch, rows, r)):
                             batch.append(r)
                             rows += r.rows
                     remaining = deadline - time.monotonic()
@@ -595,8 +608,12 @@ class InferenceServer:
             time.sleep(0.005)
         return None
 
-    @staticmethod
-    def _pad_concat(batch: List[Request], bucket: int) -> List[np.ndarray]:
+    def _fits(self, batch: List[Request], rows: int, r: Request) -> bool:
+        """May ``r`` join the forming batch? Base packs by summed rows;
+        subclasses add their own capacity axes (token budget + row cap)."""
+        return rows + r.rows <= self.cfg.max_batch
+
+    def _pad_concat(self, batch: List[Request], bucket: int) -> List[np.ndarray]:
         n_inputs = len(batch[0].inputs)
         arrays = []
         for i in range(n_inputs):
@@ -870,3 +887,285 @@ def predictor_executor(pred) -> Callable:
         return pred.run(list(arrays))
 
     return fn
+
+
+# ===========================================================================
+# decode-native serving (ISSUE 11): autoregressive generation over the
+# paged KV cache, scheduled through the same batcher/admission machinery
+# ===========================================================================
+
+class GenerationRequest(Request):
+    """One autoregressive generation: prompt in, ``max_new`` greedy
+    tokens out (``result()`` -> ``[np.int32 generated tokens]``).
+
+    The SAME object rides the queue for every step of its life — prefill
+    chunks, then one-token decode steps — re-entering at the FRONT after
+    each completed step so in-flight sequences outrank new admissions.
+    ``rows`` is reinterpreted as the tokens the request wants to compute
+    in its NEXT step (prefill chunk size, or 1 for decode), which makes
+    the base scheduler's row arithmetic — packing, buckets, in-flight
+    accounting, the EWMA service rate, the modeled-wait admission model —
+    token-denominated without touching it."""
+
+    def __init__(self, prompt_tokens, max_new_tokens: int,
+                 deadline_s: Optional[float] = None):
+        prompt = [int(t) for t in np.asarray(prompt_tokens).reshape(-1)]
+        if not prompt:
+            raise ValueError("generation needs a non-empty prompt")
+        if int(max_new_tokens) < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        super().__init__([np.asarray(prompt, np.int32).reshape(1, -1)],
+                         deadline_s=deadline_s,
+                         tokens=len(prompt) + int(max_new_tokens))
+        self.prompt = prompt
+        self.max_new = int(max_new_tokens)
+        self.generated: List[int] = []
+        self.seq = None                 # kv_cache.CacheSeq (set at admission)
+        self.chunk: List[int] = []      # tokens of the NEXT step
+
+    def signature(self):
+        # every generation is batch-compatible with every other: the
+        # decode executor consumes the flattened varlen layout
+        return ("__generate__",)
+
+
+class DecodeServer(InferenceServer):
+    """Decode-native serving: mixed prefill/decode continuous batching
+    over a :class:`~paddle_tpu.inference.kv_cache.PagedKVCache`.
+
+    ``step_fns`` are per-replica executors with the decode contract —
+    ``fn([tokens, row_id, positions, valid, tables, ctx_lens, last_idx])
+    -> [next_tokens (R,), k_new (L, T, H, D), v_new (L, T, H, D)]`` (see
+    ``inference.decode_model.make_step_fn``); ``T`` is the token-budget
+    bucket, ``R = min(T, max_batch_rows)`` the row bucket, so the
+    compiled-shape set stays closed. The executor only COMPUTES; the
+    cache is written here, after ``try_finish`` — a cancelled or wedged
+    call can never corrupt cache state, and a requeued step re-runs
+    idempotently (greedy decode is deterministic).
+
+    Admission folds cache pressure into the modeled wait: pages the
+    prompt + generation will need beyond the free + evictable supply add
+    ``pages * page_size / rate`` of wait, so tight caches surface as
+    ``deadline_infeasible`` shedding, not mid-decode OOM. Prefix pages
+    matched at admission are pinned (ref-counted) until the request
+    reaches a terminal state — ``Request.on_terminal`` releases them on
+    EVERY seal path, including drain and failover exhaustion.
+
+    ``cfg.max_batch`` is the per-dispatch TOKEN budget (decode steps
+    cost 1, prefill chunks up to ``prefill_chunk``)."""
+
+    def __init__(self, step_fns, cache, replicas: Optional[int] = None,
+                 config: Optional[ServingConfig] = None,
+                 prefill_chunk: int = 32,
+                 max_pages_per_seq: Optional[int] = None,
+                 max_batch_rows: Optional[int] = None):
+        super().__init__(step_fns, replicas=replicas, config=config)
+        self.cache = cache
+        self.prefill_chunk = max(1, min(int(prefill_chunk),
+                                        self.cfg.max_batch))
+        self.max_batch_rows = max(1, int(max_batch_rows
+                                         or self.cfg.max_batch))
+        self.max_pages_per_seq = int(max_pages_per_seq or cache.num_pages)
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, *a, **kw):
+        raise TypeError("DecodeServer serves generations: use "
+                        "submit_generate(prompt_tokens, max_new_tokens)")
+
+    def submit_generate(self, prompt_tokens, max_new_tokens: int,
+                        deadline_s: Optional[float] = None
+                        ) -> GenerationRequest:
+        """Admit a generation (or shed it: the returned request is then
+        already terminal with the cause recorded)."""
+        if deadline_s is None:
+            deadline_s = self.cfg.default_deadline_s
+        req = GenerationRequest(prompt_tokens, max_new_tokens,
+                                deadline_s=deadline_s)
+        self._count_only("submitted")
+        if self._draining or self._stopped:
+            self._terminal(req, SHED, cause="draining")
+            return req
+        total = len(req.prompt) + req.max_new
+        if self.cache.pages_needed(total) > self.max_pages_per_seq:
+            raise ValueError(
+                f"generation spans {self.cache.pages_needed(total)} pages "
+                f"> max_pages_per_seq={self.max_pages_per_seq}")
+        with self._cv:
+            if len(self._deque) >= self.cfg.max_queue:
+                cause = "queue_full"
+            else:
+                # prefix pages the prompt already shares don't need
+                # allocating; everything else must fit the pool
+                matched, _ = self.cache.match_prefix(req.prompt[:-1])
+                needed = self.cache.pages_needed(total) \
+                    - matched // self.cache.page_size
+                wait = self._decode_wait_locked(req, needed)
+                if needed > self.cache.num_pages:
+                    cause = "deadline_infeasible"  # can never fit
+                elif req.deadline is not None and \
+                        wait * self.cfg.admission_safety + req.arrival \
+                        > req.deadline:
+                    cause = "deadline_infeasible"
+                else:
+                    req.seq = self.cache.create(req.prompt[:-1])
+                    req.on_terminal = self._release_request
+                    self._assign_chunk(req)
+                    self._deque.append(req)
+                    self._gauge("serving_queue_depth", len(self._deque))
+                    self._cv.notify_all()
+                    return req
+        self._terminal(req, SHED, cause=cause)
+        return req
+
+    def _decode_wait_locked(self, req: GenerationRequest,
+                            needed_pages: int) -> float:
+        """Base modeled wait (token-denominated) plus the cache-pressure
+        term: pages short of the free + evictable supply each cost a
+        page worth of tokens at the EWMA service rate — eviction keeps
+        up with decode, so shortfall is time, not failure."""
+        first_chunk = min(self.prefill_chunk,
+                          max(1, len(req.prompt) - 1))
+        wait = self._modeled_wait_locked(first_chunk)
+        if self._ewma_rows_per_s and needed_pages > 0:
+            short = needed_pages - (self.cache.free_pages()
+                                    + self.cache.evictable_pages())
+            if short > 0:
+                healthy = max(1, sum(1 for r in self.replicas if r.healthy))
+                wait += (short * self.cache.page_size
+                         / (self._ewma_rows_per_s * healthy))
+        return wait
+
+    def _release_request(self, req: Request):
+        if getattr(req, "seq", None) is not None:
+            self.cache.release(req.seq)
+
+    def _assign_chunk(self, req: GenerationRequest):
+        """Point the request at its next step's tokens. Prefill walks
+        the prompt from the cache frontier (``seq.length`` — prefix hits
+        land past them for free); decode feeds back the last generated
+        token. ``rows`` tracks the chunk's token cost for the packer."""
+        done = req.seq.length
+        if done < len(req.prompt):
+            req.chunk = req.prompt[done:done + self.prefill_chunk]
+        else:
+            req.chunk = [req.generated[-1]]
+        req.rows = len(req.chunk)
+
+    # -- batching ------------------------------------------------------------
+
+    def _fits(self, batch: List[Request], rows: int, r: Request) -> bool:
+        # token budget AND a row cap (the executor's R dimension)
+        return (len(batch) < self.max_batch_rows
+                and rows + r.rows <= self.cfg.max_batch)
+
+    def _pad_concat(self, batch: List[Request],
+                    bucket: int) -> List[np.ndarray]:
+        """Flattened varlen layout: every request's chunk tokens
+        concatenated on one axis of width ``bucket`` (the token bucket),
+        plus per-row block tables / context lengths. The row dimension is
+        ``min(bucket, max_batch_rows)`` — deterministic in the token
+        bucket, so it adds no recompile axis."""
+        t_b = bucket
+        r_b = min(bucket, self.max_batch_rows)
+        tokens = np.zeros(t_b, np.int32)
+        row_id = np.zeros(t_b, np.int32)
+        positions = np.zeros(t_b, np.int32)
+        valid = np.zeros(t_b, np.int32)
+        tables = np.zeros((r_b, self.max_pages_per_seq), np.int32)
+        ctx_lens = np.zeros(r_b, np.int32)
+        last_idx = np.zeros(r_b, np.int32)
+        off = 0
+        for i, r in enumerate(batch):
+            n = len(r.chunk)
+            tokens[off:off + n] = r.chunk
+            row_id[off:off + n] = i
+            positions[off:off + n] = np.arange(
+                r.seq.length, r.seq.length + n, dtype=np.int32)
+            valid[off:off + n] = 1
+            tables[i] = self.cache.block_table(r.seq,
+                                               self.max_pages_per_seq)
+            ctx_lens[i] = r.seq.length
+            last_idx[i] = off + n - 1
+            off += n
+        return [tokens, row_id, positions, valid, tables, ctx_lens,
+                last_idx]
+
+    # -- completion ----------------------------------------------------------
+
+    def _on_batch_done(self, replica: _Replica, job: _BatchJob,
+                       outs, dt: float):
+        if not job.try_finish():
+            return  # per-call deadline fired; the step re-runs elsewhere
+        if job.timer is not None:
+            job.timer.cancel()
+        self._finish_inflight(job)
+        outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+        next_tokens, k_new, v_new = [np.asarray(o) for o in outs]
+        self._observe("serving_execute_seconds", dt)
+        a = self.cfg.rate_ewma
+        rate = job.rows / max(dt, 1e-9)
+        with self._cv:
+            self._ewma_rows_per_s = rate if self._ewma_rows_per_s is None \
+                else a * rate + (1 - a) * self._ewma_rows_per_s
+            self._ewma_batch_s = dt if self._ewma_batch_s is None \
+                else a * dt + (1 - a) * self._ewma_batch_s
+        # cache writes + sequence advance happen HERE (post-try_finish):
+        # a cancelled job never touched the cache, so its requests re-run
+        # the identical step on a survivor
+        back: List[Request] = []
+        off = 0
+        for i, r in enumerate(job.requests):
+            n = len(r.chunk)
+            try:
+                self._advance(r, int(next_tokens[i]),
+                              k_new[:, off:off + n], v_new[:, off:off + n],
+                              back)
+            except Exception as e:  # noqa: BLE001 - CacheOOM et al.
+                if r._seal(FAILED, error=e if isinstance(e, ServingError)
+                           else ServingError(
+                               f"request {r.id} step failed: {e!r}")):
+                    self._count_outcome(FAILED)
+            off += n
+        if back:
+            with self._cv:
+                for r in reversed(back):
+                    self._deque.appendleft(r)
+                self._gauge("serving_queue_depth", len(self._deque))
+                self._cv.notify_all()
+
+    def _advance(self, r: GenerationRequest, next_tok: int,
+                 k_chunk: np.ndarray, v_chunk: np.ndarray,
+                 back: List[Request]):
+        """Commit one completed step: write the chunk's K/V, consume the
+        sampled token when the step produced real logits (prompt fully
+        processed), then complete / expire / re-enqueue."""
+        if r.done():
+            return  # sealed while in flight (e.g. drain-expire race)
+        self.cache.append(r.seq, r.chunk, k_chunk, v_chunk)
+        if r.seq.length >= len(r.prompt):
+            # the step's last token was prompt-final or a decode token:
+            # its logits sample the next generated token
+            r.generated.append(int(next_tok))
+            self._count_only("decode_tokens")
+            self._count("decode_tokens_total")
+        if len(r.generated) >= r.max_new:
+            if r._seal(COMPLETED,
+                       outputs=[np.asarray(r.generated, np.int32)]):
+                self._count_outcome(COMPLETED)
+                self._count("serving_tokens_total", n=r.tokens)
+                self._observe("serving_e2e_seconds",
+                              r.t_done - r.arrival)
+            return
+        if r.expired():
+            self._terminal(r, EXPIRED, cause="deadline_expired_in_queue")
+            return
+        self._assign_chunk(r)
+        back.append(r)
+
+    def stats(self) -> Dict[str, object]:
+        s = super().stats()
+        with self._clock:
+            s["decode_tokens"] = self.counts.get("decode_tokens", 0)
+        s["kv_cache"] = self.cache.stats()
+        return s

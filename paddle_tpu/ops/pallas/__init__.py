@@ -1,3 +1,5 @@
 from . import tuner  # noqa: F401
 from .flash_attention import flash_attention, flash_supported  # noqa: F401
 from .fused_ce import fused_ce_supported, fused_lm_ce  # noqa: F401
+from .paged_attention import (  # noqa: F401
+    paged_decode_attention, paged_decode_supported, paged_prefill_attention)

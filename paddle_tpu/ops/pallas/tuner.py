@@ -48,7 +48,7 @@ __all__ = [
     "TuningDB", "default_db_path", "overlay_db_path", "get_db",
     "clear_cache", "shape_bucket", "device_kind", "make_key", "resolve",
     "record_fallback", "tune", "flash_candidates", "ce_candidates",
-    "entry_for_traced_call", "GENERIC_DEVICE",
+    "paged_candidates", "entry_for_traced_call", "GENERIC_DEVICE",
 ]
 
 GENERIC_DEVICE = "any"  # device-agnostic seed entries (interpret-validated)
@@ -97,8 +97,12 @@ def make_key(kernel: str, device: str, dtype, dims: Dict[str, int]) -> str:
 
 def flash_dims(d: int, sq: int, sk: int) -> Dict[str, int]:
     """Bucketed dims for a flash-attention call: head_dim exact (it is a
-    hardware tile), sequence lengths bucketed."""
-    return {"d": int(d), "sq": shape_bucket(sq), "sk": shape_bucket(sk)}
+    hardware tile), sequence lengths bucketed. ``sq`` buckets with
+    floor=1 so DECODE-shaped calls (sq = 1..8) keep exact small keys
+    instead of collapsing into — and colliding with — the 128 prefill
+    bucket; keys for sq >= 128 are unchanged."""
+    return {"d": int(d), "sq": shape_bucket(sq, floor=1),
+            "sk": shape_bucket(sk)}
 
 
 def ce_dims(h: int, v: int, tokens: int) -> Dict[str, int]:
@@ -314,6 +318,21 @@ def entry_for_traced_call(kernel_name: str, avals: List, grid) -> \
         return make_key(
             "fused_ce", device_kind(), hid.dtype,
             {"h": int(h), "v": int(vpad), "t": tb}), None
+    if kernel_name == "_paged_decode_kernel":
+        # paged decode attention: invars (tables, lens, q, k_pool, v_pool)
+        # with q (B, H, q_pad, D) and k_pool (P, page_size, H, D)
+        if len(avals) < 4:
+            return None, None
+        tables, q, kpool = avals[0], avals[2], avals[3]
+        from .paged_attention import paged_dims
+        dims = paged_dims(q.shape[-1], kpool.shape[1], tables.shape[1])
+        for dev in (device_kind(), GENERIC_DEVICE):
+            key = make_key("paged_attention", dev, q.dtype, dims)
+            entry = db.lookup(key)
+            if entry:
+                return key, entry
+        return make_key("paged_attention", device_kind(), q.dtype,
+                        dims), None
     return None, None
 
 
@@ -348,6 +367,13 @@ def ce_candidates(tokens: int, vocab: int) -> List[Dict[str, int]]:
             out.append({"block_tokens": bt, "block_vocab": bv})
     return out or [{"block_tokens": min(tokens, 128),
                     "block_vocab": min(shape_bucket(vocab), 512)}]
+
+
+def paged_candidates() -> List[Dict[str, int]]:
+    """q_pad grid for the paged decode kernel: the sublane rows the
+    single query is broadcast to — 8 matches the f32 tile, 16 the bf16
+    tile shape."""
+    return [{"q_pad": 8}, {"q_pad": 16}]
 
 
 # ---------------------------------------------------------------------------
@@ -506,6 +532,65 @@ def _time_ce(cfg, tokens, h, v, dtype, interpret, iters) -> float:
     return _time_op(step, (hid, w), iters=iters)
 
 
+def _paged_case_arrays(b, h, d, ps, pages, dtype):
+    import jax.numpy as jnp
+    import numpy as np
+
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(b, 1, h, d), dtype)
+    kp = jnp.asarray(rs.randn(pages, ps, h, d), dtype)
+    vp = jnp.asarray(rs.randn(pages, ps, h, d), dtype)
+    # shuffled tables + ragged lens exercise the gather and masking
+    tables = jnp.asarray(
+        np.stack([rs.permutation(pages) for _ in range(b)]), jnp.int32)
+    lens = jnp.asarray(rs.randint(0, ps * pages + 1, (b,)), jnp.int32)
+    kn = jnp.asarray(rs.randn(b, 1, h, d), dtype)
+    vn = jnp.asarray(rs.randn(b, 1, h, d), dtype)
+    return q, kp, vp, tables, lens, kn, vn
+
+
+def _validate_paged(cfg, b, h, d, ps, pages, dtype, interpret,
+                    tol=2e-3) -> bool:
+    """Candidate gate: the Pallas paged decode output must match the XLA
+    gather baseline (the mandatory reference path) for the same pool."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .paged_attention import paged_decode_attention
+
+    q, kp, vp, tables, lens, kn, vn = _paged_case_arrays(
+        b, h, d, ps, pages, dtype)
+    try:
+        got = paged_decode_attention(q, kp, vp, tables, lens, k_new=kn,
+                                     v_new=vn, kernel="pallas",
+                                     q_pad=cfg["q_pad"],
+                                     interpret=interpret)
+        ref = paged_decode_attention(q, kp, vp, tables, lens, k_new=kn,
+                                     v_new=vn, kernel="xla")
+    except Exception:
+        return False
+    t = 2e-2 if jnp.dtype(dtype) == jnp.bfloat16 else tol
+    err = np.max(np.abs(np.asarray(got, np.float32)
+                        - np.asarray(ref, np.float32)))
+    return err / max(1.0, float(np.max(np.abs(np.asarray(
+        ref, np.float32))))) <= t
+
+
+def _time_paged(cfg, b, h, d, ps, pages, dtype, interpret, iters) -> float:
+    from .paged_attention import paged_decode_attention
+
+    q, kp, vp, tables, lens, kn, vn = _paged_case_arrays(
+        b, h, d, ps, pages, dtype)
+
+    def step(q, kp, vp):
+        return paged_decode_attention(q, kp, vp, tables, lens, k_new=kn,
+                                      v_new=vn, kernel="pallas",
+                                      q_pad=cfg["q_pad"],
+                                      interpret=interpret)
+
+    return _time_op(step, (q, kp, vp), iters=iters)
+
+
 # ---------------------------------------------------------------------------
 # the sweep
 # ---------------------------------------------------------------------------
@@ -535,7 +620,7 @@ def tune_case(kernel: str, case: Dict[str, int], dtype,
     dev = device or device_kind()
     if kernel == "flash_attention":
         b, h = case.get("b", 1), case.get("h", 2)
-        d, sq, sk = case["d"], shape_bucket(case["sq"]), \
+        d, sq, sk = case["d"], shape_bucket(case["sq"], floor=1), \
             shape_bucket(case["sk"])
         dims = flash_dims(d, sq, sk)
         cands = flash_candidates(sq, sk)
@@ -554,6 +639,17 @@ def tune_case(kernel: str, case: Dict[str, int], dtype,
         timeit = lambda c: _time_ce(c, tokens, hdim, v, dtype,  # noqa: E731
                                     interpret, iters)
         defaults = _ce_defaults()
+    elif kernel == "paged_attention":
+        from .paged_attention import DEFAULT_Q_PAD, paged_dims
+        b, h = case.get("b", 4), case.get("h", 2)
+        d, ps, pages = case["d"], case["ps"], case["pages"]
+        dims = paged_dims(d, ps, pages)
+        cands = paged_candidates()
+        validate = lambda c: _validate_paged(c, b, h, d, ps, pages,  # noqa: E731
+                                             dtype, interpret)
+        timeit = lambda c: _time_paged(c, b, h, d, ps, pages, dtype,  # noqa: E731
+                                       interpret, iters)
+        defaults = {"q_pad": DEFAULT_Q_PAD}
     else:
         raise ValueError(f"unknown kernel {kernel!r}")
 
@@ -636,6 +732,15 @@ def _suite(name: str) -> List[Tuple[str, Dict[str, int], Any]]:
             ("fused_ce", {"h": 128, "v": 1024, "t": 512}, f32),
             ("fused_ce", {"h": 64, "v": 512, "t": 128}, f32),
         ]
+    if name == "decode":      # bench_serving decode-shape buckets
+        return [
+            ("paged_attention", {"b": 4, "h": 2, "d": 32, "ps": 16,
+                                 "pages": 16}, f32),
+            ("paged_attention", {"b": 4, "h": 2, "d": 32, "ps": 16,
+                                 "pages": 8}, f32),
+            ("paged_attention", {"b": 4, "h": 2, "d": 64, "ps": 16,
+                                 "pages": 16}, bf16),
+        ]
     if name == "bench":       # the TPU bench GPT-base shapes
         return [
             ("flash_attention", {"b": 2, "h": 4, "d": 64, "sq": 1024,
@@ -650,7 +755,7 @@ def _suite(name: str) -> List[Tuple[str, Dict[str, int], Any]]:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--suite", default="quick",
-                    choices=("smoke", "quick", "bench"),
+                    choices=("smoke", "quick", "decode", "bench"),
                     help="shape-case set to sweep")
     ap.add_argument("--db", default=None,
                     help="DB file to update (default: the user overlay "

@@ -113,6 +113,16 @@ serving_requeued_requests_total counter   requests requeued by failover
 serving_execute_errors_total   counter    executor exceptions {error=...}
 serving_weight_compression_x   gauge      fp weight bytes / quantized
                                           bytes {policy=int8|int4}
+kv_cache_pages_total           gauge      paged KV cache pool size
+kv_cache_pages_used            gauge      pages allocated or held by the
+                                          shared-prefix table
+kv_cache_prefix_hits_total     counter    prompt TOKENS served from
+                                          shared prefix pages at
+                                          admission (not recomputed)
+kv_cache_evictions_total       counter    registered pages reclaimed
+                                          {cause=capacity|trim}
+decode_tokens_total            counter    generated tokens committed by
+                                          the decode scheduler
 =============================  =========  =================================
 
 Multi-host merge: ``telemetry.aggregate.gather_registries()`` allgathers

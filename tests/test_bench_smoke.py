@@ -245,7 +245,11 @@ def test_bench_serving_smoke():
     overload phase sheds with the completed p99 within deadline, goodput
     stays within a bounded band of baseline, an injected replica_stall
     fails over with zero admitted-and-feasible requests lost, and the
-    recompile count stops growing after warmup (shape buckets closed)."""
+    recompile count stops growing after warmup (shape buckets closed) —
+    plus the ISSUE 11 decode phase: prefix-heavy generations over the
+    paged KV cache hit >= 0.5 of their prompt tokens, compute <= 0.5x
+    the no-sharing prefill baseline, exercise LRU eviction, and add
+    zero compiled shapes beyond the primed set."""
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "bench_serving.py"),
          "--smoke"],
@@ -263,6 +267,17 @@ def test_bench_serving_smoke():
     assert extra["overload"]["p99_s"] <= extra["overload"]["deadline_s"]
     assert extra["replica_failover_total"] >= 1
     assert extra["failover"]["stall_fired"] == 1
+    # ISSUE 11 decode acceptance: sharing halves prefill at hit-rate
+    # >= 0.5, eviction fired, and the compiled set stayed closed
+    dec = extra["decode"]
+    assert extra["kv_cache_hit_rate"] >= 0.5
+    assert dec["prefill_tokens_computed"] \
+        <= 0.5 * dec["prefill_tokens_no_sharing"]
+    assert dec["prefix_hit_tokens"] > 0
+    assert dec["evictions"] >= 1
+    assert dec["decode_goodput_tokens_per_s"] > 0
+    assert dec["jit_shapes"]["final"] == dec["jit_shapes"]["primed"]
+    assert dec["failed"] == 0
     assert extra["failover"]["failed"] == 0
     assert extra["accounted"] is True
     assert extra["serving_recompiles_total"]["closed"] is True

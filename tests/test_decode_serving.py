@@ -1,0 +1,183 @@
+"""Decode-native serving e2e (DecodeServer + PagedKVCache + the toy
+autoregressive model): every generation must reproduce the dense
+no-cache oracle token-for-token — through prefix sharing, mixed
+prefill/decode batches, LRU eviction, replica failover, and both
+attention dispatch paths — while PR 10's zero-silent-loss
+(``accounted``) and closed-recompile-set contracts keep holding.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import serving
+from paddle_tpu.inference.decode_model import (dense_generate,
+                                               init_decode_model,
+                                               make_step_fn)
+from paddle_tpu.inference.kv_cache import PagedKVCache
+from paddle_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.reset()
+
+
+PARAMS = init_decode_model(vocab=128, num_heads=2, head_dim=32, seed=7)
+RS = np.random.RandomState(11)
+SYSTEM = [int(t) for t in RS.randint(0, 128, 8)]    # 2 full pages @ ps=4
+
+
+def prompt(i, extra=4):
+    rs = np.random.RandomState(100 + i)
+    return SYSTEM + [int(t) for t in rs.randint(0, 128, extra)]
+
+
+def make_stack(num_pages=64, page_size=4, max_pages_per_seq=16,
+               replicas=2, kernel="auto", interpret=False, **srv_kw):
+    cache = PagedKVCache(num_pages, page_size, 2, 32)
+    fn = make_step_fn(PARAMS, cache, kernel=kernel, interpret=interpret)
+    cfg_kw = dict(max_batch=32, call_timeout_s=30.0, batch_wait_s=0.002)
+    cfg_kw.update(srv_kw.pop("cfg_kw", {}))
+    cfg = serving.ServingConfig(**cfg_kw)
+    srv = serving.DecodeServer(fn, cache, replicas=replicas, config=cfg,
+                               prefill_chunk=8,
+                               max_pages_per_seq=max_pages_per_seq,
+                               **srv_kw)
+    return srv, cache
+
+
+def oracle(p, n):
+    return dense_generate(PARAMS, p, n)
+
+
+def test_generations_match_dense_oracle_with_prefix_sharing():
+    srv, cache = make_stack()
+    with srv:
+        # warm-up: registers the shared system-prompt pages
+        warm = srv.submit_generate(prompt(0), 5)
+        assert [int(t) for t in warm.result(timeout=30)[0]] \
+            == oracle(prompt(0), 5)
+        hits0 = cache.prefix_hit_tokens
+        reqs = [srv.submit_generate(prompt(i), 5) for i in range(1, 6)]
+        for i, r in zip(range(1, 6), reqs):
+            assert [int(t) for t in r.result(timeout=30)[0]] \
+                == oracle(prompt(i), 5), f"request {i} diverged"
+        # every follower reused the 2 full system-prompt pages
+        assert cache.prefix_hit_tokens - hits0 == 5 * 8
+        assert srv.accounted()
+        s = srv.stats()
+        assert s["completed"] == 6 and s["decode_tokens"] == 30
+        assert s["kv_cache"]["prefix_hit_tokens"] == cache.prefix_hit_tokens
+
+
+def test_recompile_set_closes_after_warmup():
+    srv, cache = make_stack()
+    with srv:
+        for i in range(4):
+            r = srv.submit_generate(prompt(i), 4)
+            r.result(timeout=30)
+        warm = srv.stats()["recompiles"]
+        assert warm > 0
+        # identically-shaped second wave: ZERO new compiled shapes
+        for i in range(4, 8):
+            r = srv.submit_generate(prompt(i), 4)
+            assert [int(t) for t in r.result(timeout=30)[0]] \
+                == oracle(prompt(i), 4)
+        assert srv.stats()["recompiles"] == warm
+        assert srv.accounted()
+
+
+def test_cache_pressure_sheds_as_deadline_infeasible_not_oom():
+    # pool of 2 pages, but per-seq budget allows 8: a generation that
+    # can NEVER fit is shed at admission with the standard cause
+    srv, cache = make_stack(num_pages=2, page_size=4,
+                            max_pages_per_seq=8)
+    with srv:
+        req = srv.submit_generate(list(np.arange(20) % 128), 4,
+                                  deadline_s=5.0)
+        assert req.state == "shed"
+        assert req.cause == "deadline_infeasible"
+        assert srv.stats()["shed_causes"]["deadline_infeasible"] == 1
+        assert srv.accounted()
+        assert cache.used_pages() == 0   # nothing leaked at admission
+
+
+def test_over_budget_generation_rejected():
+    srv, cache = make_stack(max_pages_per_seq=2, page_size=4)
+    with srv:
+        with pytest.raises(ValueError):
+            srv.submit_generate(list(np.arange(12) % 128), 4)
+        with pytest.raises(TypeError):
+            srv.submit([np.zeros((1, 2), np.float32)])
+
+
+def test_eviction_under_pressure_keeps_outputs_exact():
+    # 6-page pool, up to 4 pages live per generation: completed
+    # sequences leave registered pages behind, so later admissions must
+    # evict — and the evictions may not corrupt any still-pinned page
+    srv, cache = make_stack(num_pages=6, page_size=4,
+                            max_pages_per_seq=4, replicas=1)
+    with srv:
+        for i in range(6):
+            p = prompt(i * 17 + 1, extra=6)   # distinct 14-token prompts
+            r = srv.submit_generate(p, 2)
+            assert [int(t) for t in r.result(timeout=30)[0]] \
+                == oracle(p, 2), f"generation {i} diverged"
+        assert cache.evictions >= 1
+        assert srv.accounted()
+        s = srv.stats()["kv_cache"]
+        assert s["evictions"] == cache.evictions
+
+
+def test_terminal_paths_release_pages():
+    srv, cache = make_stack()
+    srv.start()
+    r1 = srv.submit_generate(prompt(1), 4)
+    r1.result(timeout=30)
+    srv.shutdown(drain=True, timeout=30)
+    late = srv.submit_generate(prompt(2), 4)
+    assert late.state == "shed" and late.cause == "draining"
+    assert srv.accounted()
+    # every live reference is gone: remaining pages are exactly the
+    # prefix table's (ref == 1 each), all evictable
+    st = cache.stats()
+    assert st["pages_used"] == st["registered"] == st["evictable"]
+    assert cache.trim(cache.num_pages) == st["registered"]
+    assert cache.used_pages() == 0
+
+
+def test_failover_mid_decode_matches_oracle():
+    srv, cache = make_stack(
+        cfg_kw=dict(call_timeout_s=1.0, probation_base_s=0.02,
+                    probation_max_s=0.2, seed=3))
+    with srv:
+        # warm both the jit caches and the EWMA so the stalled call's
+        # timeout fires against a known-fast baseline; at_step=None
+        # wedges the first batch dispatched inside the block (the global
+        # batch counter has already moved past the warm-up)
+        srv.submit_generate(prompt(0), 3).result(timeout=30)
+        with faults.inject("replica_stall") as spec:
+            reqs = [srv.submit_generate(prompt(i), 4) for i in (1, 2, 3)]
+            for i, r in zip((1, 2, 3), reqs):
+                assert [int(t) for t in r.result(timeout=60)[0]] \
+                    == oracle(prompt(i), 4), f"request {i} diverged"
+        assert spec.fired == 1
+        s = srv.stats()
+        assert s["failovers"] >= 1 and s["failed"] == 0
+        assert srv.accounted()
+
+
+def test_pallas_interpret_kernel_end_to_end():
+    # the Pallas kernel needs sublane-aligned pages (ps % 8 == 0); the
+    # 8-token system prompt is then exactly one shareable page
+    srv, cache = make_stack(replicas=1, kernel="pallas", interpret=True,
+                            page_size=8, max_pages_per_seq=8)
+    with srv:
+        srv.submit_generate(prompt(0), 3).result(timeout=60)  # warm-up
+        r = srv.submit_generate(prompt(1), 3)
+        assert [int(t) for t in r.result(timeout=60)[0]] \
+            == oracle(prompt(1), 3)
+        assert cache.prefix_hit_tokens >= 8
+        assert srv.accounted()

@@ -48,6 +48,20 @@ class TestBucketing:
         assert tuner.flash_dims(64, 300, 511) == \
             {"d": 64, "sq": 512, "sk": 512}
 
+    def test_flash_dims_small_sq_stays_exact(self):
+        # decode-shaped calls (sq = 1..8) must NOT collapse into the 128
+        # prefill bucket — their tuned configs resolve independently
+        assert tuner.flash_dims(64, 1, 256) == \
+            {"d": 64, "sq": 1, "sk": 256}
+        assert tuner.flash_dims(64, 8, 256)["sq"] == 8
+        assert tuner.flash_dims(64, 128, 256)["sq"] == 128  # unchanged
+        assert tuner.flash_dims(64, 130, 256)["sq"] == 256
+
+    def test_paged_dims_page_exact_capacity_bucketed(self):
+        from paddle_tpu.ops.pallas.paged_attention import paged_dims
+        assert paged_dims(32, 16, 16) == {"d": 32, "ps": 16, "sk": 256}
+        assert paged_dims(32, 16, 8) == {"d": 32, "ps": 16, "sk": 128}
+
     def test_ce_dims_bucket_tokens_not_vocab(self):
         assert tuner.ce_dims(64, 500, 200) == {"h": 64, "v": 500, "t": 256}
 
@@ -226,6 +240,46 @@ class TestAnalysisRule:
         closed = jax.make_jaxpr(
             lambda a, b: fused_lm_ce(a, b, y, interpret=True))(hid, w)
         assert self._findings(closed) == []
+
+    def _paged(self, d=32, ps=16, pages=16, pool=64):
+        from paddle_tpu.ops.pallas.paged_attention import \
+            paged_decode_attention
+        rs = np.random.RandomState(3)
+        q = jnp.asarray(rs.randn(2, 1, 2, d), jnp.float32)
+        kp = jnp.asarray(rs.randn(pool, ps, 2, d), jnp.float32)
+        tb = jnp.zeros((2, pages), jnp.int32)
+        ln = jnp.asarray([ps, 2 * ps], jnp.int32)
+        return jax.make_jaxpr(
+            lambda q, kp, vp: paged_decode_attention(
+                q, kp, vp, tb, ln, kernel="pallas",
+                interpret=True))(q, kp, kp)
+
+    def test_paged_decode_tuned_is_silent(self):
+        # the shipped seed DB carries the bench_serving decode buckets
+        assert self._findings(self._paged(d=32, ps=16, pages=16)) == []
+        assert self._findings(self._paged(d=32, ps=16, pages=8)) == []
+
+    def test_paged_decode_untuned_shape_fires(self):
+        fs = self._findings(self._paged(d=128, ps=16, pages=16))
+        assert len(fs) == 1
+        assert "paged_attention" in fs[0].message
+        assert "d128" in fs[0].message
+
+
+class TestPagedTuneCase:
+    def test_decode_sweep_validates_and_records(self, tmp_path):
+        """Interpret-mode sweep of one decode case: both q_pad
+        candidates validate against the XLA gather baseline, the entry
+        lands with mean_us null (no TPU to time on)."""
+        key, entry = tuner.tune_case(
+            "paged_attention",
+            {"b": 2, "h": 2, "d": 32, "ps": 8, "pages": 4}, jnp.float32)
+        assert key.startswith("paged_attention|")
+        assert entry is not None and entry["swept"] == 2
+        assert entry["validated"] == "interpret"
+        assert entry["mean_us"] is None
+        assert entry["config"]["q_pad"] in (8, 16)
+        assert entry["dims"] == {"d": 32, "ps": 8, "sk": 128}
 
 
 class TestOpBenchPallasSuite:

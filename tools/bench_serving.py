@@ -19,6 +19,15 @@ printed:
   (``replica_failover_total >= 1``), and ZERO admitted-and-feasible
   requests are silently lost — every submitted request terminates as
   completed / shed / expired (``accounted``).
+- **decode** — prefix-heavy Poisson generations against the
+  DecodeServer + paged KV cache (toy autoregressive LM): every prompt
+  shares a 32-token system prefix, so after one warm-up generation the
+  prefill tokens actually computed must be <= 0.5x the no-sharing
+  baseline at a ``kv_cache_hit_rate`` >= 0.5, the pool is sized so LRU
+  eviction fires, and — with every (token-bucket, row-bucket) executor
+  shape compiled up front — serving traffic triggers zero fresh
+  compiles no matter how decode steps coalesce. Reports decode
+  tokens/sec goodput under the deadline contract.
 
 Capacity is made deterministic on any machine by padding each batch
 execute with a fixed service time (the model itself is tiny), so
@@ -88,6 +97,17 @@ def make_executor(pred, pad_s: float):
     return fn
 
 
+def make_step_executor(step, pad_s: float):
+    """Same fixed service pad around a DecodeServer step executor."""
+
+    def fn(arrays):
+        out = step(list(arrays))
+        time.sleep(pad_s)
+        return out
+
+    return fn
+
+
 def _diff(before: dict, after: dict) -> dict:
     out = {}
     for k, v in after.items():
@@ -148,6 +168,157 @@ def run_phase(server, rate_rps: float, duration_s: float,
     }
 
 
+def _prime_decode_shapes(step, width: int, token_buckets, rows_cap: int):
+    """Compile every (token-bucket, row-bucket) shape of BOTH executor
+    paths (mixed prefill and pure decode) before serving starts, so
+    traffic triggers zero fresh compiles regardless of how decode steps
+    coalesce into batches."""
+    for t_b in token_buckets:
+        r_b = min(t_b, rows_cap)
+        tables = np.zeros((r_b, width), np.int32)
+        # mixed/prefill shape: one cold row owning every token
+        step([np.zeros(t_b, np.int32), np.zeros(t_b, np.int32),
+              np.arange(t_b, dtype=np.int32), np.ones(t_b, np.int32),
+              tables, np.zeros(r_b, np.int32), np.zeros(r_b, np.int32)])
+        # pure-decode shape: r_b rows with context, one token each
+        valid = np.zeros(t_b, np.int32)
+        valid[:r_b] = 1
+        row_id = np.zeros(t_b, np.int32)
+        row_id[:r_b] = np.arange(r_b)
+        step([np.zeros(t_b, np.int32), row_id, np.ones(t_b, np.int32),
+              valid, tables, np.ones(r_b, np.int32),
+              np.arange(r_b, dtype=np.int32)])
+
+
+def run_decode_bench(smoke: bool, seed: int) -> dict:
+    """Prefix-heavy decode phase: Poisson generations whose prompts
+    share a 2-page system prefix. Returns the decode section of the
+    bench record, with its own ``checks`` sub-dict."""
+    from paddle_tpu.inference import serving
+    from paddle_tpu.inference.decode_model import (init_decode_model,
+                                                   make_step_fn)
+    from paddle_tpu.inference.kv_cache import PagedKVCache
+
+    heads, head_dim, page_size = 2, 32, 16
+    num_pages, width = 20, 4           # width = max pages per sequence
+    replicas, token_budget, rows_cap = 2, 8, 4
+    pad_s = 0.01
+    # prompt = 32 shared + 8 unique; 8 written decode tokens close the
+    # 3rd page exactly, so each generation leaves ONE fresh registered
+    # page behind — completed traffic fills the pool and forces eviction
+    max_new = 9
+    rate_rps = 16.0 if smoke else 20.0
+    duration = 1.5 if smoke else 4.0
+    deadline_s = 3.0
+
+    rng = np.random.RandomState(seed + 17)
+    system = [int(t) for t in rng.randint(0, 128, 2 * page_size)]
+
+    def prompt(i):
+        rs = np.random.RandomState(1000 + i)
+        return system + [int(t) for t in rs.randint(0, 128, 8)]
+
+    params = init_decode_model(vocab=128, num_heads=heads,
+                               head_dim=head_dim, seed=1)
+    cache = PagedKVCache(num_pages, page_size, heads, head_dim)
+    step = make_step_fn(params, cache)
+    _prime_decode_shapes(step, width, (1, 2, 4, 8), rows_cap)
+    jits_primed = sum(f._cache_size() for f in step.jit_fns)
+
+    cfg = serving.ServingConfig(
+        max_queue=6, max_batch=token_budget, batch_wait_s=0.002,
+        call_timeout_s=2.0, admission_safety=1.3, seed=seed)
+    server = serving.DecodeServer(
+        make_step_executor(step, pad_s), cache, replicas=replicas,
+        config=cfg, prefill_chunk=8, max_pages_per_seq=width,
+        max_batch_rows=rows_cap)
+
+    with server:
+        # warm-up: registers the shared system-prompt pages + the EWMA
+        server.submit_generate(prompt(0), max_new,
+                               deadline_s=30.0).result(timeout=120)
+        ev0 = cache.evictions
+        hits0 = cache.prefix_hit_tokens
+        tok0 = server.stats()["decode_tokens"]
+
+        reqs = []
+        t0 = time.monotonic()
+        next_t, i = t0, 0
+        while True:
+            next_t += rng.exponential(1.0 / rate_rps)
+            if next_t - t0 > duration:
+                break
+            lag = next_t - time.monotonic()
+            if lag > 0:
+                time.sleep(lag)
+            i += 1
+            reqs.append(server.submit_generate(prompt(i), max_new,
+                                               deadline_s=deadline_s))
+        elapsed = time.monotonic() - t0
+        settle = time.monotonic() + deadline_s + 20.0
+        for r in reqs:
+            r._done.wait(max(0.0, settle - time.monotonic()))
+        stats = server.stats()
+        accounted = server.accounted()
+        server.shutdown(drain=True)
+
+    jits_final = sum(f._cache_size() for f in step.jit_fns)
+    admitted = [r for r in reqs if r.seq is not None]
+    prompt_tokens = sum(len(r.prompt) for r in admitted)
+    hit_tokens = sum(r.seq.cached_tokens for r in admitted)
+    prefill_computed = prompt_tokens - hit_tokens
+    hit_rate = hit_tokens / max(1, prompt_tokens)
+    in_deadline = [r for r in reqs if r.state == "completed"
+                   and r.latency is not None and r.latency <= deadline_s]
+    goodput_tps = sum(r.max_new for r in in_deadline) / elapsed
+    lat = sorted(r.latency for r in reqs
+                 if r.state == "completed" and r.latency is not None)
+
+    def pct(p):
+        return lat[min(len(lat) - 1, int(p * len(lat)))] if lat else None
+
+    by_state = {}
+    for r in reqs:
+        by_state[r.state] = by_state.get(r.state, 0) + 1
+    checks = {
+        "decode_goodput_positive": goodput_tps > 0,
+        # acceptance: hit-rate >= 0.5 with prefill computed <= 0.5x the
+        # no-sharing baseline (= every admitted prompt fully recomputed)
+        "decode_hit_rate": hit_rate >= 0.5,
+        "decode_prefill_halved": prefill_computed <= 0.5 * prompt_tokens,
+        "decode_compiled_set_closed": jits_final == jits_primed,
+        "decode_hit_accounting": (
+            hit_tokens == cache.prefix_hit_tokens - hits0),
+        "decode_evictions_exercised": cache.evictions - ev0 >= 1,
+        "decode_zero_lost": (accounted and by_state.get("failed", 0) == 0
+                             and stats["failed"] == 0),
+    }
+    return {
+        "decode_goodput_tokens_per_s": round(goodput_tps, 1),
+        "kv_cache_hit_rate": round(hit_rate, 4),
+        "prefill_tokens_computed": prefill_computed,
+        "prefill_tokens_no_sharing": prompt_tokens,
+        "prefix_hit_tokens": hit_tokens,
+        "offered_rps": round(len(reqs) / elapsed, 1),
+        "duration_s": round(elapsed, 3),
+        "submitted": len(reqs),
+        "admitted": len(admitted),
+        "completed": by_state.get("completed", 0),
+        "shed": by_state.get("shed", 0),
+        "expired": by_state.get("expired", 0),
+        "failed": by_state.get("failed", 0),
+        "decode_tokens": stats["decode_tokens"] - tok0,
+        "evictions": cache.evictions - ev0,
+        "deadline_s": deadline_s,
+        "p50_s": pct(0.50),
+        "p99_s": pct(0.99),
+        "jit_shapes": {"primed": jits_primed, "final": jits_final},
+        "recompiles": stats["recompiles"],
+        "kv_cache": stats["kv_cache"],
+        "checks": checks,
+    }
+
+
 def run_bench(smoke: bool, seed: int = 0) -> dict:
     from paddle_tpu import inference, telemetry
     from paddle_tpu.inference import serving
@@ -196,6 +367,9 @@ def run_bench(smoke: bool, seed: int = 0) -> dict:
         accounted = server.accounted()
         server.shutdown(drain=True)
 
+    decode = run_decode_bench(smoke, seed)
+    decode_checks = decode.pop("checks")
+
     shed_total = (overload["shed"] + overload["expired"])
     goodput_band_ok = (
         baseline["goodput_rps"] > 0
@@ -211,6 +385,7 @@ def run_bench(smoke: bool, seed: int = 0) -> dict:
         "zero_requests_lost": accounted and failover["failed"] == 0,
         "buckets_closed": recompiles_final == recompiles_warm,
     }
+    checks.update(decode_checks)
     return {
         "metric": "serving_overload_goodput_rps",
         "value": overload["goodput_rps"],
@@ -232,6 +407,8 @@ def run_bench(smoke: bool, seed: int = 0) -> dict:
                 "closed": checks["buckets_closed"],
             },
             "accounted": accounted,
+            "decode": decode,
+            "kv_cache_hit_rate": decode["kv_cache_hit_rate"],
             "stats": stats,
             "telemetry": {
                 "prometheus_bytes": len(telemetry.prometheus_text()),
