@@ -34,6 +34,39 @@ local-disk staging (``PADDLE_TPU_CKPT_STAGING`` or a tempdir; counted in
 ``ckpt_retry_bytes_abandoned_total``) instead of burning the link.
 ``restore()`` falls back to the newest verified staged step when no
 primary step restores.
+
+Async commit pipeline (ISSUE 13): with ``async_commit=True`` the manager
+takes the whole write→fsync→CRC→MANIFEST→rename two-phase commit off the
+step path. ``save()`` only snapshots the device arrays into a host-side
+staging buffer (``jax.device_get`` — a donation-safe copy, so step N+1
+can freely mutate the live state while step N persists) and returns; a
+background committer thread runs the commit. The pipeline is
+double-buffered: at most one snapshot is being committed and one is
+staged — a newer snapshot arriving while one is staged SUPERSEDES it
+(``ckpt_suppressed_total{reason=superseded}``) so save cadence degrades
+gracefully under backpressure instead of stalling the step loop. A
+``dirty_probe`` callable is consulted at COMMIT time (not snapshot
+time): a quarantine verdict arriving while a tainted snapshot is in
+flight suppresses the commit (``reason=dirty``). Every snapshot
+terminates as exactly one of committed / superseded / suppressed /
+failed / abandoned (``accounted()``).
+
+Async crash consistency: before the committer starts writing step N it
+durably records a ``PENDING.N`` intent marker in the root; the marker is
+removed only after the manifest lands. A step directory carrying a live
+marker and no manifest is an aborted async commit — ``restore()`` and
+``latest_valid_step()`` skip it WITHOUT counting a restore fallback (it
+was never committed; nothing was lost) and retention GC removes the
+debris. A crash anywhere in the pipeline therefore leaves the previous
+``latest_valid_step()`` intact.
+
+Hierarchical tiers: ``deep_every=M`` makes every M-th save a DEEP save
+(per-array content digests in the manifest, PR 9) and the rest cheap
+(file CRCs only). ``restore(prefer_deep=True)`` prefers the newest
+deep-verified step and falls back through the cheap tier with the
+existing ``ckpt_restore_fallbacks_total{reason}`` accounting. Digests
+for async deep saves are computed on the committer thread from the host
+snapshot — off the step path, so deep tiers no longer defeat async.
 """
 from __future__ import annotations
 
@@ -41,19 +74,28 @@ import json
 import os
 import shutil
 import tempfile
+import threading
 import time
 import warnings
 import zlib
-from typing import Any, List, Optional
+from typing import Any, Callable, List, Optional
 
 import jax
 
 __all__ = ["save_checkpoint", "load_checkpoint", "CheckpointManager",
            "TrainEpochRange", "train_epoch_range",
            "write_manifest", "verify_manifest", "MANIFEST_NAME",
-           "CKPT_RETRY_BYTE_BUDGET_X", "staging_root"]
+           "CKPT_RETRY_BYTE_BUDGET_X", "staging_root", "stall_seconds",
+           "attributing_stall", "STALL_BUCKETS_MS"]
 
 MANIFEST_NAME = "MANIFEST.json"
+PENDING_PREFIX = "PENDING."
+
+# ms-denominated buckets for the step-stall/snapshot/commit histograms
+# (DEFAULT_BUCKETS are seconds-scaled and too coarse under 1ms)
+STALL_BUCKETS_MS = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+    250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0)
 
 # retries may move at most this multiple of the state size before the
 # save degrades to local staging (first attempt always runs)
@@ -73,6 +115,57 @@ def staging_root() -> str:
 def _state_nbytes(state: Any) -> float:
     return float(sum(getattr(v, "nbytes", 0) or 0
                      for v in jax.tree_util.tree_leaves(state)))
+
+
+# -- step-stall attribution --------------------------------------------------
+#
+# Every checkpoint path that can block a training step records its
+# host-blocking duration here (sync saves: the whole write+commit; async
+# saves: the device→host snapshot only). The step-time instrumentation
+# (hapi TelemetryCallback) reads the ledger to EXCLUDE save stall from
+# ``step_time_seconds``, so MFU / tokens-per-sec stop dipping on
+# checkpoint steps — the stall is its own headline series.
+
+_stall_lock = threading.Lock()
+_stall_seconds_total = 0.0
+
+
+def stall_seconds() -> float:
+    """Cumulative host-blocking checkpoint time this process (seconds).
+    Step-time instrumentation diffs this across a timed window to carve
+    save stall out of ``step_time_seconds``."""
+    with _stall_lock:
+        return _stall_seconds_total
+
+
+def _record_stall(dt: float):
+    """Attribute ``dt`` seconds of step-loop blocking to checkpointing:
+    the ``ckpt_step_stall_ms`` histogram (the headline async-vs-sync
+    metric) plus the process-wide ledger."""
+    global _stall_seconds_total
+    with _stall_lock:
+        _stall_seconds_total += dt
+    from .. import telemetry
+    if telemetry.enabled():
+        telemetry.histogram(
+            "ckpt_step_stall_ms",
+            "time the step loop blocked on a checkpoint save (async: "
+            "snapshot only; sync: the full write+commit)",
+            buckets=STALL_BUCKETS_MS).observe(dt * 1000.0)
+
+
+class attributing_stall:
+    """Context manager: attribute the wrapped block's wall time to
+    checkpoint stall (used by save paths outside this module, e.g. the
+    hapi ModelCheckpoint callback)."""
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        _record_stall(time.perf_counter() - self._t0)
+        return False
 
 
 def _save_retry_kwargs(nbytes: float) -> dict:
@@ -221,6 +314,42 @@ def verify_manifest(step_dir: str, level: str = "full") -> Optional[bool]:
     return True
 
 
+# -- async-commit intent markers --------------------------------------------
+
+def _pending_marker(root: str, step: int) -> str:
+    return os.path.join(root, PENDING_PREFIX + str(step))
+
+
+def _write_pending_marker(root: str, step: int):
+    """Durably record "step is being committed" BEFORE any byte of the
+    step is written: marker file fsync'd, then the root dir fsync'd so
+    the dirent survives a crash. A step dir found later with a live
+    marker and no manifest is an aborted commit, never a committed step."""
+    p = _pending_marker(root, step)
+    with open(p, "w") as f:
+        f.write(str(step))
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_dir(root)
+
+
+def _clear_pending_marker(root: str, step: int):
+    try:
+        os.remove(_pending_marker(root, step))
+    except OSError:
+        pass
+
+
+def _is_uncommitted(root: str, step: int) -> bool:
+    """Aborted async commit: intent marker present, manifest absent.
+    (A marker WITH a manifest is just a crash between manifest write and
+    marker removal — the commit completed; the stale marker is ignored
+    and cleaned up by GC.)"""
+    return (os.path.exists(_pending_marker(root, step))
+            and not os.path.exists(
+                os.path.join(root, str(step), MANIFEST_NAME)))
+
+
 def _corrupt_one_file(step_dir: str):
     """Fault-injection helper (ckpt_torn): truncate the largest data file —
     what a machine loss mid-flush leaves behind."""
@@ -341,22 +470,36 @@ class CheckpointManager:
 
     ``deep_digests=True`` (opt-in) records per-array content digests in
     the manifest so ``verify(step, deep=True)`` / ``restore(deep=True)``
-    and ``replay_step`` have a value-level reference. The digests are
-    computed from the live state on the save path — a full device→host
-    transfer plus CRC32 per save, which serializes against async writes
-    — so it stays off unless the integrity features are wanted.
+    and ``replay_step`` have a value-level reference. ``deep_every=M``
+    is the tiered form: every M-th save is deep, the rest are cheap
+    (file CRCs only) — frequent cheap saves interleaved with rare
+    verified ones.
+
+    ``async_commit=True`` moves the whole commit off the step path (see
+    the module docstring): ``save()`` snapshots device arrays host-side
+    and returns; a background committer thread writes, manifests, and
+    GCs. ``dirty_probe`` (settable any time, typically by
+    ``run_resilient``) is consulted at commit time — a True answer
+    suppresses the commit (``ckpt_suppressed_total{reason=dirty}``).
+    ``commit_delay`` artificially slows each commit (test/chaos knob for
+    racing a verdict against an in-flight snapshot).
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  save_interval_steps: int = 1, use_async: bool = True,
                  staging_dir: Optional[str] = None,
-                 deep_digests: bool = False):
+                 deep_digests: bool = False,
+                 async_commit: bool = False, deep_every: int = 0,
+                 dirty_probe: Optional[Callable[[], bool]] = None,
+                 commit_delay: float = 0.0):
         import orbax.checkpoint as ocp
         self._dir = os.path.abspath(directory)
         self._staging = staging_dir or os.path.join(
             staging_root(), os.path.basename(self._dir))
         self._max_to_keep = max_to_keep
-        self._use_async = use_async
+        # our committer thread IS the async layer: orbax stays sync under it
+        self._async_commit = bool(async_commit)
+        self._use_async = use_async and not self._async_commit
         # retention is OURS (post-commit, validity-aware): orbax counting
         # torn steps toward max_to_keep could GC the last valid one.
         self._mngr = ocp.CheckpointManager(
@@ -364,13 +507,36 @@ class CheckpointManager:
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=None,
                 save_interval_steps=save_interval_steps,
-                enable_async_checkpointing=use_async))
+                enable_async_checkpointing=self._use_async))
+        self._save_interval = max(1, int(save_interval_steps))
         self._deep_digests = deep_digests
+        self._deep_every = max(0, int(deep_every))
+        self._save_seq = 0              # save() calls, drives the tier cadence
         self._pending: List[int] = []   # written (maybe in flight), no manifest yet
         self._pending_digests = {}      # step -> tree_digests, until committed
         self._vcache = {}               # step -> verify_manifest result
         self.restore_fallbacks_total = 0   # corrupt steps skipped over
         self.last_restored_step: Optional[int] = None
+        # -- async commit pipeline state --
+        self.dirty_probe = dirty_probe  # consulted at COMMIT time
+        self.commit_delay = float(commit_delay)
+        self._fs_lock = threading.RLock()   # serializes disk ops vs committer
+        self._cv = threading.Condition()
+        self._staged = None             # (step, host_state, deep) double buffer
+        self._committing: Optional[int] = None
+        self._commit_thread: Optional[threading.Thread] = None
+        self._commit_gate = threading.Event()  # cleared = commits paused
+        self._commit_gate.set()
+        self._stopping = False
+        self._thread_error: Optional[BaseException] = None
+        # snapshot accounting: every snapshot must terminate as exactly
+        # one of these (or still be in flight)
+        self.snapshots_total = 0
+        self.committed_total = 0
+        self.superseded_total = 0
+        self.suppressed_dirty_total = 0
+        self.failed_total = 0
+        self.abandoned_total = 0
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self._dir, str(step))
@@ -394,6 +560,299 @@ class CheckpointManager:
             self._vcache[step] = verify_manifest(self._step_dir(step))
         return self._vcache[step]
 
+    def _uncommitted(self, step: int) -> bool:
+        """Aborted async commit (live intent marker, no manifest) —
+        never a restore candidate, never a counted fallback."""
+        return _is_uncommitted(self._dir, step)
+
+    # -- async commit pipeline ----------------------------------------------
+
+    @property
+    def async_commit(self) -> bool:
+        return self._async_commit
+
+    @property
+    def deep_every(self) -> int:
+        return self._deep_every
+
+    @deep_every.setter
+    def deep_every(self, value: int):
+        self._deep_every = max(0, int(value))
+
+    def accounted(self) -> bool:
+        """Every snapshot terminated (committed / superseded / suppressed
+        / failed / abandoned) and none is still in flight."""
+        with self._cv:
+            in_flight = (self._staged is not None
+                         or self._committing is not None)
+            total = (self.committed_total + self.superseded_total
+                     + self.suppressed_dirty_total + self.failed_total
+                     + self.abandoned_total)
+            return not in_flight and total == self.snapshots_total
+
+    def inflight(self) -> int:
+        """Snapshots staged or mid-commit (0..2 — double-buffered)."""
+        with self._cv:
+            return int(self._staged is not None) + \
+                int(self._committing is not None)
+
+    def pause_commits(self):
+        """Hold the committer before its next commit (test/chaos hook:
+        the deterministic 'between snapshot and commit' window)."""
+        self._commit_gate.clear()
+
+    def resume_commits(self):
+        self._commit_gate.set()
+        with self._cv:
+            self._cv.notify_all()
+
+    def _count_suppressed(self, reason: str):
+        from .. import telemetry
+        if reason == "dirty":
+            self.suppressed_dirty_total += 1
+        else:
+            self.superseded_total += 1
+        if telemetry.enabled():
+            telemetry.counter(
+                "ckpt_suppressed_total",
+                "async snapshots whose commit was suppressed "
+                "(dirty: quarantine verdict arrived while in flight; "
+                "superseded: a newer snapshot replaced it)").inc(
+                    reason=reason)
+
+    def _set_inflight_gauge(self):
+        from .. import telemetry
+        if telemetry.enabled():
+            telemetry.gauge(
+                "ckpt_inflight",
+                "snapshots staged or mid-commit (async pipeline)").set(
+                    self.inflight())
+
+    def _snapshot_host(self, state: Any):
+        """Device→host copy of the state tree (numpy leaves): the staging
+        buffer the committer persists. After this returns, nothing in the
+        snapshot aliases device memory, so the next step may donate/mutate
+        the live state freely."""
+        import numpy as np
+
+        def _leaf(x):
+            if isinstance(x, np.ndarray):
+                return np.array(x)  # private copy: caller may mutate theirs
+            if isinstance(x, np.generic):
+                return np.asarray(x)
+            if hasattr(x, "shape"):
+                try:
+                    return np.asarray(jax.device_get(x))
+                except Exception:
+                    return x  # non-addressable shard: let orbax handle it
+            return x
+        from .. import telemetry
+        t0 = time.perf_counter()
+        host = jax.tree_util.tree_map(_leaf, state)
+        dt = time.perf_counter() - t0
+        if telemetry.enabled():
+            telemetry.histogram(
+                "ckpt_snapshot_ms",
+                "device→host staging-buffer copy time (the only part of "
+                "an async save on the step path)",
+                buckets=STALL_BUCKETS_MS).observe(dt * 1000.0)
+        return host
+
+    def _ensure_committer(self):
+        if self._commit_thread is None or not self._commit_thread.is_alive():
+            self._stopping = False
+            self._commit_thread = threading.Thread(
+                target=self._commit_loop, name="ckpt-committer", daemon=True)
+            self._commit_thread.start()
+
+    def _commit_loop(self):
+        while True:
+            with self._cv:
+                while not self._stopping and (
+                        self._staged is None
+                        or not self._commit_gate.is_set()):
+                    self._cv.wait(timeout=0.05)
+                if self._stopping and self._staged is None:
+                    return
+                if self._staged is None or not self._commit_gate.is_set():
+                    continue
+                step, host, deep = self._staged
+                self._staged = None
+                self._committing = step
+            try:
+                self._commit_one(step, host, deep)
+            except BaseException as e:  # noqa: BLE001 — surfaced via flush()
+                self.failed_total += 1
+                self._thread_error = e
+            finally:
+                with self._cv:
+                    self._committing = None
+                    self._cv.notify_all()
+                self._set_inflight_gauge()
+
+    def _commit_one(self, step: int, host_state: Any, deep: bool):
+        """The off-step-path half of an async save: dirty check, intent
+        marker, orbax write (retry/byte-budgeted like the sync path),
+        two-phase manifest commit, retention GC."""
+        import orbax.checkpoint as ocp
+        from ..resilience import faults
+        from ..resilience.retry import RetryBytesExhausted, call_with_retry
+        from .. import telemetry
+        if self.commit_delay > 0:
+            time.sleep(self.commit_delay)
+        # the subtle interaction: consult the dirty flag at COMMIT time —
+        # a quarantine verdict that arrived after the snapshot was taken
+        # must keep the tainted state off disk
+        probe = self.dirty_probe
+        if probe is not None and probe():
+            self._count_suppressed("dirty")
+            if telemetry.enabled():
+                telemetry.emit("ckpt_commit", step=step, outcome="dirty")
+            return
+        t0 = time.perf_counter()
+        arrays = None
+        if deep:
+            from ..resilience.integrity import tree_digests
+            arrays = tree_digests(host_state)  # host-side, off the step path
+        with self._fs_lock:
+            # crash window proof: the marker lands durably before any byte
+            _write_pending_marker(self._dir, step)
+            if step in (self._mngr.all_steps() or []):
+                self._mngr.delete(step)
+                self._vcache.pop(step, None)
+
+            def _write():
+                faults.maybe_raise(
+                    "ckpt_io", step=step, site="async_commit",
+                    msg=f"injected ckpt_io committing step {step}")
+                return self._mngr.save(
+                    step, args=ocp.args.StandardSave(host_state))
+
+            nbytes = _state_nbytes(host_state)
+            try:
+                saved = call_with_retry(
+                    _write, site="ckpt_save", base_delay=0.01,
+                    **_save_retry_kwargs(nbytes))
+            except RetryBytesExhausted as e:
+                _stage_save(self._staged_step_dir(step), host_state,
+                            nbytes, e, arrays=arrays)
+                _clear_pending_marker(self._dir, step)
+                self.committed_total += 1  # durable, just degraded
+                return
+            if not saved:
+                self.superseded_total += 1  # orbax interval-skipped it
+                return
+            self._mngr.wait_until_finished()
+            sdir = self._step_dir(step)
+            if faults.fires("ckpt_torn", step=step, site="ckpt_commit"):
+                # the kill -9 window: torn payload, no manifest, marker
+                # left live — the step must stay invisible to restores
+                _corrupt_one_file(sdir)
+                self._vcache.pop(step, None)
+                raise faults.SimulatedCrash(
+                    f"simulated kill -9 committing checkpoint step {step}")
+            if os.environ.get("PADDLE_TPU_TEST_COMMIT_CRASH") == str(step):
+                # chaos hook: a REAL kill -9 after the payload write but
+                # before the manifest — the torn-dir crash window
+                import signal as _signal
+                os.kill(os.getpid(), _signal.SIGKILL)
+            write_manifest(sdir, arrays=arrays)
+            _clear_pending_marker(self._dir, step)
+            self._vcache[step] = True
+            self.committed_total += 1
+            self._gc()
+        dt = time.perf_counter() - t0
+        _record("save", dt, host_state)
+        if telemetry.enabled():
+            telemetry.histogram(
+                "ckpt_commit_ms",
+                "background write→fsync→CRC→manifest→GC time per "
+                "committed step (off the step path)",
+                buckets=STALL_BUCKETS_MS).observe(dt * 1000.0)
+            telemetry.emit("ckpt_commit", step=step,
+                           outcome="committed", deep=bool(deep),
+                           commit_ms=dt * 1000.0)
+
+    def _save_async(self, step: int, state: Any, deep: bool) -> bool:
+        """The on-step-path half: snapshot + stage + return. Never blocks
+        on IO; a staged-but-not-started older snapshot is superseded."""
+        self._raise_thread_error()
+        if self._save_interval > 1 and step % self._save_interval:
+            return False
+        t0 = time.perf_counter()
+        host = self._snapshot_host(state)
+        with self._cv:
+            self.snapshots_total += 1
+            if self._staged is not None:
+                # double buffer full: the newer state supersedes — cadence
+                # degrades under backpressure, the step loop never waits
+                self._count_suppressed("superseded")
+            self._staged = (step, host, deep)
+            self._cv.notify_all()
+        self._ensure_committer()
+        self._set_inflight_gauge()
+        dt = time.perf_counter() - t0
+        _record_stall(dt)
+        from .. import telemetry
+        if telemetry.enabled():
+            telemetry.emit("ckpt_snapshot", step=step, deep=bool(deep),
+                           snapshot_ms=dt * 1000.0)
+        return True
+
+    def _raise_thread_error(self):
+        """Re-raise a committer-thread SimulatedCrash (the injected
+        kill -9) at the step boundary so run_resilient's restart path
+        sees it exactly like the sync pipeline's. Other commit failures
+        stay recorded (failed_total) without killing the run."""
+        err, self._thread_error = self._thread_error, None
+        if err is not None:
+            from ..resilience import faults
+            if isinstance(err, faults.SimulatedCrash):
+                raise err
+            warnings.warn(f"async checkpoint commit failed: {err!r}",
+                          RuntimeWarning)
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Block until no snapshot is staged or mid-commit (drain /
+        pre-restore barrier). Returns False on timeout. Re-raises a
+        committer SimulatedCrash."""
+        if self._async_commit and not self._commit_gate.is_set() and \
+                (self._staged is not None or self._committing is not None):
+            warnings.warn("flush() while commits are paused — resuming",
+                          RuntimeWarning)
+            self.resume_commits()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._staged is not None or self._committing is not None:
+                wait = 0.1 if deadline is None else min(
+                    0.1, deadline - time.monotonic())
+                if deadline is not None and wait <= 0:
+                    return False
+                self._cv.wait(timeout=wait)
+        self._raise_thread_error()
+        return True
+
+    def abandon(self):
+        """Drop any staged snapshot without committing it (the in-process
+        stand-in for dying mid-pipeline; chaos uses a real SIGKILL)."""
+        with self._cv:
+            if self._staged is not None:
+                self._staged = None
+                self.abandoned_total += 1
+            self._cv.notify_all()
+
+    def _tier_deep(self, explicit: Optional[bool]) -> bool:
+        """Tier decision for one save: explicit flag wins; else
+        ``deep_digests`` (every save) or the ``deep_every`` cadence
+        (save #0, #M, #2M, ... are deep — a run always has a deep anchor)."""
+        if explicit is not None:
+            return bool(explicit)
+        if self._deep_digests:
+            return True
+        if self._deep_every:
+            return self._save_seq % self._deep_every == 0
+        return False
+
     def _commit_pending(self):
         """Phase 2: barrier on in-flight writes, manifest each pending step,
         then GC. An injected ``ckpt_torn`` fault corrupts the step and skips
@@ -414,6 +873,9 @@ class CheckpointManager:
             if os.path.isdir(sdir):
                 write_manifest(sdir,
                                arrays=self._pending_digests.pop(step, None))
+                # a sync replay of a step whose async commit was aborted:
+                # the commit just completed, retire the stale intent marker
+                _clear_pending_marker(self._dir, step)
                 self._vcache[step] = True
             else:
                 self._pending_digests.pop(step, None)
@@ -427,24 +889,56 @@ class CheckpointManager:
         if not self._max_to_keep:
             return
         steps = sorted(self._mngr.all_steps() or [])
-        valid = [s for s in steps if self._verify(s) is not False]
+        # aborted async commits (live marker, no manifest) are debris:
+        # always collectable, never restore candidates, and their stale
+        # markers go with them
+        debris = {s for s in steps if self._uncommitted(s)}
+        valid = [s for s in steps
+                 if s not in debris and self._verify(s) is not False]
         if not valid:
             return
         keep = set(valid[-self._max_to_keep:])
         for s in steps:
-            if s in keep or s in self._pending:
+            if s in keep or (s in self._pending and s not in debris):
                 continue
+            if s == self._committing:
+                continue  # mid-commit on the committer thread
             try:
                 self._mngr.delete(s)
             except Exception:
                 continue
+            _clear_pending_marker(self._dir, s)
             self._vcache.pop(s, None)
+        # markers whose step dir is already gone (GC'd debris or a crash
+        # before any byte landed)
+        try:
+            for name in os.listdir(self._dir):
+                if not name.startswith(PENDING_PREFIX):
+                    continue
+                try:
+                    s = int(name[len(PENDING_PREFIX):])
+                except ValueError:
+                    continue
+                if _is_uncommitted(self._dir, s) and \
+                        not os.path.isdir(self._step_dir(s)):
+                    _clear_pending_marker(self._dir, s)
+        except OSError:
+            pass
 
-    def save(self, step: int, state: Any) -> bool:
+    def save(self, step: int, state: Any,
+             deep: Optional[bool] = None) -> bool:
+        """Persist ``state`` as ``step``. ``deep`` pins this save's tier
+        (None = the manager's ``deep_digests``/``deep_every`` policy).
+        In async mode the call returns after the host snapshot; the
+        two-phase commit happens on the committer thread."""
         import numpy as np
         import orbax.checkpoint as ocp
         from ..resilience import faults
         from ..resilience.retry import RetryBytesExhausted, call_with_retry
+        tier_deep = self._tier_deep(deep)
+        self._save_seq += 1
+        if self._async_commit:
+            return self._save_async(step, state, tier_deep)
         # numpy scalars (np.int32(3) etc.) are not in orbax's supported
         # leaf types — promote them to 0-d ndarrays
         state = jax.tree_util.tree_map(
@@ -458,7 +952,7 @@ class CheckpointManager:
             self._vcache.pop(step, None)
             self._pending_digests.pop(step, None)
         arrays = None
-        if self._deep_digests:
+        if tier_deep:
             # content digests are taken from the live state at save time —
             # the ground truth the payload must still decode to at restore
             from ..resilience.integrity import tree_digests
@@ -483,7 +977,9 @@ class CheckpointManager:
             # primary step verifies.
             _stage_save(self._staged_step_dir(step), state, nbytes, e,
                         arrays=arrays)
-            _record("save", time.perf_counter() - t0, state)
+            dt = time.perf_counter() - t0
+            _record("save", dt, state)
+            _record_stall(dt)  # a sync save stalls the step for its wall
             return True
         if saved:  # interval-skipped saves shouldn't pollute the histogram
             self._pending.append(step)
@@ -491,7 +987,9 @@ class CheckpointManager:
                 self._pending_digests[step] = arrays
             if not self._use_async:
                 self._commit_pending()
-            _record("save", time.perf_counter() - t0, state)
+            dt = time.perf_counter() - t0
+            _record("save", dt, state)
+            _record_stall(dt)  # a sync save stalls the step for its wall
         return saved
 
     def _restore_step(self, step: int, template: Optional[Any]):
@@ -567,10 +1065,17 @@ class CheckpointManager:
         return dv
 
     def restore(self, step: Optional[int] = None,
-                template: Optional[Any] = None, deep: bool = False):
+                template: Optional[Any] = None, deep: bool = False,
+                prefer_deep: bool = False):
         from ..resilience.retry import call_with_retry
+        if self._async_commit:
+            self.flush()  # only committed steps are restore candidates
         self._commit_pending()
         if step is not None:  # explicit step: verify, no fallback
+            if self._uncommitted(step):
+                raise OSError(
+                    f"checkpoint step {step} was never committed "
+                    f"(aborted async save)")
             # re-verify from disk (not the cache): restore is rare and this
             # catches rot that happened after the commit
             self._vcache.pop(step, None)
@@ -594,7 +1099,36 @@ class CheckpointManager:
             _record("restore", time.perf_counter() - t0, out)
             self.last_restored_step = step
             return out
-        for s in sorted(self._mngr.all_steps() or [], reverse=True):
+        steps_desc = sorted(self._mngr.all_steps() or [], reverse=True)
+        deep_failed: set = set()
+        if prefer_deep:
+            # tier-aware pass 1: the newest DEEP-verified step wins —
+            # cheap-tier steps (no digests) are not candidates yet and
+            # cost no fallback here; they are pass 2's job
+            for s in steps_desc:
+                if self._uncommitted(s):
+                    continue  # aborted async commit: debris, not a fallback
+                if self._manifest_arrays(s) is None:
+                    continue  # cheap tier
+                self._vcache.pop(s, None)
+                if self._verify(s) is False:
+                    self._count_fallbacks(1, reason="manifest")
+                    deep_failed.add(s)
+                    continue
+                t0 = time.perf_counter()
+                dv, out = self._deep_verify(s, template)
+                if dv is True:
+                    _record("restore", time.perf_counter() - t0, out)
+                    self.last_restored_step = s
+                    return out
+                self._count_fallbacks(1, reason="deep")
+                deep_failed.add(s)
+            # no deep anchor survived: fall back through the cheap tiers
+        for s in steps_desc:
+            if s in deep_failed:
+                continue  # already counted above
+            if self._uncommitted(s):
+                continue  # aborted async commit: debris, not a fallback
             self._vcache.pop(s, None)
             if self._verify(s) is False:
                 self._count_fallbacks(1, reason="manifest")
@@ -653,6 +1187,8 @@ class CheckpointManager:
         the elastic restore barrier on every host, so the common
         all-healthy case should not re-read whole checkpoints."""
         for s in sorted(self._mngr.all_steps() or [], reverse=True):
+            if self._uncommitted(s):
+                continue  # aborted async commit — never latest_valid
             if verify_manifest(self._step_dir(s), level="size") is False:
                 self._vcache[s] = False
                 continue
@@ -664,11 +1200,20 @@ class CheckpointManager:
         return self._mngr.all_steps()
 
     def wait_until_finished(self):
+        if self._async_commit:
+            self.flush()
         self._mngr.wait_until_finished()
         self._commit_pending()
 
     def close(self):
         try:
+            if self._async_commit:
+                self.flush()
+                with self._cv:
+                    self._stopping = True
+                    self._cv.notify_all()
+                if self._commit_thread is not None:
+                    self._commit_thread.join(timeout=5.0)
             self._commit_pending()
         finally:
             self._mngr.close()

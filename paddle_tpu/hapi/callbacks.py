@@ -148,13 +148,19 @@ class ModelCheckpoint(Callback):
         self.save_dir = save_dir
 
     def on_epoch_end(self, epoch, logs=None):
+        from ..distributed.checkpoint import attributing_stall
         if self.save_dir and epoch % self.save_freq == 0:
             path = os.path.join(self.save_dir, str(epoch))
-            self.model.save(path)
+            # attributed so TelemetryCallback keeps save wall out of
+            # step_time/MFU whatever the callback ordering
+            with attributing_stall():
+                self.model.save(path)
 
     def on_train_end(self, logs=None):
+        from ..distributed.checkpoint import attributing_stall
         if self.save_dir:
-            self.model.save(os.path.join(self.save_dir, "final"))
+            with attributing_stall():
+                self.model.save(os.path.join(self.save_dir, "final"))
 
 
 class LRScheduler(Callback):
@@ -271,23 +277,37 @@ class TelemetryCallback(Callback):
     def __init__(self):
         super().__init__()
         self._t0 = None
+        self._stall0 = 0.0
 
     def on_train_batch_begin(self, step, logs=None):
+        from ..distributed import checkpoint as _ckpt
         self._t0 = time.perf_counter()
+        self._stall0 = _ckpt.stall_seconds()
 
     def on_train_batch_end(self, step, logs=None):
         from .. import telemetry
+        from ..distributed import checkpoint as _ckpt
         if self._t0 is None:
             return
         dt = time.perf_counter() - self._t0
         self._t0 = None
+        # a checkpoint save that ran inside this window (ModelCheckpoint
+        # or any caller under ``attributing_stall``) is NOT compute: it
+        # goes to ckpt_step_stall_ms, not step_time/MFU — otherwise MFU
+        # and tokens/sec dip on every checkpoint step
+        stall = max(0.0, _ckpt.stall_seconds() - self._stall0)
+        dt = max(0.0, dt - stall)
         if logs is not None:
             logs["step_time"] = dt
+            if stall:
+                logs["ckpt_stall_ms"] = stall * 1000.0
         if telemetry.enabled():
             telemetry.histogram(
                 "step_time_seconds",
                 "train_step wall time incl. device execution").observe(dt)
-            telemetry.emit("step", step_time=dt, source="hapi")
+            telemetry.emit("step", step_time=dt, source="hapi",
+                           **({"ckpt_stall_ms": stall * 1000.0}
+                              if stall else {}))
         reg = telemetry.get_registry()
         if logs is not None:
             for log_key, metric in (("mfu", "mfu"),

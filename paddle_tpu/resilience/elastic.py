@@ -166,6 +166,11 @@ def coordinated_restore(manager, template, coordinator: FileCoordinator,
     no valid checkpoint at all)."""
     from .. import telemetry
     from . import faults
+    if manager is not None and getattr(manager, "async_commit", False):
+        # an in-flight async commit must land (or be suppressed) before
+        # this host reports: the barrier min-reduces COMMITTED steps only,
+        # so no peer restores a step we haven't durably finished
+        manager.flush()
     local = manager.latest_valid_step() if manager is not None else None
     local = -1 if local is None else int(local)
     if faults.fires("restore_divergence", site="restore_barrier"):

@@ -147,7 +147,12 @@ def _restore(manager, trainer):
     if manager is None:
         return None
     template = {"trainer": trainer.state, "meta": _meta(0, 0, 0)}
-    restored = manager.restore(template=template)
+    kw = {}
+    if getattr(manager, "deep_every", 0):
+        # tiered layout: prefer the newest deep-verified anchor, fall
+        # back through the cheap tiers
+        kw["prefer_deep"] = True
+    restored = manager.restore(template=template, **kw)
     if restored is None:
         return None
     trainer.state = restored["trainer"]
@@ -158,6 +163,7 @@ def _restore(manager, trainer):
 
 def run_resilient(trainer, loader: Iterable, steps: int,
                   manager=None, save_every: int = 1,
+                  deep_every: int = 0,
                   elastic=None, lr: Optional[float] = None,
                   max_restarts: int = 2,
                   handle_signals: bool = True,
@@ -175,7 +181,13 @@ def run_resilient(trainer, loader: Iterable, steps: int,
     stops checkpointing it until a later check step passes clean.
     ``hang_timeout`` (seconds) arms a :class:`integrity.HangWatchdog`
     around each step; ``hang_exit`` makes a firing hard-exit the process
-    with that code (the supervisor observes it — hostsim's hang path)."""
+    with that code (the supervisor observes it — hostsim's hang path).
+
+    ``deep_every=M`` makes every M-th save a deep one (per-array content
+    digests) and the rest cheap (file CRCs only) — the hierarchical-tier
+    cadence, forwarded to the manager. With an async-commit manager the
+    loop also registers a ``dirty_probe`` so a quarantine verdict that
+    lands while a snapshot is in flight suppresses its commit."""
     from .. import telemetry
     from . import integrity
     from ..distributed.fleet.elastic import ElasticManager, ElasticStatus
@@ -198,6 +210,14 @@ def run_resilient(trainer, loader: Iterable, steps: int,
     # "clean" restore. Cleared when a later check step passes clean or
     # a rollback restores verified state.
     dirty = False
+    if manager is not None:
+        if deep_every and hasattr(manager, "deep_every"):
+            manager.deep_every = int(deep_every)
+        if hasattr(manager, "dirty_probe"):
+            # consulted by the committer at COMMIT time: a quarantine
+            # verdict landing while a snapshot is in flight suppresses
+            # that commit (the tainted state never reaches disk)
+            manager.dirty_probe = lambda: dirty
     rollback_steps: List[int] = []
     step, epoch, batch = 0, 0, 0
     last_loss = None
@@ -355,6 +375,11 @@ def run_resilient(trainer, loader: Iterable, steps: int,
                             if hasattr(trainer, "consume_divergence")
                             else [])
                 if diverged:
+                    # taint IMMEDIATELY: an async snapshot of this state
+                    # may already be in flight — the commit-time probe
+                    # must see dirty before the committer reaches it.
+                    # Cleared below only by a verified rollback restore.
+                    dirty = True
                     divergences += 1
                     q = integrity.quarantine_outliers(
                         trainer, leaves=diverged, elastic=elastic)

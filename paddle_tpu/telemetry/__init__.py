@@ -63,6 +63,22 @@ ckpt_retry_bytes_abandoned_total counter  checkpoint saves degraded to
 ckpt_restore_fallbacks_total   counter    CheckpointManager.restore steps
                                           skipped over {reason=manifest|
                                           deep|restore|staged}
+ckpt_step_stall_ms             histogram  time the step loop actually
+                                          blocked on checkpointing (sync:
+                                          the whole save; async: the
+                                          device->host snapshot only) —
+                                          the headline async-vs-sync
+                                          metric
+ckpt_snapshot_ms               histogram  async save device->host
+                                          staging-buffer copy
+ckpt_commit_ms                 histogram  background committer write->
+                                          fsync->CRC->manifest->GC per
+                                          committed step
+ckpt_inflight                  gauge      snapshots staged or mid-commit
+                                          (0..2, double-buffered)
+ckpt_suppressed_total          counter    async snapshots whose commit was
+                                          suppressed {reason=dirty|
+                                          superseded}
 resilience_faults_injected_total counter  resilience.faults {kind=...,
                                           site=...}
 resilience_restarts_total      counter    run_resilient crash recoveries

@@ -1,0 +1,564 @@
+"""Async hierarchical checkpointing (ISSUE 13): the off-step-path commit
+pipeline, tiered deep/cheap saves, the dirty-flag x in-flight-snapshot
+interaction, crash-consistency of aborted commits, and save-stall
+attribution (step_time must not fold checkpoint wall in)."""
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, telemetry
+from paddle_tpu.distributed import checkpoint as ck
+from paddle_tpu.distributed.checkpoint import (PENDING_PREFIX,
+                                               CheckpointManager)
+from paddle_tpu.distributed.engine import ParallelTrainer
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.resilience import faults, run_resilient
+from paddle_tpu.resilience.elastic import FileCoordinator, coordinated_restore
+from paddle_tpu.resilience.integrity import compare_digests, tree_digests
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture()
+def fresh_registry():
+    old_reg = telemetry.get_registry()
+    old_on = telemetry.enabled()
+    reg = telemetry.Registry()
+    telemetry._set_registry(reg)
+    telemetry.enable(True)
+    yield reg
+    telemetry._set_registry(old_reg)
+    telemetry.enable(old_on)
+
+
+def _series_total(reg, name):
+    series = reg.to_dict().get(name, {}).get("series", {})
+    return sum(series.values())
+
+
+def _state(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"w": rng.randn(32, 8).astype(np.float32),
+            "b": rng.randn(8).astype(np.float32),
+            "step": np.int64(seed)}  # np scalar: exercises promotion
+
+
+def _mlp_trainer(check_every=0, seed=7):
+    paddle.seed(seed)
+    mesh = build_mesh({"data": 4 if check_every else 2})
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(8, 16)
+            self.l2 = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.l2(nn.functional.relu(self.l1(x)))
+
+    model = MLP()
+    opt = paddle.optimizer.Momentum(0.05, momentum=0.9,
+                                    parameters=model.parameters())
+    kw = {}
+    if check_every:
+        kw = dict(grad_sync="int8", grad_sync_block=8,
+                  integrity_check_every=check_every)
+    return ParallelTrainer(model, opt,
+                           lambda out, y: jnp.mean((out - y) ** 2),
+                           mesh=mesh, **kw)
+
+
+def _loader(n=4, batch=8, seed=3):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(batch, 8).astype(np.float32),
+             rng.randn(batch, 4).astype(np.float32)) for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# async commit pipeline
+# ---------------------------------------------------------------------------
+
+class TestAsyncPipeline:
+    def test_roundtrip_bitwise_identical_to_sync(self, tmp_path):
+        states = [_state(i) for i in range(3)]
+        ms = CheckpointManager(str(tmp_path / "sync"), use_async=False,
+                               max_to_keep=5)
+        ma = CheckpointManager(str(tmp_path / "async"), async_commit=True,
+                               max_to_keep=5)
+        for i, st in enumerate(states):
+            ms.save(i, st)
+            ma.save(i, st)
+            ma.flush()  # commit each so none is superseded
+        for i, st in enumerate(states):
+            ref = tree_digests(st)
+            assert not compare_digests(ref, tree_digests(ms.restore(i)))
+            assert not compare_digests(ref, tree_digests(ma.restore(i)))
+        assert ma.committed_total == 3 and ma.accounted()
+        ms.close()
+        ma.close()
+
+    def test_save_returns_before_commit(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), async_commit=True,
+                              commit_delay=0.3)
+        t0 = time.perf_counter()
+        m.save(1, _state())
+        assert time.perf_counter() - t0 < 0.25  # snapshot only, no IO wait
+        assert m.inflight() >= 1
+        assert m.flush()
+        assert m.inflight() == 0
+        assert m.latest_valid_step() == 1
+        m.close()
+
+    def test_double_buffer_supersedes_staged_snapshot(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), async_commit=True,
+                              max_to_keep=8)
+        m.pause_commits()
+        for s in (1, 2, 3, 4):
+            m.save(s, _state(s))
+        m.resume_commits()
+        assert m.flush()
+        # only the newest staged snapshot commits; the rest superseded
+        assert m.committed_total == 1
+        assert m.superseded_total == 3
+        assert m.snapshots_total == 4 and m.accounted()
+        assert m.latest_valid_step() == 4
+        assert sorted(m.all_steps() or []) == [4]
+        m.close()
+
+    def test_restore_flushes_inflight_commit(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), async_commit=True,
+                              commit_delay=0.15)
+        st = _state(5)
+        m.save(5, st)
+        out = m.restore()  # must see the committed step, not race it
+        assert m.last_restored_step == 5
+        assert not compare_digests(tree_digests(st), tree_digests(out))
+        m.close()
+
+    def test_wait_until_finished_drains_pipeline(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), async_commit=True,
+                              commit_delay=0.05)
+        m.save(1, _state())
+        m.wait_until_finished()
+        assert m.inflight() == 0 and m.latest_valid_step() == 1
+        m.close()
+
+    def test_committer_simulated_crash_surfaces_at_flush(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), async_commit=True,
+                              max_to_keep=5)
+        m.save(1, _state(1))
+        assert m.flush()
+        with faults.inject("ckpt_torn", at_step=2):
+            m.save(2, _state(2))
+            with pytest.raises(faults.SimulatedCrash):
+                m.flush()
+        # the aborted step is debris (live marker, no manifest): the
+        # previous latest_valid_step is intact and restoring past it
+        # costs NO fallback
+        assert m.failed_total == 1
+        assert m.latest_valid_step() == 1
+        m.restore()
+        assert m.last_restored_step == 1
+        assert m.restore_fallbacks_total == 0
+        m.close()
+
+    def test_aborted_commit_debris_reclaimed_on_replay(self, tmp_path):
+        root = str(tmp_path)
+        m = CheckpointManager(root, async_commit=True, max_to_keep=5)
+        m.save(1, _state(1))
+        assert m.flush()
+        with faults.inject("ckpt_torn", at_step=2):
+            m.save(2, _state(2))
+            with pytest.raises(faults.SimulatedCrash):
+                m.flush()
+        assert os.path.exists(os.path.join(root, PENDING_PREFIX + "2"))
+        # the restart replays step 2: the new commit must clear the
+        # marker and make step 2 the newest valid step
+        m.save(2, _state(2))
+        assert m.flush()
+        assert m.latest_valid_step() == 2
+        assert not os.path.exists(os.path.join(root, PENDING_PREFIX + "2"))
+        assert m.accounted()
+        m.close()
+
+
+# ---------------------------------------------------------------------------
+# dirty flag x in-flight snapshot (the subtle interaction)
+# ---------------------------------------------------------------------------
+
+class TestDirtyInflight:
+    def test_verdict_between_snapshot_and_commit_suppresses(self, tmp_path):
+        dirty = {"v": False}
+        m = CheckpointManager(str(tmp_path), async_commit=True,
+                              dirty_probe=lambda: dirty["v"])
+        m.save(1, _state(1))
+        assert m.flush()
+        m.pause_commits()
+        m.save(2, _state(2))      # tainted snapshot staged, not committed
+        dirty["v"] = True          # the quarantine verdict lands NOW
+        m.resume_commits()
+        assert m.flush()
+        assert m.suppressed_dirty_total == 1
+        assert m.latest_valid_step() == 1
+        assert 2 not in (m.all_steps() or [])  # provably never committed
+        m.close()
+
+    def test_later_clean_check_reenables_saves(self, tmp_path):
+        dirty = {"v": False}
+        m = CheckpointManager(str(tmp_path), async_commit=True,
+                              dirty_probe=lambda: dirty["v"])
+        m.save(1, _state(1))
+        assert m.flush()
+        dirty["v"] = True
+        m.save(2, _state(2))
+        assert m.flush()
+        assert m.suppressed_dirty_total == 1
+        dirty["v"] = False         # a later check step came back clean
+        m.save(3, _state(3))
+        assert m.flush()
+        assert m.latest_valid_step() == 3
+        assert m.committed_total == 2 and m.accounted()
+        m.close()
+
+    def test_drain_flush_suppresses_dirty_and_commits_clean(self, tmp_path):
+        """The two drain paths: a clean staged snapshot is flushed to
+        disk; a dirty one is suppressed — both leave the pipeline
+        accounted."""
+        dirty = {"v": False}
+        m = CheckpointManager(str(tmp_path), async_commit=True,
+                              dirty_probe=lambda: dirty["v"])
+        m.pause_commits()
+        m.save(1, _state(1))
+        m.resume_commits()
+        assert m.flush()           # clean path: drain commits it
+        assert m.latest_valid_step() == 1
+        m.pause_commits()
+        m.save(2, _state(2))
+        dirty["v"] = True
+        m.resume_commits()
+        assert m.flush()           # dirty path: drain suppresses it
+        assert m.suppressed_dirty_total == 1
+        assert m.latest_valid_step() == 1 and m.accounted()
+        m.close()
+
+    def test_runner_quarantine_verdict_suppresses_inflight(
+            self, fresh_registry, tmp_path):
+        """End-to-end: param_flip SDC mid-run with slow commits — the
+        divergence verdict must suppress whatever snapshot is in flight,
+        the tainted step must never land on disk, and the run must
+        recover and finish with the pipeline fully accounted."""
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), async_commit=True,
+                                max_to_keep=12, commit_delay=0.08)
+        with faults.inject("param_flip", at_step=5, seed=11) as f:
+            res = run_resilient(_mlp_trainer(check_every=2), _loader(),
+                                steps=8, manager=mgr, save_every=1,
+                                handle_signals=False)
+        assert f.fired == 1
+        assert res.exit_code == 0 and res.divergences >= 1
+        # the verdict raced at least one in-flight snapshot into
+        # suppression (commit_delay guarantees commits lag the loop)
+        assert mgr.suppressed_dirty_total >= 1
+        assert _series_total(fresh_registry, "ckpt_suppressed_total") >= 1
+        mgr.flush()
+        assert mgr.accounted()
+        # after the clean check re-enabled saves the run kept committing
+        assert mgr.latest_valid_step() is not None
+        mgr.close()
+
+    def test_runner_registers_dirty_probe(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), async_commit=True)
+        assert mgr.dirty_probe is None
+        run_resilient(_mlp_trainer(), _loader(), steps=2, manager=mgr,
+                      save_every=1, handle_signals=False)
+        assert callable(mgr.dirty_probe)
+        assert mgr.dirty_probe() is False  # run ended clean
+        mgr.close()
+
+    def test_runner_sigterm_drain_flushes_async_save(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), async_commit=True,
+                                max_to_keep=12, commit_delay=0.05)
+        with faults.inject("sigterm", at_step=3):
+            res = run_resilient(_mlp_trainer(), _loader(), steps=8,
+                                manager=mgr, save_every=1)
+        assert res.exit_code == 143 and res.status == "sigterm"
+        # the drain save landed durably before return
+        assert mgr.inflight() == 0
+        assert mgr.latest_valid_step() == 2
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# hierarchical tiers
+# ---------------------------------------------------------------------------
+
+class TestTiers:
+    def test_deep_every_cadence(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), use_async=False,
+                              max_to_keep=8, deep_every=2)
+        for s in (1, 2, 3, 4):
+            m.save(s, _state(s))
+        # save #0,#2 are deep (digest-bearing), #1,#3 cheap
+        assert m._manifest_arrays(1) and m._manifest_arrays(3)
+        assert not m._manifest_arrays(2) and not m._manifest_arrays(4)
+        m.close()
+
+    def test_explicit_tier_flag_wins(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), use_async=False,
+                              max_to_keep=8, deep_every=2)
+        m.save(1, _state(1), deep=False)   # would be deep by cadence
+        m.save(2, _state(2), deep=True)    # would be cheap by cadence
+        assert not m._manifest_arrays(1)
+        assert m._manifest_arrays(2)
+        m.close()
+
+    def test_async_tiers_digest_from_host_snapshot(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), async_commit=True,
+                              max_to_keep=8, deep_every=2)
+        for s in (1, 2):
+            m.save(s, _state(s))
+            assert m.flush()
+        assert m._manifest_arrays(1) and not m._manifest_arrays(2)
+        assert m.verify(1, deep=True) is True
+        m.close()
+
+    def test_prefer_deep_picks_newest_deep_anchor(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), use_async=False,
+                              max_to_keep=8, deep_every=2)
+        for s in (1, 2, 3, 4):
+            m.save(s, _state(s))
+        out = m.restore(prefer_deep=True)
+        # step 3 is the newest deep-verified step (4 is cheap)
+        assert m.last_restored_step == 3
+        assert not compare_digests(tree_digests(_state(3)),
+                                   tree_digests(out))
+        assert m.restore_fallbacks_total == 0
+        m.close()
+
+    def test_prefer_deep_falls_back_through_cheap_tiers(
+            self, fresh_registry, tmp_path):
+        root = str(tmp_path)
+        m = CheckpointManager(root, use_async=False, max_to_keep=8,
+                              deep_every=2)
+        for s in (1, 2, 3, 4):
+            m.save(s, _state(s))
+
+        def _rot(step):
+            # flip a payload byte and re-attest its CRC: shallow passes,
+            # only the content digests can catch it
+            sdir = os.path.join(root, str(step))
+            best, size = None, -1
+            for r, _d, names in os.walk(sdir):
+                if "ocdbt.process_" in r:
+                    continue
+                for n in names:
+                    if n.startswith("MANIFEST"):
+                        continue
+                    p = os.path.join(r, n)
+                    if os.path.getsize(p) > size:
+                        best, size = p, os.path.getsize(p)
+            with open(best, "r+b") as f:
+                f.seek(size // 2)
+                b = f.read(1)
+                f.seek(size // 2)
+                f.write(bytes([b[0] ^ 0x01]))
+            import json as _json
+            mpath = os.path.join(sdir, ck.MANIFEST_NAME)
+            with open(mpath) as f:
+                man = _json.load(f)
+            man["files"][os.path.relpath(best, sdir)] = {
+                "size": os.path.getsize(best), "crc32": ck._crc_file(best)}
+            with open(mpath, "w") as f:
+                _json.dump(man, f)
+
+        _rot(1)
+        _rot(3)  # every deep anchor now carries silent rot
+        out = m.restore(prefer_deep=True)
+        # both deep steps fall (reason=deep), the newest cheap step wins
+        assert m.last_restored_step == 4
+        assert not compare_digests(tree_digests(_state(4)),
+                                   tree_digests(out))
+        assert m.restore_fallbacks_total == 2
+        assert _series_total(fresh_registry,
+                             "ckpt_restore_fallbacks_total") == 2
+        m.close()
+
+    def test_runner_forwards_deep_every(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), use_async=False,
+                                max_to_keep=12)
+        run_resilient(_mlp_trainer(), _loader(), steps=4, manager=mgr,
+                      save_every=1, deep_every=2, handle_signals=False)
+        assert mgr.deep_every == 2
+        steps = sorted(mgr.all_steps() or [])
+        tiers = [bool(mgr._manifest_arrays(s)) for s in steps]
+        assert any(tiers) and not all(tiers)  # both tiers present
+        # resume restores through the tier-aware path
+        mgr2 = CheckpointManager(str(tmp_path), use_async=False,
+                                 max_to_keep=12)
+        res = run_resilient(_mlp_trainer(), _loader(), steps=6,
+                            manager=mgr2, save_every=1, deep_every=2,
+                            handle_signals=False)
+        assert res.exit_code == 0 and mgr2.last_restored_step is not None
+        mgr.close()
+        mgr2.close()
+
+
+# ---------------------------------------------------------------------------
+# crash consistency of aborted commits (in-process view)
+# ---------------------------------------------------------------------------
+
+class TestAbortedCommitDebris:
+    def _make_uncommitted(self, root, m, step):
+        """Forge the on-disk shape of a commit killed between payload
+        write and manifest: step dir present, no manifest, live marker."""
+        m.save(step, _state(step))
+        os.remove(os.path.join(root, str(step), ck.MANIFEST_NAME))
+        ck._write_pending_marker(root, step)
+
+    def test_uncommitted_step_invisible_no_fallback(self, tmp_path):
+        root = str(tmp_path)
+        m = CheckpointManager(root, use_async=False, max_to_keep=8)
+        for s in (1, 2):
+            m.save(s, _state(s))
+        self._make_uncommitted(root, m, 3)
+        assert m.latest_valid_step() == 2
+        m.restore()
+        assert m.last_restored_step == 2
+        assert m.restore_fallbacks_total == 0
+        m.close()
+
+    def test_legacy_manifestless_step_still_restorable(self, tmp_path):
+        """No marker + no manifest = a pre-manifest legacy step, NOT
+        debris: it must stay restorable (three-valued verify contract)."""
+        root = str(tmp_path)
+        m = CheckpointManager(root, use_async=False, max_to_keep=8)
+        m.save(1, _state(1))
+        os.remove(os.path.join(root, "1", ck.MANIFEST_NAME))
+        assert m.latest_valid_step() == 1
+        m.restore()
+        assert m.last_restored_step == 1
+        m.close()
+
+    def test_gc_reclaims_debris_and_stale_markers(self, tmp_path):
+        root = str(tmp_path)
+        m = CheckpointManager(root, use_async=False, max_to_keep=2)
+        for s in (1, 2):
+            m.save(s, _state(s))
+        self._make_uncommitted(root, m, 3)
+        # a marker whose step dir never materialized (crash before any
+        # byte landed)
+        ck._write_pending_marker(root, 9)
+        for s in (4, 5):
+            m.save(s, _state(s))
+        steps = sorted(m.all_steps() or [])
+        assert 3 not in steps  # debris collected despite being "newest-ish"
+        assert not os.path.exists(os.path.join(root, PENDING_PREFIX + "3"))
+        assert not os.path.exists(os.path.join(root, PENDING_PREFIX + "9"))
+        assert m.latest_valid_step() == 5
+        m.close()
+
+
+# ---------------------------------------------------------------------------
+# elastic integration: only committed steps cross the barrier
+# ---------------------------------------------------------------------------
+
+class TestElasticSeesCommittedOnly:
+    def test_coordinated_restore_flushes_inflight(self, tmp_path):
+        m = CheckpointManager(str(tmp_path / "ckpt"), async_commit=True,
+                              commit_delay=0.15)
+        st = {"w": np.arange(8, dtype=np.float32)}
+        m.save(3, {"w": st["w"]})
+        coord = FileCoordinator(str(tmp_path / "coord"), job_id="j",
+                                host="a", poll=0.01)
+        restored, common = coordinated_restore(
+            m, {"w": st["w"]}, coord, lambda: ["a"], timeout=30.0)
+        # without the flush the barrier would min-reduce None (-1):
+        # the in-flight commit must land before this host reports
+        assert common == 3
+        np.testing.assert_array_equal(np.asarray(restored["w"]), st["w"])
+        m.close()
+
+
+# ---------------------------------------------------------------------------
+# save-stall attribution (satellite: step_time must exclude save wall)
+# ---------------------------------------------------------------------------
+
+class TestStallAttribution:
+    def test_sync_save_feeds_stall_ledger(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), use_async=False)
+        before = ck.stall_seconds()
+        t0 = time.perf_counter()
+        m.save(1, _state())
+        wall = time.perf_counter() - t0
+        delta = ck.stall_seconds() - before
+        assert delta > 0 and delta <= wall * 1.5
+        m.close()
+
+    def test_async_save_stall_is_snapshot_only(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), async_commit=True,
+                              commit_delay=0.2)
+        before = ck.stall_seconds()
+        m.save(1, _state())
+        delta = ck.stall_seconds() - before
+        assert delta < 0.1  # the 0.2s commit never touched the ledger
+        m.flush()
+        assert ck.stall_seconds() - before - delta < 0.01
+        m.close()
+
+    def test_telemetry_callback_excludes_save_stall(self, fresh_registry):
+        from paddle_tpu.hapi.callbacks import TelemetryCallback
+        cb = TelemetryCallback()
+        cb.on_train_batch_begin(0)
+        with ck.attributing_stall():
+            time.sleep(0.2)        # "the save" inside the batch window
+        time.sleep(0.02)           # "the compute"
+        logs = {}
+        cb.on_train_batch_end(0, logs)
+        # the regression this guards: 0.2s of save wall must NOT appear
+        # as step_time (it used to, sinking MFU on checkpoint steps)
+        assert logs["step_time"] < 0.1
+        assert logs["ckpt_stall_ms"] >= 150.0
+        hist = fresh_registry.to_dict().get("step_time_seconds", {})
+        assert hist, "step_time histogram must still be recorded"
+
+    def test_stall_histogram_series_recorded(self, fresh_registry,
+                                             tmp_path):
+        ms = CheckpointManager(str(tmp_path / "s"), use_async=False)
+        ms.save(1, _state())
+        stall = fresh_registry.to_dict().get("ckpt_step_stall_ms", {})
+        assert sum(s["count"] for s in stall.get("series", {}).values()) >= 1
+        ma = CheckpointManager(str(tmp_path / "a"), async_commit=True)
+        ma.save(1, _state())
+        ma.flush()
+        reg = fresh_registry.to_dict()
+        assert "ckpt_snapshot_ms" in reg
+        assert "ckpt_commit_ms" in reg
+        assert reg["ckpt_inflight"]["series"][""] == 0  # drained
+        ms.close()
+        ma.close()
+
+    def test_suppressed_counter_reasons(self, fresh_registry, tmp_path):
+        dirty = {"v": False}
+        m = CheckpointManager(str(tmp_path), async_commit=True,
+                              dirty_probe=lambda: dirty["v"])
+        m.pause_commits()
+        m.save(1, _state(1))
+        m.save(2, _state(2))       # supersedes 1
+        dirty["v"] = True
+        m.resume_commits()
+        m.flush()                  # 2 suppressed as dirty
+        series = fresh_registry.to_dict().get(
+            "ckpt_suppressed_total", {}).get("series", {})
+        assert any("superseded" in k for k in series)
+        assert any("dirty" in k for k in series)
+        m.close()

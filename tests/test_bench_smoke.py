@@ -206,9 +206,11 @@ def test_chaos_host_hang_scenario():
 
 
 def test_fsck_ckpt_smoke():
-    """tools/fsck_ckpt.py --smoke: shallow fsck catches truncation,
-    deep fsck additionally catches a bit flip whose file CRC was
-    re-attested, and latest_valid_step points at the newest clean step."""
+    """tools/fsck_ckpt.py --smoke on a TIERED tree (deep_every=2):
+    shallow fsck catches the cheap-tier tamper without digests, deep
+    fsck additionally catches a bit flip whose file CRC was re-attested
+    on a deep step, tiers are labelled, and latest_valid_step falls back
+    to the newest clean cheap step."""
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "fsck_ckpt.py"),
          "--smoke"],
@@ -219,6 +221,57 @@ def test_fsck_ckpt_smoke():
     assert res["exit_code"] == 0, res
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert res["smoke"] is True
+    assert res["clean_tiers"] == {"1": "deep", "2": "cheap",
+                                  "3": "deep", "4": "cheap"}
+    assert res["shallow"]["4"] == "corrupt"   # cheap tamper, shallow catch
+    assert res["deep"]["3"] == "corrupt"      # deep-only catch
+    assert res["latest_valid_step_deep"] == 2  # cheap-tier fallback
+
+
+@pytest.mark.multihost(timeout=600)
+def test_chaos_crash_during_async_save_scenario():
+    """tools/chaos_smoke.py --scenario crash_during_async_save: the ISSUE
+    13 acceptance path — a child training with async_commit saves dies by
+    REAL SIGKILL (a) with a snapshot staged pre-commit and (b) mid-commit
+    between payload write and manifest; both times restore lands on the
+    previous committed step with ckpt_restore_fallbacks_total unchanged,
+    and a dirty in-flight snapshot is provably never committed."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_smoke.py"),
+         "--scenario", "crash_during_async_save", "--steps", "3"],
+        capture_output=True, text=True, timeout=560, env=_env())
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert lines, f"no stdout; stderr: {proc.stderr[-2000:]}"
+    res = json.loads(lines[-1])
+    assert res["exit_code"] == 0, res
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert res["killed"] == 2                  # both windows really died
+    assert res["restore_fallbacks"] == 0       # debris costs no fallback
+    assert res["restored_step_staged"] == 2
+    assert res["restored_step_mid_commit"] == 2
+    assert res["dirty_suppressed"] == 1
+    assert res["accounted"] is True
+
+
+def test_bench_ckpt_smoke():
+    """tools/bench_ckpt.py --smoke: the ISSUE 13 perf acceptance — async
+    ckpt_step_stall_ms p50 < 0.5x the synchronous save wall at the same
+    cadence, with bitwise-identical restored state and the new telemetry
+    series recorded."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_ckpt.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=560, env=_env())
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert lines, f"no stdout; stderr: {proc.stderr[-2000:]}"
+    res = json.loads(lines[-1])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert res["metric"] == "ckpt_async_stall_ratio"
+    assert res["value"] is not None and res["value"] < 0.5
+    extra = res["extra"]
+    assert extra["bitwise_identical"] is True
+    assert all(extra["telemetry_series"].values())
+    assert extra["accounting"]["accounted"] is True
 
 
 @pytest.mark.slow

@@ -40,6 +40,23 @@ collective); its hang watchdog fires, stops its heartbeats, and exits
 with code 10 — the survivors detect staleness and remesh exactly as for
 a machine loss.
 
+``--scenario crash_during_async_save`` is the crash-consistency proof
+for the async commit pipeline: a subprocess child trains with
+``async_commit=True`` saves, then dies by REAL SIGKILL in each crash
+window — (a) snapshot staged but commit not started, (b) mid-commit
+after the payload write but before the manifest. In both, a fresh
+manager must land ``latest_valid_step()``/restore on the previous
+committed step with ``ckpt_restore_fallbacks_total`` UNchanged (an
+aborted async commit is debris, not a fallback), and a subsequent save
+must reclaim the torn debris. A third in-process phase proves the
+dirty×in-flight rule: a quarantine verdict arriving while a tainted
+snapshot is staged suppresses its commit — the tainted step never
+appears on disk — and a later clean verdict re-enables saves::
+
+    {"scenario": "crash_during_async_save", "killed": 2,
+     "restored_step_staged": 3, "restored_step_mid_commit": 3,
+     "restore_fallbacks": 0, "dirty_suppressed": 1, ...}
+
 Run: ``python tools/chaos_smoke.py [--steps 10] [--ckpt-dir DIR]``
 (also wired as a ``-m 'not slow'`` pytest in tests/test_resilience.py;
 the host_loss/sdc/host_hang scenarios in tests/test_bench_smoke.py).
@@ -268,6 +285,125 @@ def run_host_hang(steps: int, root: str):
     }
 
 
+def _async_crash_child(ckpt_dir: str, mode: str, steps: int):
+    """Child half of crash_during_async_save: train with async saves,
+    flush so steps 0..steps-1 are durably committed, then stage one more
+    snapshot and die by SIGKILL in the requested window. Never returns."""
+    import signal
+    import time
+
+    import numpy as np
+
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+    from paddle_tpu.resilience import run_resilient
+
+    trainer, _ = build_trainer()
+    loader = make_loader()
+    manager = CheckpointManager(ckpt_dir, max_to_keep=steps + 2,
+                                async_commit=True, deep_every=2)
+    run_resilient(trainer, loader, steps, manager=manager, save_every=1)
+    manager.flush()
+    crash_step = int(manager.latest_valid_step()) + 1
+    state = {"trainer": trainer.state,
+             "meta": {"step": np.asarray(crash_step)}}
+    if mode == "staged":
+        # window (a): snapshot staged, commit never starts
+        manager.pause_commits()
+        manager.save(crash_step, state)
+        os.kill(os.getpid(), signal.SIGKILL)
+    # window (b): the committer SIGKILLs us after the payload write but
+    # before the manifest (env knob checked inside _commit_one)
+    os.environ["PADDLE_TPU_TEST_COMMIT_CRASH"] = str(crash_step)
+    manager.save(crash_step, state)
+    for _ in range(600):  # the committer kills us; never exit cleanly
+        time.sleep(0.1)
+    os._exit(97)  # pragma: no cover — the kill did not arrive
+
+
+def run_crash_during_async_save(steps: int, root: str):
+    """Parent half: run the child per crash window, then prove crash
+    consistency from the survivor's view (see module docstring)."""
+    import signal
+    import subprocess
+
+    import numpy as np
+
+    from paddle_tpu.distributed.checkpoint import (CheckpointManager,
+                                                   PENDING_PREFIX)
+
+    steps = max(2, min(steps, 4))  # keep the two child runs cheap
+    expected = steps - 1           # last step run_resilient committed
+    crash_step = expected + 1
+    out = {"scenario": "crash_during_async_save", "killed": 0,
+           "restore_fallbacks": 0}
+    ok = True
+    for mode in ("staged", "mid_commit"):
+        d = os.path.join(root, mode)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--steps", str(steps), "--ckpt-dir", d,
+             "--scenario", "crash_during_async_save",
+             "--async-crash-child", mode],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=480)
+        killed = proc.returncode == -signal.SIGKILL
+        out["killed"] += int(killed)
+        marker = os.path.exists(
+            os.path.join(d, PENDING_PREFIX + str(crash_step)))
+        m = CheckpointManager(d, max_to_keep=steps + 2, use_async=False)
+        lvs = m.latest_valid_step()
+        restored = m.restore()
+        out[f"restored_step_{mode}"] = m.last_restored_step
+        out["restore_fallbacks"] += m.restore_fallbacks_total
+        # no torn step becomes latest_valid, no committed step is lost,
+        # and skipping the aborted commit costs NO fallback
+        ok &= (killed and lvs == expected and restored is not None
+               and m.last_restored_step == expected
+               and m.restore_fallbacks_total == 0)
+        if mode == "staged":
+            # window (a) dies before any byte: no marker, no step dir
+            ok &= not marker and crash_step not in (m.all_steps() or [])
+        else:
+            # window (b) leaves the intent marker + a manifest-less dir
+            ok &= marker
+        m.close()
+        # recovery: replaying the crashed step reclaims the debris
+        m2 = CheckpointManager(d, max_to_keep=steps + 2, async_commit=True)
+        m2.save(crash_step, restored)
+        m2.flush()
+        ok &= (m2.latest_valid_step() == crash_step
+               and not os.path.exists(
+                   os.path.join(d, PENDING_PREFIX + str(crash_step))))
+        m2.close()
+
+    # phase (c): dirty verdict × in-flight snapshot, in-process
+    d = os.path.join(root, "dirty")
+    dirty = {"v": False}
+    m = CheckpointManager(d, max_to_keep=8, async_commit=True,
+                          dirty_probe=lambda: dirty["v"])
+    rng = np.random.RandomState(0)
+    clean = {"w": rng.randn(32, 8).astype(np.float32)}
+    m.save(1, clean)
+    m.flush()
+    m.pause_commits()
+    m.save(2, {"w": clean["w"] + 1e3})  # tainted snapshot, in flight
+    dirty["v"] = True                    # quarantine verdict lands NOW
+    m.resume_commits()
+    m.flush()
+    out["dirty_suppressed"] = m.suppressed_dirty_total
+    ok &= (m.suppressed_dirty_total == 1
+           and m.latest_valid_step() == 1
+           and 2 not in (m.all_steps() or []))  # provably never committed
+    dirty["v"] = False                   # later clean check re-enables
+    m.save(3, clean)
+    m.flush()
+    ok &= m.latest_valid_step() == 3 and m.accounted()
+    out["accounted"] = m.accounted()
+    m.close()
+    out["exit_code"] = 0 if ok else 1
+    return out
+
+
 def run_plain(steps: int, ckpt_dir: str):
     """Fault-free twin of run_chaos (same seed/data) for loss comparison."""
     from paddle_tpu.distributed.checkpoint import CheckpointManager
@@ -291,14 +427,28 @@ def main(argv=None) -> int:
     p.add_argument("--plain", action="store_true",
                    help="fault-free reference run instead of the chaos loop")
     p.add_argument("--scenario",
-                   choices=["faults", "host_loss", "sdc", "host_hang"],
+                   choices=["faults", "host_loss", "sdc", "host_hang",
+                            "crash_during_async_save"],
                    default="faults",
                    help="faults: the in-process chaos loop (default); "
                         "host_loss: the 3-subprocess elastic scenario; "
                         "sdc: silent-corruption detect/quarantine/rollback; "
-                        "host_hang: wedged host + hang watchdog")
+                        "host_hang: wedged host + hang watchdog; "
+                        "crash_during_async_save: SIGKILL in the async "
+                        "commit windows + dirty-suppression proof")
+    p.add_argument("--async-crash-child", default=None,
+                   choices=["staged", "mid_commit"],
+                   help=argparse.SUPPRESS)  # internal: the SIGKILL victim
     args = p.parse_args(argv)
     ckpt = args.ckpt_dir or tempfile.mkdtemp(prefix="chaos_ckpt_")
+    if args.async_crash_child:
+        _async_crash_child(ckpt, args.async_crash_child,
+                           max(2, min(args.steps, 4)))
+        return 96  # pragma: no cover — the child must die by SIGKILL
+    if args.scenario == "crash_during_async_save":
+        out = run_crash_during_async_save(args.steps, ckpt)
+        print(json.dumps(out))
+        return 0 if out["exit_code"] == 0 else 1
     if args.scenario == "host_loss":
         out = run_host_loss(max(args.steps, 24), ckpt)
     elif args.scenario == "sdc":

@@ -14,6 +14,14 @@ Shallow checks read bytes (size + CRC32); ``--deep`` additionally
 restores each step's payload host-side and re-hashes every array — the
 only level that catches rot which decodes cleanly into wrong values.
 
+Tier-aware: each step is labelled ``deep`` (manifest carries per-array
+content digests), ``cheap`` (file CRCs only — the frequent tier under
+``deep_every``), ``legacy`` (no manifest), or ``uncommitted`` (a live
+``PENDING.N`` intent marker with no manifest: an aborted async commit —
+debris, not corruption). ``--deep`` walks cheap-tier steps too (they
+verify at the shallow level), and ``by_tier`` summarises verdict counts
+per tier.
+
 Prints ONE line of JSON and exits 0 (all steps ok), 1 (any corrupt), or
 2 (usage/unreadable root)::
 
@@ -42,26 +50,53 @@ force_host_devices(8)
 
 def fsck(root: str, deep: bool = False) -> dict:
     """Check every step under ``root``; returns the summary dict."""
-    from paddle_tpu.distributed.checkpoint import CheckpointManager
+    from paddle_tpu.distributed.checkpoint import (MANIFEST_NAME,
+                                                   PENDING_PREFIX,
+                                                   CheckpointManager)
 
     if not os.path.isdir(root):
         return {"root": root, "error": "not a directory", "exit_code": 2}
     mgr = CheckpointManager(root, use_async=False, deep_digests=False)
     steps = sorted(mgr.all_steps() or [])
-    verdicts = {}
+    verdicts, tiers = {}, {}
     for s in steps:
+        # tier layout: a manifest with per-array digests is a DEEP save;
+        # without, a cheap one (file CRCs only); a live PENDING marker
+        # with no manifest is an aborted async commit (never restorable,
+        # never counted corrupt — it's debris awaiting GC)
+        sdir = os.path.join(root, str(s))
+        has_manifest = os.path.exists(os.path.join(sdir, MANIFEST_NAME))
+        marker = os.path.exists(os.path.join(root, PENDING_PREFIX + str(s)))
+        if marker and not has_manifest:
+            tiers[str(s)] = "uncommitted"
+            verdicts[str(s)] = "uncommitted"
+            continue
+        if not has_manifest:
+            tiers[str(s)] = "legacy"
+        elif mgr._manifest_arrays(s):
+            tiers[str(s)] = "deep"
+        else:
+            tiers[str(s)] = "cheap"
         v = mgr.verify(s, deep=deep)
         verdicts[str(s)] = ("ok" if v is True
                             else "corrupt" if v is False else "unattested")
     corrupt = sum(1 for v in verdicts.values() if v == "corrupt")
+    by_tier = {}
+    for s in steps:
+        t = tiers[str(s)]
+        by_tier.setdefault(t, {}).setdefault(verdicts[str(s)], 0)
+        by_tier[t][verdicts[str(s)]] += 1
     # newest step this run did NOT prove corrupt (at the checked depth —
     # the manager's own latest_valid_step() is shallow-only)
     latest_valid = next((s for s in reversed(steps)
-                         if verdicts[str(s)] != "corrupt"), None)
+                         if verdicts[str(s)] not in ("corrupt",
+                                                     "uncommitted")), None)
     out = {
         "root": os.path.abspath(root),
         "deep": deep,
         "steps": verdicts,
+        "tiers": tiers,
+        "by_tier": by_tier,
         "steps_checked": len(steps),
         "latest_valid_step": latest_valid,
         "corrupt": corrupt,
@@ -72,19 +107,21 @@ def fsck(root: str, deep: bool = False) -> dict:
 
 
 def _smoke() -> dict:
-    """Self-test: the checker must pass a clean tree, catch a deep-only
-    value corruption, and catch a truncation."""
+    """Self-test on a TIERED tree (``deep_every=2``: steps 1/3 deep,
+    2/4 cheap): the checker must pass the clean tree with the right tier
+    labels, catch a deep-only value corruption on a deep step, and catch
+    a cheap-tier tamper with the shallow layer alone (no digests)."""
     import numpy as np
 
     from paddle_tpu.distributed import checkpoint as ck
 
     root = tempfile.mkdtemp(prefix="fsck_smoke_")
-    mgr = ck.CheckpointManager(root, use_async=False, max_to_keep=5,
-                               deep_digests=True)
+    mgr = ck.CheckpointManager(root, use_async=False, max_to_keep=6,
+                               deep_every=2)
     rng = np.random.RandomState(0)
     state = {"w": rng.randn(64, 8).astype(np.float32),
              "b": rng.randn(8).astype(np.float32)}
-    for s in (1, 2, 3):
+    for s in (1, 2, 3, 4):
         mgr.save(s, state)
     mgr.close()
 
@@ -105,40 +142,49 @@ def _smoke() -> dict:
                     best, size = p, sz
         return best
 
-    # step 2: flip a payload byte, then re-attest the file CRC so the
-    # shallow layer passes — only --deep can catch it
-    p2 = _largest_payload(2)
-    with open(p2, "r+b") as f:
-        f.seek(os.path.getsize(p2) // 2)
-        b = f.read(1)
-        f.seek(os.path.getsize(p2) // 2)
-        f.write(bytes([b[0] ^ 0x01]))
-    sdir2 = os.path.join(root, "2")
-    mpath = os.path.join(sdir2, ck.MANIFEST_NAME)
-    with open(mpath) as f:
-        man = json.load(f)
-    rel = os.path.relpath(p2, sdir2)
-    man["files"][rel] = {"size": os.path.getsize(p2),
-                         "crc32": ck._crc_file(p2)}
-    with open(mpath, "w") as f:
-        json.dump(man, f)
-    # step 3: truncate — the shallow size check alone must catch it
+    # step 3 (deep tier): flip a payload byte, then re-attest the file
+    # CRC so the shallow layer passes — only --deep can catch it
     p3 = _largest_payload(3)
     with open(p3, "r+b") as f:
-        f.truncate(max(1, os.path.getsize(p3) // 2))
+        f.seek(os.path.getsize(p3) // 2)
+        b = f.read(1)
+        f.seek(os.path.getsize(p3) // 2)
+        f.write(bytes([b[0] ^ 0x01]))
+    sdir3 = os.path.join(root, "3")
+    mpath = os.path.join(sdir3, ck.MANIFEST_NAME)
+    with open(mpath) as f:
+        man = json.load(f)
+    rel = os.path.relpath(p3, sdir3)
+    man["files"][rel] = {"size": os.path.getsize(p3),
+                         "crc32": ck._crc_file(p3)}
+    with open(mpath, "w") as f:
+        json.dump(man, f)
+    # step 4 (cheap tier): flip a byte with NO re-attest — the shallow
+    # CRC alone must catch it, digests not required
+    p4 = _largest_payload(4)
+    with open(p4, "r+b") as f:
+        f.seek(os.path.getsize(p4) // 2)
+        b = f.read(1)
+        f.seek(os.path.getsize(p4) // 2)
+        f.write(bytes([b[0] ^ 0x01]))
 
     shallow = fsck(root)
     deep = fsck(root, deep=True)
     ok = (clean["exit_code"] == 0
           and all(v == "ok" for v in clean["steps"].values())
-          and shallow["steps"]["2"] == "ok"       # shallow is fooled
-          and shallow["steps"]["3"] == "corrupt"
+          and clean["tiers"] == {"1": "deep", "2": "cheap",
+                                 "3": "deep", "4": "cheap"}
+          and shallow["steps"]["3"] == "ok"       # shallow is fooled
+          and shallow["steps"]["4"] == "corrupt"  # cheap-tier tamper
           and deep["steps"]["1"] == "ok"
-          and deep["steps"]["2"] == "corrupt"     # deep is not
-          and deep["steps"]["3"] == "corrupt"
-          and deep["latest_valid_step"] == 1)
+          and deep["steps"]["2"] == "ok"          # cheap, still intact
+          and deep["steps"]["3"] == "corrupt"     # deep is not fooled
+          and deep["steps"]["4"] == "corrupt"
+          and deep["latest_valid_step"] == 2)     # a cheap-tier fallback
     return {"smoke": True, "clean": clean["steps"],
+            "clean_tiers": clean["tiers"],
             "shallow": shallow["steps"], "deep": deep["steps"],
+            "by_tier_deep": deep["by_tier"],
             "latest_valid_step_deep": deep["latest_valid_step"],
             "exit_code": 0 if ok else 1}
 
