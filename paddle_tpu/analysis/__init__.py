@@ -18,32 +18,42 @@ from __future__ import annotations
 
 from typing import Iterable, Optional
 
-from . import cost, report, rules, walker
+from . import cost, report, rules, sharding, walker
 from .report import CostRow, CostSummary, Finding, Report
 from .rules import (RULES, AnalysisConfig, RuleContext, register_rule,
                     run_rules)
+from .sharding import ReshardSite, ShardingInfo, propagate, resharding_table
 from .walker import count_eqns, walk
 
 __all__ = [
     "analyze", "analyze_jaxpr", "AnalysisConfig", "Report", "Finding",
     "CostRow", "CostSummary", "RULES", "register_rule", "run_rules",
-    "RuleContext", "walker", "rules", "cost", "report",
+    "RuleContext", "walker", "rules", "cost", "report", "sharding",
+    "ReshardSite", "ShardingInfo", "propagate", "resharding_table",
 ]
 
 
 def analyze_jaxpr(closed, mesh=None, donated=None,
                   config: Optional[AnalysisConfig] = None,
-                  rule_ids: Optional[Iterable[str]] = None) -> Report:
-    """Analyze an already-traced ClosedJaxpr."""
+                  rule_ids: Optional[Iterable[str]] = None,
+                  in_specs=None) -> Report:
+    """Analyze an already-traced ClosedJaxpr. ``in_specs`` (one
+    PartitionSpec/NamedSharding per flat invar) seeds the static
+    sharding-propagation pass (:mod:`.sharding`); without it the
+    sharding rules stay silent and the overlap model prices only
+    explicit collectives."""
     cfg = config or AnalysisConfig()
-    findings = run_rules(closed, mesh=mesh, donated=donated, config=cfg,
-                         rules=rule_ids)
+    ctx = RuleContext(closed, mesh=mesh, donated=donated, config=cfg,
+                      in_specs=in_specs)
+    findings = run_rules(closed, config=cfg, rules=rule_ids, ctx=ctx)
     summary = cost.summarize(closed, k=cfg.top_k,
                              while_trips=cfg.while_trips)
     if mesh is not None:
         try:
+            info = ctx.sharding()
             summary.overlap = cost.overlap_summary(
-                closed, mesh, while_trips=cfg.while_trips)
+                closed, mesh, while_trips=cfg.while_trips,
+                reshard_sites=info.sites if info is not None else None)
         except Exception:
             pass  # the overlap model must never sink an analysis run
     return Report(
@@ -54,7 +64,8 @@ def analyze_jaxpr(closed, mesh=None, donated=None,
 
 def analyze(target, *args, mesh=None, donated=None,
             config: Optional[AnalysisConfig] = None,
-            rule_ids: Optional[Iterable[str]] = None, **kwargs) -> Report:
+            rule_ids: Optional[Iterable[str]] = None,
+            in_specs=None, **kwargs) -> Report:
     """Analyze a ClosedJaxpr, or trace ``target(*args, **kwargs)`` and
     analyze the result. Tracing uses abstract values only — pass
     ``jax.ShapeDtypeStruct`` args to analyze huge programs without
@@ -64,4 +75,4 @@ def analyze(target, *args, mesh=None, donated=None,
         import jax
         closed = jax.make_jaxpr(target)(*args, **kwargs)
     return analyze_jaxpr(closed, mesh=mesh, donated=donated, config=config,
-                         rule_ids=rule_ids)
+                         rule_ids=rule_ids, in_specs=in_specs)

@@ -275,7 +275,8 @@ def _atomic_flops(eqn, while_trips: float) -> float:
 
 
 def overlap_summary(jaxpr, mesh, peak_flops=None, while_trips: float = 1.0,
-                    include_timeline: bool = False) -> dict:
+                    include_timeline: bool = False,
+                    reshard_sites=None) -> dict:
     """Two-stream schedule simulation of the staged program: a single
     compute stream runs equations at ``peak_flops`` while each collective
     runs asynchronously on its link's wire stream (one in flight per link
@@ -291,11 +292,20 @@ def overlap_summary(jaxpr, mesh, peak_flops=None, while_trips: float = 1.0,
     mid-backward lands under the remaining buckets' backward compute
     instead of serializing after it.
 
+    ``reshard_sites`` — predicted IMPLICIT collectives from the sharding
+    pass (analysis/sharding.propagate): each site is charged on its
+    link's wire stream right before the equation that forces it, and
+    that equation's compute waits for it to land — hidden resharding is
+    priced exactly like an explicit collective. Sites inside atomic
+    control flow attach to the enclosing scan/while/cond node via their
+    anchor chain.
+
     Returns a dict: ``compute_time``, ``collective_time``,
     ``stalled_time`` (compute idle waiting on collectives, incl. the
     tail wait after the last compute), ``overlap_efficiency`` =
     (collective time - stalls) / collective time clamped to [0, 1]
     (None when the program has no collectives), ``n_collectives``,
+    ``n_reshard`` / ``reshard_time`` (the implicit-resharding share),
     ``makespan``; with ``include_timeline`` also ``timeline``: per-node
     start/end entries sorted by start time (zero-cost bookkeeping nodes
     omitted). Estimates rank schedules — they are a model, not a
@@ -332,6 +342,25 @@ def overlap_summary(jaxpr, mesh, peak_flops=None, while_trips: float = 1.0,
                  else eqn_flops(eqn)) * node.trips
             plans.append((False, f / peak_flops, None, f, ()))
 
+    # Attach predicted implicit-resharding sites (analysis/sharding) to
+    # the node they fire at: innermost anchor first, falling back to the
+    # enclosing atomic control-flow equation's node.
+    pending = {}
+    if reshard_sites:
+        node_pos = {}
+        for j, node in enumerate(nodes):
+            node_pos.setdefault((node.path, node.index), j)
+        for s in reshard_sites:
+            anchors = list(getattr(s, "anchors", ()) or ())
+            anchors.reverse()
+            anchors.append((getattr(s, "path", ()),
+                            getattr(s, "eqn_index", -1)))
+            for key in anchors:
+                j = node_pos.get(tuple(key))
+                if j is not None:
+                    pending.setdefault(j, []).append(s)
+                    break
+
     # Dataflow edges over canonical var ids (linear_schedule already
     # resolved call-boundary aliases).
     producer = {}
@@ -353,12 +382,36 @@ def overlap_summary(jaxpr, mesh, peak_flops=None, while_trips: float = 1.0,
     wire_free = {}                # link class -> busy-until
     t = 0.0                       # compute-stream cursor
     coll_total = compute_total = 0.0
-    n_coll = 0
+    n_coll = n_reshard = 0
+    reshard_total = 0.0
     timeline = [] if include_timeline else None
     while heap:
         rt, j = heapq.heappop(heap)
         node = nodes[j]
         is_coll, dur, link, amount, axes = plans[j]
+        # implicit resharding this node forces: charged on the wire
+        # stream, and the node itself waits for the result to land
+        for s in pending.get(j, ()):
+            r_dur = float(getattr(s, "time_s", 0.0)) \
+                * max(float(getattr(s, "trips", 1.0)), 1.0)
+            r_link = getattr(s, "link", "ici")
+            r_start = max(rt, wire_free.get(r_link, 0.0))
+            r_done = r_start + r_dur
+            wire_free[r_link] = r_done
+            coll_total += r_dur
+            reshard_total += r_dur
+            n_coll += 1
+            n_reshard += 1
+            rt = max(rt, r_done)
+            if timeline is not None:
+                timeline.append({
+                    "kind": "reshard", "primitive": node.primitive,
+                    "path": "/".join(node.path) or "<top>",
+                    "eqn_index": node.index,
+                    "axes": list(getattr(s, "axes", ())), "link": r_link,
+                    "bytes": float(getattr(s, "wire_bytes", 0.0)),
+                    "start": r_start, "end": r_done,
+                    "reshard_kind": getattr(s, "kind", "")})
         if is_coll:
             start = max(rt, wire_free.get(link, 0.0))
             done = start + dur
@@ -403,6 +456,8 @@ def overlap_summary(jaxpr, mesh, peak_flops=None, while_trips: float = 1.0,
         "stalled_time": stall,
         "overlap_efficiency": eff,
         "n_collectives": n_coll,
+        "n_reshard": n_reshard,
+        "reshard_time": reshard_total,
         "makespan": end,
         "peak_flops": peak_flops,
     }

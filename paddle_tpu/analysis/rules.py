@@ -55,6 +55,16 @@ class AnalysisConfig:
     # many FLOPs (filters out the scalar bookkeeping that trails every
     # program and would hide a genuinely serialized exchange)
     overlap_min_flops: float = 1e5
+    # implicit-resharding: sites below this payload are scalar noise
+    # (loss all-reduces, guard flags) and stay silent
+    reshard_min_bytes: float = 4096.0
+    # implicit-resharding escalates warning -> error when the collective
+    # crosses a DCN axis at/above this payload
+    dcn_reshard_error_bytes: float = 64 << 20
+    # replicated-large-param: a replicated invar this big, with a
+    # shardable mesh axis available, should be ZeRO-sharded
+    replicated_param_min_bytes: float = 8 << 20
+    shardable_axes: tuple = ("sharding",)
     disabled_rules: frozenset = frozenset()
 
 
@@ -67,28 +77,66 @@ class RuleContext:
     donated — flat indices of donated top-level invars, or None when the
               caller has no donation info (then the top-level pjit
               equations' own ``donated_invars`` params are consulted).
+    in_specs — one PartitionSpec/NamedSharding per flat top-level invar
+              (the staged step's real layouts), or None: the seed for
+              the sharding-propagation pass (:meth:`sharding`).
     """
 
     def __init__(self, closed, mesh=None, donated=None,
-                 config: Optional[AnalysisConfig] = None):
+                 config: Optional[AnalysisConfig] = None, in_specs=None):
         self.closed = closed
         self.raw, self.consts = unwrap(closed)
         self.mesh = mesh
         self.donated = frozenset(donated) if donated is not None else None
         self.config = config or AnalysisConfig()
+        self.in_specs = list(in_specs) if in_specs is not None else None
+        self._sharding = False  # not-yet-computed sentinel
         # bound_axes starts empty on purpose: only shard_maps inside the
         # program bind axes; the mesh is checked by the membership rule.
         self.sites: List[EqnSite] = list(walk(closed))
 
-    def finding(self, site: Optional[EqnSite], message: str) -> Finding:
-        """A Finding pinned to a site (rule/severity filled by runner)."""
+    def sharding(self):
+        """The sharding-propagation result (analysis/sharding) for this
+        program, computed lazily on first rule access; None when no mesh
+        or no in_specs were provided (nothing to seed from) or the pass
+        failed."""
+        if self._sharding is False:
+            self._sharding = None
+            if self.mesh is not None and self.in_specs is not None:
+                try:
+                    from .sharding import propagate
+                    self._sharding = propagate(
+                        self.closed, self.mesh, self.in_specs,
+                        while_trips=self.config.while_trips)
+                except Exception:
+                    self._sharding = None
+        return self._sharding
+
+    def finding(self, site: Optional[EqnSite], message: str,
+                severity: str = "") -> Finding:
+        """A Finding pinned to a site. Rule id is stamped by the runner;
+        severity too, unless the rule overrides it here (e.g. a warning
+        rule escalating one specific finding to error)."""
         if site is None:
-            return Finding(rule="", severity="info", message=message)
+            return Finding(rule="", severity=severity, message=message)
         return Finding(
-            rule="", severity="info", message=message,
+            rule="", severity=severity, message=message,
             primitive=site.primitive,
             path="/".join(site.path) or "<top>", eqn_index=site.index,
             source=source_summary(site.eqn))
+
+    def finding_at(self, message: str, *, primitive: str = "",
+                   path=(), eqn_index: int = -1,
+                   source: Optional[str] = None,
+                   severity: str = "") -> Finding:
+        """A Finding pinned by raw coordinates (for rules working from
+        derived site lists rather than EqnSites)."""
+        if not isinstance(path, str):
+            path = "/".join(path)
+        return Finding(
+            rule="", severity=severity, message=message,
+            primitive=primitive, path=path or "<top>",
+            eqn_index=eqn_index, source=source)
 
 
 @dataclass(frozen=True)
@@ -119,10 +167,16 @@ def register_rule(rule_id: str, severity: str):
 
 def run_rules(closed, mesh=None, donated=None,
               config: Optional[AnalysisConfig] = None,
-              rules: Optional[Iterable[str]] = None) -> List[Finding]:
-    """Run (a subset of) the registry over one ClosedJaxpr."""
+              rules: Optional[Iterable[str]] = None,
+              in_specs=None, ctx: Optional[RuleContext] = None
+              ) -> List[Finding]:
+    """Run (a subset of) the registry over one ClosedJaxpr. A finding
+    whose rule set an explicit valid severity keeps it (escalation);
+    otherwise the rule's registered severity is stamped."""
     cfg = config or AnalysisConfig()
-    ctx = RuleContext(closed, mesh=mesh, donated=donated, config=cfg)
+    if ctx is None:
+        ctx = RuleContext(closed, mesh=mesh, donated=donated, config=cfg,
+                          in_specs=in_specs)
     out: List[Finding] = []
     selected = RULES.keys() if rules is None else rules
     for rid in selected:
@@ -130,7 +184,8 @@ def run_rules(closed, mesh=None, donated=None,
         if rid in cfg.disabled_rules:
             continue
         for f in rule.fn(ctx):
-            out.append(replace(f, rule=rule.id, severity=rule.severity))
+            sev = f.severity if f.severity in SEVERITIES else rule.severity
+            out.append(replace(f, rule=rule.id, severity=sev))
     return out
 
 
@@ -494,11 +549,23 @@ def oversized_allgather(ctx):
     for site in ctx.sites:
         if site.primitive != "all_gather":
             continue
-        out_b = sum(_aval_nbytes(v) for v in site.eqn.outvars)
+        in_b = sum(_aval_nbytes(v) for v in site.eqn.invars)
+        # gathered size = participants x per-shard operand bytes; the
+        # traced outvar aval under shard_map is per-shard, so sizing from
+        # it under-fires by exactly the mesh factor on large meshes
+        n = site.eqn.params.get("axis_size")
+        if not isinstance(n, int) or n < 1:
+            n = 1
+            if ctx.mesh is not None:
+                for ax in collective_axes(site.eqn):
+                    n *= int(ctx.mesh.shape.get(ax, 1))
+        out_b = max(in_b * max(n, 1),
+                    sum(_aval_nbytes(v) for v in site.eqn.outvars))
         if out_b >= thresh:
             yield ctx.finding(
-                site, f"all_gather materializes {_human_bytes(out_b)} on "
-                      "every device (threshold "
+                site, f"all_gather materializes {_human_bytes(out_b)} "
+                      f"({max(n, 1)}x {_human_bytes(in_b)}) on every "
+                      "device (threshold "
                       f"{_human_bytes(thresh)}); consider keeping the "
                       "tensor sharded (psum_scatter / rechunk the "
                       "computation)")
@@ -606,3 +673,140 @@ def exchange_not_overlapped(ctx):
         "between them: the exchange is serialized after the backward "
         "instead of overlapping it (check the per-bucket custom_vjp "
         "hooks and that the buckets did not collapse to one)")
+
+
+# ---------------------------------------------------------------------------
+# sharding-propagation rules (need mesh + in_specs; silent otherwise)
+# ---------------------------------------------------------------------------
+
+def _site_key(s) -> tuple:
+    """Dedup key collapsing custom_vjp fwd/bwd clones of one layout
+    conflict (remat / partial_eval re-trace the same equation under a
+    different path, but primitive, axes, payload and source line
+    coincide) — the same strategy pallas-config-untuned uses."""
+    return (s.kind, s.primitive, s.axes, round(s.bytes), s.source)
+
+
+@register_rule("implicit-resharding", "warning")
+def implicit_resharding(ctx):
+    """A layout conflict the SPMD partitioner resolves with a silent
+    collective (all-gather / all-to-all / all-reduce) that appears in no
+    source line. Escalates to error when the collective crosses a DCN
+    axis at/above ``dcn_reshard_error_bytes`` — cross-slice implicit
+    traffic there dwarfs the compressed-exchange wins."""
+    info = ctx.sharding()
+    if info is None:
+        return
+    cfg = ctx.config
+    seen = set()
+    for s in info.sites:
+        if s.bytes < cfg.reshard_min_bytes:
+            continue
+        if s.in_loop and s.trips > 1:
+            continue   # resharding-in-scan-body owns these
+        key = _site_key(s)
+        if key in seen:
+            continue
+        seen.add(key)
+        sev = ("error" if s.link == "dcn"
+               and s.bytes >= cfg.dcn_reshard_error_bytes else "")
+        loop = (f", x{s.trips:g} loop iterations" if s.in_loop
+                and s.trips > 1 else "")
+        yield ctx.finding_at(
+            f"implicit {s.kind} over axes {list(s.axes)} "
+            f"({_human_bytes(s.bytes)} payload, "
+            f"{s.time_s * 1e6:.0f}us modeled on {s.link}{loop}): "
+            f"{s.detail or 'operand layouts conflict'} — add a "
+            "with_sharding_constraint or re-layout the producer so the "
+            "partitioner need not reshard",
+            primitive=s.primitive, path=s.path, eqn_index=s.eqn_index,
+            source=s.source, severity=sev)
+
+
+@register_rule("replicated-large-param", "warning")
+def replicated_large_param(ctx):
+    """A large donated input (params/optimizer state) enters fully
+    replicated while a shardable mesh axis sits idle: every device holds
+    the full tensor when ZeRO-style sharding along that axis would cut
+    memory by the axis size."""
+    if ctx.mesh is None or ctx.in_specs is None:
+        return
+    cfg = ctx.config
+    sizes = {str(k): int(v) for k, v in dict(ctx.mesh.shape).items()}
+    idle = [ax for ax in cfg.shardable_axes if sizes.get(ax, 1) > 1]
+    if not idle:
+        return
+    from .sharding import from_pspec
+    for i, v in enumerate(ctx.raw.invars):
+        if i >= len(ctx.in_specs):
+            break
+        if ctx.donated is not None and i not in ctx.donated:
+            continue
+        nbytes = _aval_nbytes(v)
+        if nbytes < cfg.replicated_param_min_bytes:
+            continue
+        aval = getattr(v, "aval", None)
+        ndim = len(getattr(aval, "shape", ()))
+        if ndim == 0:
+            continue
+        if from_pspec(ctx.in_specs[i], ndim, sizes).replicated:
+            yield ctx.finding_at(
+                f"invar {i} ({_human_bytes(nbytes)}, "
+                f"{getattr(aval, 'str_short', lambda: '?')()}) is fully "
+                f"replicated while mesh axis {idle[0]!r} "
+                f"(size {sizes[idle[0]]}) is shardable: ZeRO-shard it "
+                f"to cut per-device memory {sizes[idle[0]]}x",
+                primitive="<invar>", path="<top>", eqn_index=-1)
+
+
+@register_rule("sharding-constraint-dropped", "warning")
+def sharding_constraint_dropped(ctx):
+    """An explicit with_sharding_constraint layout erased before its
+    consumer (a reshape/transpose/slice that cannot carry the axes): the
+    constraint the author wrote is not the layout the partitioner uses,
+    and the reshard it was meant to prevent happens anyway."""
+    info = ctx.sharding()
+    if info is None:
+        return
+    seen = set()
+    for s in info.dropped_constraints:
+        key = _site_key(s)
+        if key in seen:
+            continue
+        seen.add(key)
+        yield ctx.finding_at(
+            f"sharding_constraint layout dropped at {s.primitive} "
+            f"(axes {list(s.axes)}, {_human_bytes(s.bytes)}): "
+            f"{s.detail or 'the op cannot carry the constrained axes'} "
+            "— move the constraint after this op or constrain the "
+            "consumer instead",
+            primitive=s.primitive, path=s.path, eqn_index=s.eqn_index,
+            source=s.source)
+
+
+@register_rule("resharding-in-scan-body", "warning")
+def resharding_in_scan_body(ctx):
+    """An implicit reshard inside a scan/while body: the collective
+    fires every iteration, multiplying its cost by the trip count. Hoist
+    the layout change out of the loop or align the carry spec."""
+    info = ctx.sharding()
+    if info is None:
+        return
+    cfg = ctx.config
+    seen = set()
+    for s in info.sites:
+        if not s.in_loop or s.trips <= 1 or s.bytes < cfg.reshard_min_bytes:
+            continue
+        key = _site_key(s)
+        if key in seen:
+            continue
+        seen.add(key)
+        yield ctx.finding_at(
+            f"implicit {s.kind} over axes {list(s.axes)} inside a loop "
+            f"body fires ~{s.trips:g}x per step "
+            f"({_human_bytes(s.bytes)} payload each, "
+            f"{s.time_s * s.trips * 1e6:.0f}us modeled total on "
+            f"{s.link}): hoist the reshard out of the loop or make the "
+            "carry layout match",
+            primitive=s.primitive, path=s.path, eqn_index=s.eqn_index,
+            source=s.source)

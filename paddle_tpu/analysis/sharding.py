@@ -1,0 +1,869 @@
+"""Static sharding propagation: predict implicit resharding collectives.
+
+The SPMD partitioner silently inserts all-gathers / all-to-alls /
+all-reduces wherever operand NamedSharding layouts conflict or an output
+layout is unreachable from its operands. The analysis layer was blind to
+them: the cost model priced only the EXPLICIT collectives inside
+shard_map regions. This pass closes that gap from the jaxpr alone,
+before any compile: seed the top-level invars with the program's real
+``PartitionSpec``s, run per-primitive transfer rules over the canonical
+walker's traversal (elementwise / dot_general / reshape / transpose /
+reduce / scan / while / cond / pjit / shard_map / sharding_constraint),
+and at every equation where specs disagree record a :class:`ReshardSite`
+with the collective kind, payload bytes, ring-model wire bytes and
+modeled time over ``mesh.axis_links`` (ici vs dcn).
+
+Spec domain (:class:`ASpec`): per-dimension tuples of mesh axis names
+(empty = replicated on that dim) plus a ``partial`` axis set — the
+GSPMD "partial-sum pending all-reduce" state a sharded contraction
+produces. Mesh axes of size 1 are dropped at entry, so a single-device
+mesh trivially propagates to zero sites.
+
+Collective kinds:
+- ``all-gather``  — sharded axes dropped (sharded -> replicated);
+- ``all-to-all``  — an axis moved between dimensions;
+- ``all-reduce``  — a partial-sum resolved to full values (XLA may
+  lower it as reduce-scatter when the target is sharded; either way it
+  is one collective op in the compiled HLO, which is what
+  :meth:`ShardingInfo.predicted_collectives` counts).
+
+Replicated -> sharded is a local slice and free.
+
+Consumers: the four ``implicit-resharding`` rule family members in
+:mod:`.rules`, ``cost.overlap_summary(reshard_sites=...)`` (the PR 8
+list scheduler prices hidden resharding on the wire streams), the
+``tools/lint_program.py --dump-sharding`` table, and
+:func:`resharding_table` — the planner-ready API
+``distributed/auto.py`` scores candidate layouts with.
+
+This is a MODEL of the partitioner, not the partitioner: transfer rules
+follow GSPMD's cheapest-legal-choice conventions (slice the replicated
+operand of a half-sharded contraction instead of gathering the sharded
+one; carry partial sums through linear ops) and are validated against
+actually-compiled SPMD HLO collective counts in
+tests/test_sharding_analysis.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from .cost import aval_bytes
+from .walker import source_summary, subjaxprs, unwrap
+
+__all__ = [
+    "ASpec", "ReshardSite", "ShardingInfo", "propagate",
+    "resharding_table", "spec_str",
+]
+
+# ring-algorithm wire multiple per participating rank (see cost._COLL_RING)
+_RING = {"all-gather": 1.0, "all-to-all": 1.0, "all-reduce": 2.0}
+
+_FALLBACK_BW = {"ici": 9.0e10, "dcn": 6.25e9}
+
+# partial sums survive these unary ops unchanged (linear, shape-only, or
+# uniform rescale); add/sub carry only when every operand agrees (below)
+_PARTIAL_SAFE = frozenset({
+    "mul", "div", "neg", "convert_element_type", "reduce_precision",
+    "copy", "stop_gradient", "transpose", "reshape", "broadcast_in_dim",
+    "squeeze", "expand_dims", "reduce_sum", "slice", "gather",
+    "dynamic_slice", "concatenate", "pad", "rev",
+})
+
+_REDUCE_PRIMS = frozenset({
+    "reduce_sum", "reduce_prod", "reduce_max", "reduce_min",
+    "reduce_and", "reduce_or",
+})
+
+# data movers whose output keeps an input dim's axes only where the dim
+# size is unchanged (a partial slice of a sharded dim reshards; modeled
+# as a silent drop — usually a cheap halo, not a full collective)
+_SIZE_GATED = frozenset({"slice", "dynamic_slice", "rev", "pad"})
+
+_OPAQUE = frozenset({
+    "gather", "scatter", "scatter-add", "scatter_add", "scatter_mul",
+    "scatter_min", "scatter_max", "sort", "top_k", "iota",
+    "rng_bit_generator", "random_seed", "random_bits", "random_wrap",
+    "random_unwrap", "pallas_call", "threefry2x32",
+})
+
+
+@dataclass(frozen=True)
+class ASpec:
+    """Array sharding: per-dim mesh-axis tuples + partial-sum axes."""
+    dims: Tuple[Tuple[str, ...], ...] = ()
+    partial: frozenset = frozenset()
+    constrained: bool = False  # produced by an explicit sharding_constraint
+
+    @property
+    def replicated(self) -> bool:
+        return not self.partial and all(not d for d in self.dims)
+
+    def axis_map(self) -> Dict[str, int]:
+        return {ax: d for d, axes in enumerate(self.dims) for ax in axes}
+
+
+def _repl(ndim: int) -> ASpec:
+    return ASpec(((),) * ndim)
+
+
+def spec_str(a: ASpec) -> str:
+    parts = []
+    for axes in a.dims:
+        if not axes:
+            parts.append("None")
+        elif len(axes) == 1:
+            parts.append(repr(axes[0]))
+        else:
+            parts.append("(" + ",".join(repr(x) for x in axes) + ")")
+    s = "P(" + ", ".join(parts) + ")"
+    if a.partial:
+        s += "+sum{" + ",".join(sorted(a.partial)) + "}"
+    return s
+
+
+def _rank(v) -> int:
+    return len(getattr(getattr(v, "aval", None), "shape", ()) or ())
+
+
+def from_pspec(spec, ndim: int, sizes: Dict[str, int]) -> ASpec:
+    """Normalize a PartitionSpec / NamedSharding / ASpec / None to an
+    ASpec of the given rank, dropping mesh axes of size <= 1."""
+    if isinstance(spec, ASpec):
+        dims = tuple(spec.dims[:ndim]) + ((),) * max(0, ndim - len(spec.dims))
+        return ASpec(dims, spec.partial, spec.constrained)
+    if spec is not None and hasattr(spec, "spec"):   # NamedSharding
+        spec = spec.spec
+    dims: List[Tuple[str, ...]] = []
+    entries = tuple(spec) if spec is not None else ()
+    seen = set()
+    for d in range(ndim):
+        e = entries[d] if d < len(entries) else None
+        if e is None:
+            dims.append(())
+            continue
+        if isinstance(e, str):
+            e = (e,)
+        try:
+            axes = tuple(ax for ax in e
+                         if isinstance(ax, str) and sizes.get(ax, 1) > 1
+                         and ax not in seen)
+        except TypeError:      # UNCONSTRAINED and friends
+            axes = ()
+        seen.update(axes)
+        dims.append(axes)
+    return ASpec(tuple(dims))
+
+
+@dataclass(frozen=True)
+class ReshardSite:
+    """One predicted implicit collective the partitioner will insert."""
+    kind: str                    # "all-gather" | "all-to-all" | "all-reduce"
+    axes: Tuple[str, ...]        # mesh axes crossed
+    bytes: float                 # global payload bytes
+    wire_bytes: float            # ring-model per-rank wire bytes
+    time_s: float                # wire_bytes / link bandwidth, one firing
+    link: str                    # "ici" | "dcn"
+    trips: float                 # enclosing static trip-count product
+    path: Tuple[str, ...]
+    eqn_index: int
+    primitive: str
+    operand: int                 # resharded invar index; -1 = the output
+    detail: str
+    source: Optional[str]
+    in_loop: bool
+    from_constraint: bool        # the dropped spec came from an explicit
+                                 # sharding_constraint
+    anchors: Tuple = ()          # ((path, index), ...) outer->inner eqn
+                                 # chain, for overlap-model attachment
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "axes": list(self.axes),
+                "bytes": self.bytes, "wire_bytes": self.wire_bytes,
+                "time_s": self.time_s, "link": self.link,
+                "trips": self.trips, "path": "/".join(self.path) or "<top>",
+                "eqn_index": self.eqn_index, "primitive": self.primitive,
+                "operand": self.operand, "detail": self.detail,
+                "source": self.source, "in_loop": self.in_loop,
+                "from_constraint": self.from_constraint}
+
+
+@dataclass
+class ShardingInfo:
+    """Result of one propagation run."""
+    sites: List[ReshardSite]
+    out_specs: List[ASpec]
+    table: List[dict]
+    dropped_constraints: List[ReshardSite]
+
+    def predicted_collectives(self) -> int:
+        """Number of implicit collective OPS the compiled HLO text will
+        contain (loop-body sites count once — HLO has one op per site)."""
+        return len(self.sites)
+
+    def total_time(self) -> float:
+        """Modeled wall seconds of all predicted resharding, per step."""
+        return sum(s.time_s * s.trips for s in self.sites)
+
+    def total_wire_bytes(self) -> float:
+        return sum(s.wire_bytes * s.trips for s in self.sites)
+
+    def to_dict(self) -> dict:
+        return {"n_sites": len(self.sites),
+                "total_time_s": self.total_time(),
+                "total_wire_bytes": self.total_wire_bytes(),
+                "sites": [s.to_dict() for s in self.sites],
+                "table": self.table}
+
+
+class _SiteCtx:
+    """Where-am-I context threaded through the recursion."""
+    __slots__ = ("path", "index", "eqn", "trips", "in_loop", "stack")
+
+    def __init__(self, path, index, eqn, trips, in_loop, stack):
+        self.path, self.index, self.eqn = path, index, eqn
+        self.trips, self.in_loop, self.stack = trips, in_loop, stack
+
+
+class _Propagator:
+    def __init__(self, mesh, while_trips: float, collect_table: bool):
+        self.mesh = mesh
+        shape = dict(getattr(mesh, "shape", {}) or {})
+        self.sizes = {ax: int(n) for ax, n in shape.items() if int(n) > 1}
+        try:
+            from ..distributed.mesh import axis_links, link_bandwidth
+            self.links = axis_links(mesh) if mesh is not None else {}
+            self._bw = link_bandwidth
+        except Exception:
+            self.links = {}
+            self._bw = lambda link: _FALLBACK_BW.get(link, _FALLBACK_BW["ici"])
+        self.while_trips = max(float(while_trips), 1.0)
+        self.collect_table = collect_table
+        self.sites: List[ReshardSite] = []
+        self.table: List[dict] = []
+        self.dropped_constraints: List[ReshardSite] = []
+
+    # -- site plumbing ------------------------------------------------------
+
+    def _group(self, axes) -> int:
+        n = 1
+        for ax in axes:
+            n *= self.sizes.get(ax, 1)
+        return n
+
+    def _site(self, kind, axes, payload, sctx: _SiteCtx, operand, detail,
+              record, from_constraint=False):
+        axes = tuple(sorted(set(axes)))
+        n = self._group(axes)
+        if not axes or n <= 1 or not record:
+            return
+        link = "dcn" if any(self.links.get(ax) == "dcn" for ax in axes) \
+            else "ici"
+        wire = _RING[kind] * (n - 1) / n * float(payload)
+        site = ReshardSite(
+            kind=kind, axes=axes, bytes=float(payload), wire_bytes=wire,
+            time_s=wire / max(self._bw(link), 1.0), link=link,
+            trips=sctx.trips, path=sctx.path, eqn_index=sctx.index,
+            primitive=sctx.eqn.primitive.name if sctx.eqn is not None
+            else "", operand=operand, detail=detail,
+            source=source_summary(sctx.eqn) if sctx.eqn is not None
+            else None, in_loop=sctx.in_loop,
+            from_constraint=from_constraint,
+            anchors=sctx.stack + ((sctx.path, sctx.index),))
+        self.sites.append(site)
+        if from_constraint:
+            self.dropped_constraints.append(site)
+
+    def _classify(self, src: ASpec, dst_dims, aval, sctx, operand, detail,
+                  record):
+        """Emit sites for resharding ``src`` to ``dst_dims`` and return
+        the achieved spec (= dst for the moved/dropped axes; gaining axes
+        is a free local slice)."""
+        src_map = src.axis_map()
+        dst_map = {ax: d for d, axes in enumerate(dst_dims) for ax in axes}
+        moved = [ax for ax, d in src_map.items()
+                 if ax in dst_map and dst_map[ax] != d]
+        dropped = [ax for ax, d in src_map.items() if ax not in dst_map]
+        payload = aval_bytes(aval)
+        if moved:
+            self._site("all-to-all", moved, payload, sctx, operand,
+                       detail + f" (axis moved between dims: {moved})",
+                       record)
+        if dropped:
+            self._site("all-gather", dropped, payload, sctx, operand,
+                       detail + f" (sharded axes dropped: {dropped})",
+                       record, from_constraint=src.constrained)
+        return ASpec(tuple(tuple(a) for a in dst_dims))
+
+    def _resolve_partial(self, a: ASpec, aval, sctx, operand, detail,
+                         record) -> ASpec:
+        if not a.partial:
+            return a
+        self._site("all-reduce", tuple(a.partial), aval_bytes(aval), sctx,
+                   operand, detail + " (partial sum materialized)", record)
+        return ASpec(a.dims, frozenset(), a.constrained)
+
+    # -- scope traversal ----------------------------------------------------
+
+    def run(self, raw, consts, in_specs, out_specs):
+        env: Dict[int, ASpec] = {}
+        in_specs = list(in_specs or ())
+        for i, v in enumerate(raw.invars):
+            spec = in_specs[i] if i < len(in_specs) else None
+            env[id(v)] = from_pspec(spec, _rank(v), self.sizes)
+        for cv in raw.constvars:
+            env[id(cv)] = _repl(_rank(cv))
+        outs = self._scope(raw, env, (), 1.0, (), False, True)
+        # top-level boundary: partial sums must materialize somewhere;
+        # sharded outputs stay sharded unless the caller pinned out_specs
+        end = _SiteCtx((), len(raw.eqns), raw.eqns[-1] if raw.eqns else None,
+                       1.0, False, ())
+        final = []
+        for k, (v, a) in enumerate(zip(raw.outvars, outs)):
+            a = self._resolve_partial(a, getattr(v, "aval", None), end, -1,
+                                      f"output #{k}", True)
+            if out_specs is not None and k < len(out_specs):
+                want = from_pspec(out_specs[k], _rank(v), self.sizes)
+                if want.dims != a.dims:
+                    a = self._classify(a, want.dims, getattr(v, "aval", None),
+                                       end, -1, f"output #{k} pinned to "
+                                       f"{spec_str(want)}", True)
+            final.append(a)
+        return final
+
+    def _read(self, env, atom) -> ASpec:
+        if hasattr(atom, "val"):         # Literal
+            return _repl(_rank(atom))
+        return env.get(id(atom), _repl(_rank(atom)))
+
+    def _scope(self, raw, env, path, trips, stack, in_loop, record):
+        for i, eqn in enumerate(raw.eqns):
+            sctx = _SiteCtx(path, i, eqn, trips, in_loop, stack)
+            n0 = len(self.sites)
+            outs = self._eqn(eqn, env, sctx, record)
+            for v, a in zip(eqn.outvars, outs):
+                env[id(v)] = a
+            if self.collect_table and record:
+                self.table.append({
+                    "path": "/".join(path) or "<top>", "eqn_index": i,
+                    "primitive": eqn.primitive.name,
+                    "in": [spec_str(self._read(env, a)) for a in eqn.invars],
+                    "out": [spec_str(a) for a in outs],
+                    "conflicts": len(self.sites) - n0})
+        return [self._read(env, v) for v in raw.outvars]
+
+    # -- per-primitive transfer rules ---------------------------------------
+
+    def _eqn(self, eqn, env, sctx, record) -> List[ASpec]:
+        name = eqn.primitive.name
+        ins = [self._read(env, a) for a in eqn.invars]
+        try:
+            if name == "sharding_constraint":
+                return self._t_constraint(eqn, ins, sctx, record)
+            if name == "dot_general":
+                return self._t_dot(eqn, ins, sctx, record)
+            if name in _REDUCE_PRIMS:
+                return self._t_reduce(eqn, ins)
+            if name in ("argmax", "argmin", "reduce_argmax", "reduce_argmin"):
+                return self._t_arg_reduce(eqn, ins, sctx, record)
+            if name == "transpose":
+                p = eqn.params["permutation"]
+                a = ins[0]
+                return [ASpec(tuple(a.dims[int(d)] for d in p), a.partial,
+                              a.constrained)]
+            if name == "reshape":
+                return self._t_reshape(eqn, ins, sctx, record)
+            if name == "broadcast_in_dim":
+                return self._t_broadcast(eqn, ins)
+            if name == "squeeze":
+                dims = set(int(d) for d in eqn.params.get("dimensions", ()))
+                a = ins[0]
+                return [ASpec(tuple(ax for d, ax in enumerate(a.dims)
+                                    if d not in dims), a.partial,
+                              a.constrained)]
+            if name == "expand_dims":
+                dims = sorted(int(d) for d in
+                              eqn.params.get("dimensions", ()))
+                a = ins[0]
+                out = list(a.dims)
+                for d in dims:
+                    out.insert(d, ())
+                return [ASpec(tuple(out), a.partial, a.constrained)]
+            if name == "concatenate":
+                return self._t_concat(eqn, ins, sctx, record)
+            if name == "dynamic_update_slice":
+                return [ASpec(ins[0].dims, ins[0].partial)]
+            if name in _SIZE_GATED:
+                return self._t_size_gated(eqn, ins)
+            if name == "scan":
+                return self._t_scan(eqn, ins, sctx, record)
+            if name == "while":
+                return self._t_while(eqn, ins, sctx, record)
+            if name == "cond":
+                return self._t_cond(eqn, ins, sctx, record)
+            if name == "shard_map":
+                return self._t_shard_map(eqn, ins, sctx, record)
+            if name in _OPAQUE:
+                # conservative: replicated dims, partial carried when the
+                # op is a linear selection (gather/scatter), else dropped
+                partial = ins[0].partial if ins and name in _PARTIAL_SAFE \
+                    else frozenset()
+                return [ASpec(((),) * _rank(v), partial)
+                        for v in eqn.outvars]
+            subs = list(subjaxprs(eqn))
+            if len(subs) == 1 and subs[0].kind == "call":
+                return self._t_call(eqn, subs[0], ins, sctx, record)
+            if subs:   # unknown higher-order: opaque
+                return [_repl(_rank(v)) for v in eqn.outvars]
+            return self._t_default(eqn, ins, sctx, record)
+        except Exception:
+            # a transfer rule must never sink an analysis run
+            return [_repl(_rank(v)) for v in eqn.outvars]
+
+    def _t_default(self, eqn, ins, sctx, record) -> List[ASpec]:
+        """Generic elementwise merge: same-rank operands must agree; the
+        largest operand's layout wins and the others reshard to it."""
+        out_r = _rank(eqn.outvars[0])
+        cands = [(i, a) for i, (a, v) in enumerate(zip(ins, eqn.invars))
+                 if _rank(v) == out_r and out_r > 0]
+        # partial handling: identical partials on every participating
+        # operand carry (grad accumulation adds partials); a lone partial
+        # carries through linear/uniform ops; anything else materializes
+        partials = [a.partial for _, a in cands if a.partial]
+        name = eqn.primitive.name
+        if partials and not (
+                len(set(partials)) == 1
+                and (len(partials) == len(cands) or name in _PARTIAL_SAFE
+                     or len(cands) == 1)):
+            for k, (i, a) in enumerate(cands):
+                if a.partial:
+                    cands[k] = (i, self._resolve_partial(
+                        a, eqn.invars[i].aval, sctx, i,
+                        f"operand #{i} of {name}", record))
+            partials = []
+        if not cands:
+            partial = frozenset().union(*[a.partial for a in ins]) \
+                if ins else frozenset()
+            return [ASpec(((),) * _rank(v),
+                          partial if _rank(v) == 0 else frozenset())
+                    for v in eqn.outvars]
+        # GSPMD-style union merge: start from the most-sharded operand
+        # (ties: largest) and absorb unconflicted axes from the others.
+        # A replicated or subset-sharded operand slices for free; only a
+        # genuine per-dim disagreement reshards (to the merged layout).
+        dom_i, dom = max(cands, key=lambda t: (
+            sum(1 for d in t[1].dims if d),
+            aval_bytes(eqn.invars[t[0]].aval)))
+        merged = list(dom.dims)
+        used = {ax for axes in merged for ax in axes}
+        for i, a in cands:
+            if i == dom_i:
+                continue
+            for d, axes in enumerate(a.dims):
+                if axes and not merged[d] and not (set(axes) & used):
+                    merged[d] = axes
+                    used.update(axes)
+        merged = tuple(merged)
+        for i, a in cands:
+            if all(not axes or axes == merged[d][:len(axes)]
+                   for d, axes in enumerate(a.dims)):
+                continue   # slicing down to the merged layout is local
+            self._classify(a, merged, eqn.invars[i].aval, sctx, i,
+                           f"operand #{i} of {name} laid out "
+                           f"{spec_str(a)} vs {spec_str(ASpec(merged))}",
+                           record)
+        partial = partials[0] if partials else frozenset()
+        return [ASpec(merged, partial) if _rank(v) == out_r
+                else _repl(_rank(v)) for v in eqn.outvars]
+
+    def _t_constraint(self, eqn, ins, sctx, record) -> List[ASpec]:
+        a = ins[0]
+        sh = eqn.params.get("sharding")
+        spec = getattr(sh, "spec", sh)
+        want = from_pspec(spec, _rank(eqn.outvars[0]), self.sizes)
+        a = self._resolve_partial(a, eqn.invars[0].aval, sctx, 0,
+                                  "sharding_constraint input", record)
+        if a.dims != want.dims:
+            self._classify(a, want.dims, eqn.invars[0].aval, sctx, 0,
+                           f"sharding_constraint {spec_str(a)} -> "
+                           f"{spec_str(want)}", record)
+        return [ASpec(want.dims, frozenset(), True)]
+
+    def _t_dot(self, eqn, ins, sctx, record) -> List[ASpec]:
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        la, ra = ins[0], ins[1]
+        # partial operands: a bilinear op cannot carry both; resolve
+        la = self._resolve_partial(la, eqn.invars[0].aval, sctx, 0,
+                                   "dot_general lhs", record)
+        ra = self._resolve_partial(ra, eqn.invars[1].aval, sctx, 1,
+                                   "dot_general rhs", record)
+        l_con = set(ax for d in lc for ax in la.dims[int(d)])
+        r_con = set(ax for d in rc for ax in ra.dims[int(d)])
+        shared = l_con & r_con
+        only_l, only_r = l_con - r_con, r_con - l_con
+        partial = set(shared)
+        if only_l and only_r:
+            # contracting dims sharded over DIFFERENT axes: one operand
+            # must reshard; gather the smaller one, keep the bigger's
+            lb_b = aval_bytes(eqn.invars[0].aval)
+            rb_b = aval_bytes(eqn.invars[1].aval)
+            if lb_b <= rb_b:
+                self._site("all-gather", tuple(only_l), lb_b, sctx, 0,
+                           "dot_general contracting dims sharded over "
+                           f"conflicting axes {sorted(only_l)} vs "
+                           f"{sorted(only_r)}", record)
+                partial |= only_r
+            else:
+                self._site("all-gather", tuple(only_r), rb_b, sctx, 1,
+                           "dot_general contracting dims sharded over "
+                           f"conflicting axes {sorted(only_r)} vs "
+                           f"{sorted(only_l)}", record)
+                partial |= only_l
+        else:
+            # one-sided contraction sharding: slicing the replicated
+            # operand is free; the product is a partial sum
+            partial |= only_l | only_r
+        # batch dims: must agree; the bigger operand wins
+        out_dims: List[Tuple[str, ...]] = []
+        used = set(partial)
+        for ld, rd in zip(lb, rb):
+            lax, rax = la.dims[int(ld)], ra.dims[int(rd)]
+            if lax != rax:
+                big_is_l = aval_bytes(eqn.invars[0].aval) >= \
+                    aval_bytes(eqn.invars[1].aval)
+                win = lax if big_is_l else rax
+                lose_i = 1 if big_is_l else 0
+                lose = ra if big_is_l else la
+                self._classify(
+                    lose, [win if d == int(rd if big_is_l else ld) else
+                           lose.dims[d] for d in range(len(lose.dims))],
+                    eqn.invars[lose_i].aval, sctx, lose_i,
+                    "dot_general batch dim layout conflict", record)
+            else:
+                win = lax
+            win = tuple(ax for ax in win if ax not in used)
+            used.update(win)
+            out_dims.append(win)
+        for d in range(len(la.dims)):
+            if d in set(int(x) for x in lc) or d in set(int(x) for x in lb):
+                continue
+            axes = tuple(ax for ax in la.dims[d] if ax not in used)
+            used.update(axes)
+            out_dims.append(axes)
+        for d in range(len(ra.dims)):
+            if d in set(int(x) for x in rc) or d in set(int(x) for x in rb):
+                continue
+            axes = tuple(ax for ax in ra.dims[d] if ax not in used)
+            used.update(axes)
+            out_dims.append(axes)
+        return [ASpec(tuple(out_dims), frozenset(partial))]
+
+    def _t_reduce(self, eqn, ins) -> List[ASpec]:
+        a = ins[0]
+        axes = set(int(d) for d in eqn.params.get("axes", ()))
+        partial = set(a.partial)
+        out_dims = []
+        for d, ax in enumerate(a.dims):
+            if d in axes:
+                partial.update(ax)
+            else:
+                out_dims.append(ax)
+        return [ASpec(tuple(out_dims), frozenset(partial))
+                for _ in eqn.outvars]
+
+    def _t_arg_reduce(self, eqn, ins, sctx, record) -> List[ASpec]:
+        a = self._resolve_partial(ins[0], eqn.invars[0].aval, sctx, 0,
+                                  "arg-reduction input", record)
+        axes = set(int(d) for d in eqn.params.get("axes", ()))
+        gathered = [ax for d in axes for ax in a.dims[d]]
+        if gathered:
+            self._site("all-gather", gathered,
+                       aval_bytes(eqn.invars[0].aval), sctx, 0,
+                       "arg-reduction over a sharded dim needs the full "
+                       "dim materialized", record)
+        out_dims = tuple(ax for d, ax in enumerate(a.dims) if d not in axes)
+        return [ASpec(out_dims) for _ in eqn.outvars]
+
+    def _t_reshape(self, eqn, ins, sctx, record) -> List[ASpec]:
+        a = ins[0]
+        in_shape = tuple(int(s) for s in eqn.invars[0].aval.shape)
+        out_shape = tuple(int(s) for s in eqn.outvars[0].aval.shape)
+        out_dims: List[Tuple[str, ...]] = [() for _ in out_shape]
+        dropped: List[str] = []
+        # greedy factor grouping: advance both cursors until the running
+        # products match; within a group only the MAJOR-most input dim's
+        # axes can survive, onto the major-most output dim (divisibility
+        # permitting) — everything else reshards
+        i = j = 0
+        while i < len(in_shape) or j < len(out_shape):
+            gi, gj = [i], [j] if j < len(out_shape) else []
+            pi = in_shape[i] if i < len(in_shape) else 1
+            pj = out_shape[j] if j < len(out_shape) else 1
+            while pi != pj:
+                if pi < pj and i + 1 < len(in_shape):
+                    i += 1
+                    gi.append(i)
+                    pi *= in_shape[i]
+                elif pj < pi and j + 1 < len(out_shape):
+                    j += 1
+                    gj.append(j)
+                    pj *= out_shape[j]
+                else:
+                    break
+            group_in = [d for d in gi if d < len(in_shape)]
+            group_out = [d for d in gj if d < len(out_shape)]
+            major_axes = a.dims[group_in[0]] if group_in else ()
+            minor = [ax for d in group_in[1:] for ax in a.dims[d]]
+            if group_out and major_axes:
+                n = self._group(major_axes)
+                if n > 0 and out_shape[group_out[0]] % max(n, 1) == 0:
+                    out_dims[group_out[0]] = major_axes
+                else:
+                    dropped.extend(major_axes)
+            elif major_axes:
+                dropped.extend(major_axes)
+            dropped.extend(minor)
+            i += 1
+            j = (group_out[-1] + 1) if group_out else j + 1
+        if dropped:
+            self._site("all-gather", dropped, aval_bytes(eqn.invars[0].aval),
+                       sctx, 0,
+                       f"reshape {list(in_shape)} -> {list(out_shape)} "
+                       f"cannot keep axes {sorted(set(dropped))}", record,
+                       from_constraint=a.constrained)
+        return [ASpec(tuple(out_dims), a.partial)]
+
+    def _t_broadcast(self, eqn, ins) -> List[ASpec]:
+        a = ins[0]
+        bdims = tuple(int(d) for d in eqn.params["broadcast_dimensions"])
+        in_shape = tuple(int(s) for s in eqn.invars[0].aval.shape)
+        out_shape = tuple(int(s) for s in eqn.outvars[0].aval.shape)
+        out_dims: List[Tuple[str, ...]] = [() for _ in out_shape]
+        for src, dst in enumerate(bdims):
+            if in_shape[src] == out_shape[dst]:
+                out_dims[dst] = a.dims[src]
+        return [ASpec(tuple(out_dims), a.partial)]
+
+    def _t_concat(self, eqn, ins, sctx, record) -> List[ASpec]:
+        cd = int(eqn.params["dimension"])
+        dom_i = max(range(len(ins)),
+                    key=lambda i: aval_bytes(eqn.invars[i].aval))
+        dom = ins[dom_i]
+        out_dims = tuple(() if d == cd else ax
+                         for d, ax in enumerate(dom.dims))
+        for i, a in enumerate(ins):
+            want = tuple(() if d == cd else out_dims[d]
+                         for d in range(len(a.dims)))
+            if a.dims != want and not a.replicated:
+                self._classify(a, want, eqn.invars[i].aval, sctx, i,
+                               f"concatenate operand #{i} layout conflict",
+                               record)
+        return [ASpec(out_dims,
+                      frozenset().union(*[a.partial for a in ins]))]
+
+    def _t_size_gated(self, eqn, ins) -> List[ASpec]:
+        a = ins[0]
+        in_shape = tuple(int(s) for s in eqn.invars[0].aval.shape)
+        out_shape = tuple(int(s) for s in eqn.outvars[0].aval.shape)
+        out_dims = tuple(
+            a.dims[d] if d < len(in_shape) and in_shape[d] == out_shape[d]
+            else () for d in range(len(out_shape)))
+        return [ASpec(out_dims, a.partial)]
+
+    # -- structured control flow --------------------------------------------
+
+    def _t_call(self, eqn, sub, ins, sctx, record) -> List[ASpec]:
+        inner, consts = unwrap(sub.jaxpr)
+        env: Dict[int, ASpec] = {}
+        outer_in = list(ins)
+        inner_in = list(inner.invars)
+        if len(outer_in) > len(inner_in):   # call consts ride first
+            outer_in = outer_in[len(outer_in) - len(inner_in):]
+        if len(outer_in) == len(inner_in):
+            for iv, a in zip(inner_in, outer_in):
+                env[id(iv)] = ASpec(
+                    tuple(a.dims[:_rank(iv)])
+                    + ((),) * max(0, _rank(iv) - len(a.dims)),
+                    a.partial, a.constrained)
+        for cv in inner.constvars:
+            env[id(cv)] = _repl(_rank(cv))
+        outs = self._scope(inner, env, sctx.path + (sub.label,), sctx.trips,
+                           sctx.stack + ((sctx.path, sctx.index),),
+                           sctx.in_loop, record)
+        return outs[:len(eqn.outvars)] + [
+            _repl(_rank(v)) for v in eqn.outvars[len(outs):]]
+
+    def _loop_fixpoint(self, eqn, body_raw, body_label, const_specs,
+                       carry_specs, extra_specs, trips, sctx, record):
+        """Shared scan/while carry fixpoint: iterate the body abstractly,
+        meeting carry specs toward replicated until stable, then run one
+        recording pass. Returns (carry_specs, body_out_specs)."""
+        def body_once(carries, rec):
+            env: Dict[int, ASpec] = {}
+            seq = list(const_specs) + list(carries) + list(extra_specs)
+            for iv, a in zip(body_raw.invars, seq):
+                env[id(iv)] = a
+            for cv in body_raw.constvars:
+                env[id(cv)] = _repl(_rank(cv))
+            return self._scope(
+                body_raw, env, sctx.path + (body_label,),
+                sctx.trips * trips,
+                sctx.stack + ((sctx.path, sctx.index),), True, rec)
+
+        n_carry = len(carry_specs)
+        for _ in range(4):
+            outs = body_once(carry_specs, False)
+            new = []
+            changed = False
+            for a, b in zip(carry_specs, outs[:n_carry]):
+                met_dims = tuple(
+                    da if da == db else ()
+                    for da, db in zip(a.dims, b.dims))
+                met = ASpec(met_dims)
+                if met.dims != a.dims:
+                    changed = True
+                new.append(met)
+            carry_specs = new
+            if not changed:
+                break
+        outs = body_once(carry_specs, record)
+        # carry boundary: partial sums and layout mismatches reshard on
+        # EVERY iteration — this is what resharding-in-scan-body prices
+        bctx = _SiteCtx(sctx.path, sctx.index, eqn, sctx.trips * trips,
+                        True, sctx.stack)
+        fixed = []
+        for k, (a, b) in enumerate(zip(carry_specs, outs[:n_carry])):
+            cv = body_raw.outvars[k]
+            b = self._resolve_partial(b, getattr(cv, "aval", None), bctx, -1,
+                                      f"loop carry #{k}", record)
+            if b.dims != a.dims:
+                b = self._classify(b, a.dims, getattr(cv, "aval", None),
+                                   bctx, -1, f"loop carry #{k} layout "
+                                   "changes across iterations", record)
+            fixed.append(ASpec(a.dims))
+        return fixed, outs
+
+    def _t_scan(self, eqn, ins, sctx, record) -> List[ASpec]:
+        body, _ = unwrap(eqn.params["jaxpr"])
+        nc = int(eqn.params.get("num_consts", 0))
+        nk = int(eqn.params.get("num_carry", 0))
+        trips = float(eqn.params.get("length", 1))
+        const_specs = ins[:nc]
+        carry_specs = list(ins[nc:nc + nk])
+        xs_specs = []
+        for a in ins[nc + nk:]:
+            xs_specs.append(ASpec(tuple(a.dims[1:])))  # scanned dim peeled
+        carry_specs, outs = self._loop_fixpoint(
+            eqn, body, "scan", const_specs, carry_specs, xs_specs, trips,
+            sctx, record)
+        result = list(carry_specs)
+        bctx = _SiteCtx(sctx.path, sctx.index, eqn, sctx.trips * trips,
+                        True, sctx.stack)
+        for k, a in enumerate(outs[len(carry_specs):]):
+            ov = body.outvars[len(carry_specs) + k]
+            a = self._resolve_partial(a, getattr(ov, "aval", None), bctx, -1,
+                                      f"scan stacked output #{k}", record)
+            result.append(ASpec(((),) + a.dims))  # new leading (time) dim
+        return result[:len(eqn.outvars)] + [
+            _repl(_rank(v)) for v in eqn.outvars[len(result):]]
+
+    def _t_while(self, eqn, ins, sctx, record) -> List[ASpec]:
+        cn = int(eqn.params.get("cond_nconsts", 0))
+        bn = int(eqn.params.get("body_nconsts", 0))
+        body, _ = unwrap(eqn.params["body_jaxpr"])
+        const_specs = ins[cn:cn + bn]
+        carry_specs = list(ins[cn + bn:])
+        carry_specs, _ = self._loop_fixpoint(
+            eqn, body, "while[body]", const_specs, carry_specs, (),
+            self.while_trips, sctx, record)
+        return carry_specs[:len(eqn.outvars)] + [
+            _repl(_rank(v)) for v in eqn.outvars[len(carry_specs):]]
+
+    def _t_cond(self, eqn, ins, sctx, record) -> List[ASpec]:
+        operands = ins[1:]
+        merged = None
+        for bi, br in enumerate(eqn.params.get("branches", ())):
+            inner, _ = unwrap(br)
+            env: Dict[int, ASpec] = {}
+            for iv, a in zip(inner.invars, operands):
+                env[id(iv)] = a
+            for cv in inner.constvars:
+                env[id(cv)] = _repl(_rank(cv))
+            outs = self._scope(inner, env, sctx.path + (f"cond[{bi}]",),
+                               sctx.trips,
+                               sctx.stack + ((sctx.path, sctx.index),),
+                               sctx.in_loop, record)
+            if merged is None:
+                merged = outs
+            else:
+                merged = [ASpec(tuple(da if da == db else ()
+                                      for da, db in zip(a.dims, b.dims)),
+                                a.partial | b.partial)
+                          for a, b in zip(merged, outs)]
+        merged = merged or []
+        bctx = _SiteCtx(sctx.path, sctx.index, eqn, sctx.trips,
+                        sctx.in_loop, sctx.stack)
+        final = []
+        for k, a in enumerate(merged[:len(eqn.outvars)]):
+            final.append(self._resolve_partial(
+                a, getattr(eqn.outvars[k], "aval", None), bctx, -1,
+                f"cond output #{k}", record))
+        return final + [_repl(_rank(v))
+                        for v in eqn.outvars[len(final):]]
+
+    def _t_shard_map(self, eqn, ins, sctx, record) -> List[ASpec]:
+        """Manual region: check the entry boundary against in_names
+        (explicit collectives inside are already priced by the overlap
+        model; the interior is NOT walked — its avals are per-shard)."""
+        in_names = eqn.params.get("in_names", ())
+        out_names = eqn.params.get("out_names", ())
+
+        def names_to_spec(names, ndim):
+            dims = [() for _ in range(ndim)]
+            for d, axes in (names or {}).items():
+                if int(d) < ndim:
+                    dims[int(d)] = tuple(
+                        ax for ax in axes if self.sizes.get(ax, 1) > 1)
+            return ASpec(tuple(dims))
+
+        for i, (a, names) in enumerate(zip(ins, in_names)):
+            want = names_to_spec(names, _rank(eqn.invars[i]))
+            a = self._resolve_partial(a, eqn.invars[i].aval, sctx, i,
+                                      f"shard_map operand #{i}", record)
+            if a.dims != want.dims:
+                self._classify(a, want.dims, eqn.invars[i].aval, sctx, i,
+                               f"shard_map expects {spec_str(want)} but "
+                               f"operand arrives {spec_str(a)}", record)
+        return [names_to_spec(names, _rank(v))
+                for v, names in zip(eqn.outvars, out_names)]
+
+
+def propagate(closed, mesh, in_specs, *, out_specs=None,
+              while_trips: float = 1.0,
+              collect_table: bool = False) -> ShardingInfo:
+    """Run the sharding-propagation pass over ``closed``.
+
+    ``in_specs``: one PartitionSpec / NamedSharding / ASpec / None per
+    flat top-level invar (missing entries read as replicated).
+    ``out_specs``: optional pinned output layouts (a jitted function's
+    ``out_shardings``); partial sums at outputs always materialize.
+    Returns a :class:`ShardingInfo` with every predicted implicit
+    collective, the per-equation spec table (``collect_table=True``)
+    and any constraints erased by reshapes.
+    """
+    raw, consts = unwrap(closed)
+    prop = _Propagator(mesh, while_trips, collect_table)
+    outs = prop.run(raw, consts, in_specs, out_specs)
+    return ShardingInfo(sites=prop.sites, out_specs=outs, table=prop.table,
+                        dropped_constraints=prop.dropped_constraints)
+
+
+def resharding_table(closed, mesh, in_specs, *, out_specs=None,
+                     while_trips: float = 1.0) -> List[dict]:
+    """Planner-ready flat table of predicted implicit resharding: one
+    dict per site (kind, axes, bytes, wire_bytes, time_s, link, trips,
+    path, eqn_index, primitive, source). ``distributed/auto.py`` scores
+    candidate layouts by summing ``time_s * trips`` over this table."""
+    info = propagate(closed, mesh, in_specs, out_specs=out_specs,
+                     while_trips=while_trips)
+    return [s.to_dict() for s in info.sites]

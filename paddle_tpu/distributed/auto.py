@@ -22,7 +22,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-__all__ = ["MemoryEstimate", "Plan", "plan"]
+__all__ = ["MemoryEstimate", "Plan", "plan", "resharding_cost"]
 
 _ADAM_BYTES = 8          # m + v, fp32 each
 _ACT_COEFF = 18          # bytes-ish per (B,S,H) element across a block's
@@ -154,3 +154,26 @@ def plan(n_params: float, n_devices: int, *, layers: int = 24,
              rationale=why)
     p.remat = use_remat
     return p
+
+
+def resharding_cost(closed, mesh, in_specs, *, while_trips: float = 1.0
+                    ) -> dict:
+    """Score one candidate layout by its predicted implicit-resharding
+    traffic: run the static sharding-propagation pass
+    (analysis/sharding.py) over ``closed`` seeded with ``in_specs`` and
+    fold the per-site table into planner-ready totals. Returns
+    ``{"n_sites", "time_s", "wire_bytes", "dcn_bytes", "sites"}`` —
+    lower ``time_s`` (and especially ``dcn_bytes``) means the layout
+    needs fewer silent partitioner collectives, the second-order term
+    the memory model above cannot see."""
+    from ..analysis.sharding import resharding_table
+    rows = resharding_table(closed, mesh, in_specs,
+                            while_trips=while_trips)
+    return {
+        "n_sites": len(rows),
+        "time_s": sum(r["time_s"] * max(r["trips"], 1.0) for r in rows),
+        "wire_bytes": sum(r["wire_bytes"] * max(r["trips"], 1.0)
+                          for r in rows),
+        "dcn_bytes": sum(r["bytes"] for r in rows if r["link"] == "dcn"),
+        "sites": rows,
+    }

@@ -1114,9 +1114,63 @@ class ParallelTrainer:
             cfg = dataclasses.replace(cfg, grad_sync_buckets=eff)
         config = cfg
         closed, donated = self._staged_jaxpr(step, inputs, labels, lr)
+        in_specs = None
+        try:
+            in_specs = self.staged_in_specs(inputs, labels)
+            if len(in_specs) != len(closed.jaxpr.invars):
+                in_specs = None   # tree drift: better silent than wrong
+        except Exception:
+            in_specs = None
         report = analysis.analyze_jaxpr(closed, mesh=self.mesh,
-                                        donated=donated, config=config)
+                                        donated=donated, config=config,
+                                        in_specs=in_specs)
+        if _telemetry.enabled():
+            ov = getattr(report.cost, "overlap", None) or {}
+            if "n_reshard" in ov:
+                _telemetry.gauge(
+                    "predicted_reshard_collectives",
+                    "implicit resharding collectives the sharding pass "
+                    "predicts in the staged step").set(ov["n_reshard"])
+                _telemetry.gauge(
+                    "predicted_reshard_seconds",
+                    "modeled per-step wall seconds of implicit "
+                    "resharding").set(ov["reshard_time"])
         return step, report
+
+    def staged_in_specs(self, inputs, labels):
+        """One PartitionSpec per flat invar of :meth:`staged_jaxpr`'s
+        ClosedJaxpr, in tracing order — the seed the static
+        sharding-propagation pass (analysis/sharding.py) needs to
+        predict implicit resharding from the exact staged step."""
+        def flat(part, spec_tree=None):
+            leaves, treedef = jax.tree_util.tree_flatten(part)
+            if spec_tree is None:
+                return [P()] * len(leaves)
+            try:
+                return list(treedef.flatten_up_to(spec_tree))
+            except ValueError:
+                # node-type mismatch (dict vs OrderedDict spec trees):
+                # align per leaf by key path, in part's own flatten order
+                paths = jax.tree_util.tree_flatten_with_path(part)[0]
+                out = []
+                for path, _ in paths:
+                    node = spec_tree
+                    for e in path:
+                        node = node[e.key if hasattr(e, "key") else e.idx]
+                    out.append(node)
+                return out
+        specs = []
+        specs += flat(self.state["params"], self.param_specs)
+        specs += flat(self.state["buffers"], self.buffer_specs)
+        specs += flat(self.state["opt"], self.opt_specs)
+        specs += flat(self.state["comm_err"], self.comm_err_specs)
+        specs += flat(self.state["guard"])
+        specs += [P(), P(), P()]   # rng key, lr, grad-taint scalar
+        specs += flat(inputs, jax.tree_util.tree_map(self._leaf_spec,
+                                                     inputs))
+        specs += flat(labels, jax.tree_util.tree_map(self._leaf_spec,
+                                                     labels))
+        return specs
 
     def _staged_jaxpr(self, step, inputs, labels, lr=None):
         """Trace the staged ``step`` to a ClosedJaxpr with this trainer's

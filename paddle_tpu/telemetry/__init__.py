@@ -139,6 +139,14 @@ kv_cache_evictions_total       counter    registered pages reclaimed
                                           {cause=capacity|trim}
 decode_tokens_total            counter    generated tokens committed by
                                           the decode scheduler
+predicted_reshard_collectives  gauge      engine.compile(analyze=True):
+                                          implicit resharding collectives
+                                          the static sharding pass
+                                          (analysis/sharding.py) predicts
+                                          in the staged step
+predicted_reshard_seconds      gauge      modeled per-step wall seconds
+                                          of that implicit resharding
+                                          (ring model over axis_links)
 =============================  =========  =================================
 
 Multi-host merge: ``telemetry.aggregate.gather_registries()`` allgathers

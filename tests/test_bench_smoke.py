@@ -349,3 +349,21 @@ def test_numerics_smoke_cpu():
     assert summary["numerics_ok"], proc.stdout
     assert summary["n_checks"] >= 7
     assert proc.returncode == 0
+
+
+def test_lint_program_smoke_strict():
+    """lint_program --smoke --strict over every registered program
+    (bench trainers + decode executors): any future rule regression or
+    new warning on the shipped programs fails tier-1 here, not at
+    snapshot time."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint_program.py"),
+         "--smoke", "--strict", "--json"],
+        capture_output=True, text=True, timeout=900, env=_env())
+    assert proc.returncode == 0, (
+        f"lint rc={proc.returncode}\nstdout tail: {proc.stdout[-3000:]}\n"
+        f"stderr tail: {proc.stderr[-2000:]}")
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert set(out) == {"gpt", "bert", "decode-mixed", "decode-decode"}
+    for name, rep in out.items():
+        assert rep["ok"], f"{name}: {rep['findings']}"

@@ -4,15 +4,21 @@ Stages the bench GPT / BERT configurations (CPU shapes), traces the
 EXACT jitted step each ParallelTrainer would run (donation mask,
 comm_err / compressed grad-sync plumbing included) and runs every rule
 in paddle_tpu.analysis over it, plus the cost model's top-k
-most-expensive-equations table.
+most-expensive-equations table. The serving path is linted too: the
+DecodeServer executor programs (``decode-mixed`` ragged prefill,
+``decode-decode`` paged decode) are traced from ShapeDtypeStructs at
+the bench shapes.
 
 Exit status is the CI contract: 0 when no error-severity finding on any
-model, 1 otherwise — warnings and infos print but do not fail.
+model, 1 otherwise — warnings and infos print but do not fail unless
+``--strict`` (then any warning fails too; infos never gate).
 
 Usage:
-    python tools/lint_program.py                  # gpt + bert, text report
+    python tools/lint_program.py                  # all programs, text report
     python tools/lint_program.py --model gpt --json  # machine-readable
-    python tools/lint_program.py --smoke          # tiny config, tier-1 CI
+    python tools/lint_program.py --smoke --strict # tiny configs, tier-1 CI
+    python tools/lint_program.py --model gpt --dump-sharding
+                                  # per-equation sharding/conflict table
 """
 from __future__ import annotations
 
@@ -86,23 +92,79 @@ def _build_bert(smoke: bool):
     return trainer, ids, (mlm, nsp)
 
 
+def _decode_jaxpr(which: str, smoke: bool):
+    """Trace one DecodeServer executor fn (PR 11 serving contract) at
+    the bench shapes from ShapeDtypeStructs — nothing materialized."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.inference.decode_model import (init_decode_model,
+                                                   make_step_fn)
+    from paddle_tpu.inference.kv_cache import PagedKVCache
+
+    if smoke:
+        vocab, heads, hd, t, r, w, pages, page = 128, 2, 16, 16, 4, 4, 16, 8
+    else:  # tools/bench_serving.py default shapes
+        vocab, heads, hd, t, r, w, pages, page = 256, 4, 32, 64, 8, 8, 64, 16
+    params = init_decode_model(vocab, heads, hd, max_len=1024)
+    cache = PagedKVCache(pages, page, heads, hd, num_layers=1)
+    step = make_step_fn(params, cache)
+    mixed, decode = step.jit_fns
+    kp, vp = cache.pools(0)
+    s = jax.ShapeDtypeStruct
+    args = (s(kp.shape, kp.dtype), s(vp.shape, vp.dtype),
+            s((t,), np.int32), s((t,), np.int32), s((t,), np.int32),
+            s((t,), np.bool_), s((r, w), np.int32), s((r,), np.int32),
+            s((r,), np.int32))
+    fn = mixed if which == "mixed" else decode
+    return jax.make_jaxpr(lambda *a: fn(*a))(*args)
+
+
+# ParallelTrainer programs: staged via trainer.compile(analyze=True).
 BUILDERS = {"gpt": _build_gpt, "bert": _build_bert}
+# Inference executor programs: plain ClosedJaxprs, no trainer.
+PROGRAMS = {"decode-mixed": lambda smoke: _decode_jaxpr("mixed", smoke),
+            "decode-decode": lambda smoke: _decode_jaxpr("decode", smoke)}
+ALL_MODELS = tuple(BUILDERS) + tuple(PROGRAMS)
 
 
-def lint_model(name: str, smoke: bool, top: int, dump_schedule: bool = False):
+def lint_model(name: str, smoke: bool, top: int,
+               dump_schedule: bool = False, dump_sharding: bool = False):
+    from paddle_tpu import analysis
     from paddle_tpu.analysis import AnalysisConfig
 
-    data_mesh(1)
-    trainer, inputs, labels = BUILDERS[name](smoke)
-    _, report = trainer.compile(inputs, labels, analyze=True,
-                                config=AnalysisConfig(top_k=top))
-    schedule = None
-    if dump_schedule:
-        from paddle_tpu.analysis import cost
-        closed = trainer.staged_jaxpr(inputs, labels)
-        schedule = cost.overlap_summary(closed, trainer.mesh,
-                                        include_timeline=True)
-    return report, schedule
+    mesh = data_mesh(1)
+    cfg = AnalysisConfig(top_k=top)
+    schedule = sharding = None
+    if name in BUILDERS:
+        trainer, inputs, labels = BUILDERS[name](smoke)
+        _, report = trainer.compile(inputs, labels, analyze=True,
+                                    config=cfg)
+        if dump_schedule or dump_sharding:
+            closed = trainer.staged_jaxpr(inputs, labels)
+            if dump_schedule:
+                from paddle_tpu.analysis import cost
+                schedule = cost.overlap_summary(closed, trainer.mesh,
+                                                include_timeline=True)
+            if dump_sharding:
+                from paddle_tpu.analysis.sharding import propagate
+                info = propagate(closed, trainer.mesh,
+                                 trainer.staged_in_specs(inputs, labels),
+                                 collect_table=True)
+                sharding = info.to_dict()
+    else:
+        closed = PROGRAMS[name](smoke)
+        report = analysis.analyze_jaxpr(closed, mesh=mesh, config=cfg)
+        if dump_schedule:
+            from paddle_tpu.analysis import cost
+            schedule = cost.overlap_summary(closed, mesh,
+                                            include_timeline=True)
+        if dump_sharding:
+            from paddle_tpu.analysis.sharding import propagate
+            n = len(closed.jaxpr.invars)
+            info = propagate(closed, mesh, [None] * n, collect_table=True)
+            sharding = info.to_dict()
+    return report, schedule, sharding
 
 
 def _schedule_text(name: str, sched: dict) -> str:
@@ -118,7 +180,8 @@ def _schedule_text(name: str, sched: dict) -> str:
              f"{'start_us':>10} {'end_us':>10} {'kind':<10} "
              f"{'primitive':<22} {'cost':>12}  path"]
     for e in sched.get("timeline", ()):
-        cost = (f"{e['bytes']:.0f}B/{e['link']}" if e["kind"] == "collective"
+        cost = (f"{e['bytes']:.0f}B/{e['link']}"
+                if e["kind"] in ("collective", "reshard")
                 else f"{e['flops']:.0f}F")
         stall = (f" (+{e['stall'] * 1e6:.3g}us stall)"
                  if e.get("stall") else "")
@@ -128,9 +191,30 @@ def _schedule_text(name: str, sched: dict) -> str:
     return "\n".join(lines)
 
 
+def _sharding_text(name: str, info: dict) -> str:
+    """Render the sharding-propagation pass's per-equation table plus
+    the predicted implicit-collective sites."""
+    lines = [f"-- {name} sharding: {info['n_sites']} predicted implicit "
+             f"collectives, {info['total_time_s'] * 1e6:.4g}us modeled, "
+             f"{info['total_wire_bytes']:.0f} wire bytes",
+             f"{'#':>5} {'primitive':<22} {'out spec':<28} {'conf':>4}  "
+             "path"]
+    for row in info.get("table", ()):
+        out = ", ".join(row["out"])
+        lines.append(f"{row['eqn_index']:>5} {row['primitive']:<22} "
+                     f"{out:<28} {row['conflicts'] or '':>4}  "
+                     f"{row['path']}")
+    for s in info.get("sites", ()):
+        lines.append(f"  site: {s['kind']} over {s['axes']} "
+                     f"{s['bytes']:.0f}B on {s['link']} at "
+                     f"{s['path']}#{s['eqn_index']} ({s['primitive']}): "
+                     f"{s['detail']}")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--model", choices=("gpt", "bert", "all"),
+    ap.add_argument("--model", choices=ALL_MODELS + ("decode", "all"),
                     default="all")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON report object keyed by model")
@@ -138,28 +222,45 @@ def main(argv=None) -> int:
                     help="cost-table length (default 10)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny 1-layer configs; the tier-1 CI wrapper")
+    ap.add_argument("--strict", action="store_true",
+                    help="warnings also exit 1 (CI mode); infos never "
+                         "gate")
     ap.add_argument("--devices", type=int, default=1,
                     help="forced host device count when no accelerator")
     ap.add_argument("--dump-schedule", action="store_true",
                     help="print the overlap model's per-equation "
                          "compute/collective timeline (with --json: a "
                          "'schedule' object per model)")
+    ap.add_argument("--dump-sharding", action="store_true",
+                    help="print the sharding-propagation pass's "
+                         "per-equation spec/conflict table and predicted "
+                         "implicit collectives (with --json: a "
+                         "'sharding' object per model)")
     args = ap.parse_args(argv)
 
     force_host_devices(args.devices)
     ensure_repo_on_path()
 
-    models = ("gpt", "bert") if args.model == "all" else (args.model,)
-    reports, schedules = {}, {}
+    if args.model == "all":
+        models = ALL_MODELS
+    elif args.model == "decode":
+        models = tuple(PROGRAMS)
+    else:
+        models = (args.model,)
+    reports, schedules, shardings = {}, {}, {}
     for name in models:
-        reports[name], schedules[name] = lint_model(
-            name, args.smoke, args.top, dump_schedule=args.dump_schedule)
+        reports[name], schedules[name], shardings[name] = lint_model(
+            name, args.smoke, args.top, dump_schedule=args.dump_schedule,
+            dump_sharding=args.dump_sharding)
 
     if args.json:
         out = {n: r.to_dict() for n, r in reports.items()}
         if args.dump_schedule:
             for n in out:
                 out[n]["schedule"] = schedules[n]
+        if args.dump_sharding:
+            for n in out:
+                out[n]["sharding"] = shardings[n]
         print(json.dumps(out))
     else:
         for name, rep in reports.items():
@@ -167,7 +268,16 @@ def main(argv=None) -> int:
             print(rep.to_text())
             if args.dump_schedule and schedules[name] is not None:
                 print(_schedule_text(name, schedules[name]))
+            if args.dump_sharding and shardings[name] is not None:
+                print(_sharding_text(name, shardings[name]))
     ok = all(r.ok for r in reports.values())
+    if ok and args.strict:
+        n_warn = sum(1 for r in reports.values() for f in r.findings
+                     if f.severity == "warning")
+        if n_warn:
+            print(f"lint_program: --strict and {n_warn} warning(s) "
+                  "present", file=sys.stderr)
+            return 1
     if not ok:
         print("lint_program: error-severity findings present",
               file=sys.stderr)
