@@ -676,28 +676,44 @@ class CheckpointManager:
                     return
                 if self._staged is None or not self._commit_gate.is_set():
                     continue
-                step, host, deep = self._staged
+                step, host, deep, trace = self._staged
                 self._staged = None
                 self._committing = step
             try:
-                self._commit_one(step, host, deep)
+                self._commit_one(step, host, deep, trace=trace)
             except BaseException as e:  # noqa: BLE001 — surfaced via flush()
                 self.failed_total += 1
                 self._thread_error = e
+                if trace is not None:
+                    trace.close("failed", error=repr(e))
             finally:
                 with self._cv:
                     self._committing = None
                     self._cv.notify_all()
                 self._set_inflight_gauge()
 
-    def _commit_one(self, step: int, host_state: Any, deep: bool):
+    def _commit_one(self, step: int, host_state: Any, deep: bool,
+                    trace=None):
         """The off-step-path half of an async save: dirty check, intent
         marker, orbax write (retry/byte-budgeted like the sync path),
-        two-phase manifest commit, retention GC."""
+        two-phase manifest commit, retention GC.
+
+        ``trace`` is the ckpt_save trace opened on the step thread; the
+        commit span below therefore ends on the committer thread — the
+        cross-thread handoff the span record's ``end_thread`` attribute
+        documents."""
         import orbax.checkpoint as ocp
         from ..resilience import faults
         from ..resilience.retry import RetryBytesExhausted, call_with_retry
         from .. import telemetry
+        sp = trace.span("commit", step=step) if trace is not None else None
+
+        def _finish(outcome: str, **attrs):
+            if sp is not None and not sp._ended:
+                sp.end(outcome, **attrs)
+            if trace is not None:
+                trace.close(outcome)
+
         if self.commit_delay > 0:
             time.sleep(self.commit_delay)
         # the subtle interaction: consult the dirty flag at COMMIT time —
@@ -708,6 +724,7 @@ class CheckpointManager:
             self._count_suppressed("dirty")
             if telemetry.enabled():
                 telemetry.emit("ckpt_commit", step=step, outcome="dirty")
+            _finish("dirty")
             return
         t0 = time.perf_counter()
         arrays = None
@@ -738,9 +755,11 @@ class CheckpointManager:
                             nbytes, e, arrays=arrays)
                 _clear_pending_marker(self._dir, step)
                 self.committed_total += 1  # durable, just degraded
+                _finish("degraded", bytes_budget=True)
                 return
             if not saved:
                 self.superseded_total += 1  # orbax interval-skipped it
+                _finish("superseded")
                 return
             self._mngr.wait_until_finished()
             sdir = self._step_dir(step)
@@ -772,22 +791,34 @@ class CheckpointManager:
             telemetry.emit("ckpt_commit", step=step,
                            outcome="committed", deep=bool(deep),
                            commit_ms=dt * 1000.0)
+        _finish("committed", commit_ms=dt * 1000.0)
 
     def _save_async(self, step: int, state: Any, deep: bool) -> bool:
         """The on-step-path half: snapshot + stage + return. Never blocks
         on IO; a staged-but-not-started older snapshot is superseded."""
+        from ..telemetry import tracing as _tracing
         self._raise_thread_error()
         if self._save_interval > 1 and step % self._save_interval:
             return False
         t0 = time.perf_counter()
-        host = self._snapshot_host(state)
+        tr = _tracing.start_trace("ckpt_save", step=step, deep=bool(deep))
+        if tr is not None:
+            with tr.span("snapshot", step=step):
+                host = self._snapshot_host(state)
+        else:
+            host = self._snapshot_host(state)
         with self._cv:
             self.snapshots_total += 1
             if self._staged is not None:
                 # double buffer full: the newer state supersedes — cadence
                 # degrades under backpressure, the step loop never waits
                 self._count_suppressed("superseded")
-            self._staged = (step, host, deep)
+                old_tr = self._staged[3]
+                if old_tr is not None:
+                    old_tr.close("superseded", superseded_by=step)
+            # the trace rides the staged tuple across to the committer
+            # thread (explicit handoff; the commit span ends over there)
+            self._staged = (step, host, deep, tr)
             self._cv.notify_all()
         self._ensure_committer()
         self._set_inflight_gauge()
@@ -837,6 +868,9 @@ class CheckpointManager:
         stand-in for dying mid-pipeline; chaos uses a real SIGKILL)."""
         with self._cv:
             if self._staged is not None:
+                tr = self._staged[3]
+                if tr is not None:
+                    tr.close("abandoned")
                 self._staged = None
                 self.abandoned_total += 1
             self._cv.notify_all()

@@ -36,6 +36,7 @@ shard_map = jax.shard_map
 
 from .. import profiler as _profiler
 from .. import telemetry as _telemetry
+from ..telemetry import tracing as _tracing
 from ..framework.random import get_rng_key
 from ..jit.functionalization import functional_call, state_of
 from ..resilience.guard import all_finite
@@ -1029,7 +1030,24 @@ class ParallelTrainer:
         self._last_stage_miss = step is None
         if step is None:
             t0 = time.perf_counter()
-            step = self._make_step(in_specs, lb_specs, do_check)
+            # "stage" rides as a child of the runner's ambient step span
+            # (if one is open): staged-program builds show up inside the
+            # step that paid for them. The per-bucket exchange plan is
+            # recorded as events here — the exchanges themselves run
+            # inside the jitted program, invisible to host-side spans.
+            sp = _tracing.child_span("stage", check=bool(do_check))
+            try:
+                step = self._make_step(in_specs, lb_specs, do_check)
+            finally:
+                if sp is not None:
+                    for i, bk in enumerate(
+                            getattr(self, "grad_sync_bucket_keys", [])):
+                        sp.event(
+                            "exchange_bucket", bucket=i, leaves=len(bk),
+                            bytes=int(sum(
+                                self.state["params"][k].nbytes
+                                for k in bk)))
+                    sp.end("ok", cache_miss=True)
             self._step_cache[cache_key] = step
             if _telemetry.enabled():
                 _telemetry.counter(

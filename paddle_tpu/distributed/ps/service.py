@@ -280,7 +280,7 @@ class _ShardedClient:
                 err.append(e)
                 sent.release()  # unblock the waiter
 
-        t = threading.Thread(target=send_all, daemon=True)
+        t = threading.Thread(target=send_all, name="ps-send", daemon=True)
         t.start()
         try:
             for ch in chunks:
@@ -327,7 +327,9 @@ class DistributedSparseTable(_ShardedClient):
         self._err: Optional[BaseException] = None
         if async_mode:
             self._q: "queue.Queue" = queue.Queue(maxsize=max_pending)
-            self._worker = threading.Thread(target=self._drain, daemon=True)
+            self._worker = threading.Thread(target=self._drain,
+                                            name="ps-async-drain",
+                                            daemon=True)
             self._worker.start()
 
     def pull(self, keys, create_missing: bool = True) -> np.ndarray:

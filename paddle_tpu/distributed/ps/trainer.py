@@ -73,7 +73,8 @@ class Communicator:
         self._stop = threading.Event()
         self._pushed = 0
         self._err: Optional[BaseException] = None
-        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread = threading.Thread(target=self._drain,
+                                        name="ps-push-drain", daemon=True)
         self._thread.start()
 
     def _check_err(self):
@@ -271,8 +272,9 @@ class MultiTrainer:
             except BaseException as e:  # surface worker crashes to caller
                 errs.append(e)
 
-        threads = [threading.Thread(target=_run, args=(w,), daemon=True)
-                   for w in self.workers]
+        threads = [threading.Thread(target=_run, args=(w,),
+                                    name=f"ps-worker-{i}", daemon=True)
+                   for i, w in enumerate(self.workers)]
         for t in threads:
             t.start()
         for t in threads:

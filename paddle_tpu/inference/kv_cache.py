@@ -38,6 +38,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..telemetry import tracing as _tracing
+
 __all__ = ["PagedKVCache", "CacheSeq", "CacheOOM"]
 
 
@@ -151,6 +153,8 @@ class PagedKVCache:
                 self._deref_locked(page)
                 self.evictions += 1
                 self._count("kv_cache_evictions_total", cause=cause)
+                # lands on whichever request span drove the allocation
+                _tracing.add_event("kv_eviction", page=page, cause=cause)
                 return True
         return False
 
@@ -227,6 +231,11 @@ class PagedKVCache:
                 self.prefix_hit_tokens += seq.cached_tokens
                 self._count("kv_cache_prefix_hits_total",
                             seq.cached_tokens)
+                _tracing.add_event("kv_prefix_hit",
+                                   tokens=seq.cached_tokens,
+                                   pages=len(hits))
+            else:
+                _tracing.add_event("kv_prefix_miss")
             self._gauges()
             return seq
 
@@ -284,6 +293,7 @@ class PagedKVCache:
                 seq.length += 1
                 if slot == ps - 1:
                     self._register_tail_locked(seq, page)
+            _tracing.add_event("kv_append", tokens=n, pages=len(seq.pages))
             self._gauges()
 
     def _register_tail_locked(self, seq: CacheSeq, page: int):

@@ -65,6 +65,7 @@ import numpy as np
 from ..ops.pallas.tuner import shape_bucket
 from ..resilience import faults
 from ..resilience.retry import _backoff
+from ..telemetry import tracing as _tracing
 
 __all__ = [
     "InferenceServer", "ServingConfig", "Request",
@@ -141,6 +142,11 @@ class Request:
         self.on_terminal: Optional[Callable[["Request"], None]] = None
         self._done = threading.Event()
         self._lock = threading.Lock()
+        # tail-sampled tracing (telemetry.tracing): populated only when
+        # tracing is enabled — the disabled hot path allocates no spans
+        self._trace = None
+        self._span_wait = None      # admission -> first dispatch
+        self._attempt_span = None   # current dispatch attempt
 
     def signature(self):
         """Batch-compatibility key: per-row shape + dtype of each input."""
@@ -163,6 +169,8 @@ class Request:
             self.error = error
             self.cause = cause
             self.t_done = time.monotonic()
+        if self._trace is not None:
+            self._close_trace(state)
         cb = self.on_terminal
         if cb is not None:
             try:
@@ -173,6 +181,18 @@ class Request:
                               f"failed: {e!r}", stacklevel=2)
         self._done.set()
         return True
+
+    def _close_trace(self, outcome: str):
+        """Run the tail-sampling keep/drop decision for this request's
+        trace; any span the seal raced still open is ended with the
+        outcome so the trace tree is complete at close."""
+        tr = self._trace
+        for sp in (self._span_wait, self._attempt_span):
+            if sp is not None and not sp._ended:
+                sp.end(outcome)
+        rel = None if self.deadline is None else self.deadline - self.arrival
+        tr.close(outcome, deadline_s=rel, failover=self.attempts > 0,
+                 attempts=self.attempts, cause=self.cause)
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -388,7 +408,13 @@ class InferenceServer:
     def shutdown(self, drain: bool = True, timeout: float = 30.0):
         """Stop the server. With ``drain`` accepted work finishes first
         while new admissions are shed with cause ``draining``."""
+        first = not self._draining and not self._stopped
         self._draining = True
+        if drain and first:
+            # flight-recorder snapshot of the last seconds before drain
+            # (no-op unless a dump directory is configured)
+            from ..telemetry import flight as _flight
+            _flight.dump("drain")
         if drain:
             deadline = time.monotonic() + timeout
             while time.monotonic() < deadline:
@@ -421,7 +447,7 @@ class InferenceServer:
 
         def _handler(signum, frame):
             self._draining = True
-            threading.Thread(target=self.shutdown,
+            threading.Thread(target=self.shutdown, name="serving-drain",
                              kwargs={"drain": True}, daemon=True).start()
             prev = self._prev_sigterm
             if callable(prev) and prev not in (signal.SIG_IGN,
@@ -444,6 +470,10 @@ class InferenceServer:
         if deadline_s is None:
             deadline_s = self.cfg.default_deadline_s
         req = Request(inputs, deadline_s=deadline_s, tokens=tokens)
+        if _tracing.enabled():
+            req._trace = _tracing.start_trace(
+                "serving_request", req_id=req.id, rows=req.rows)
+            req._span_wait = req._trace.span("admission_wait")
         self._count_only("submitted")
         if self._draining or self._stopped:
             self._terminal(req, SHED, cause="draining")
@@ -573,6 +603,16 @@ class InferenceServer:
                 r.t_dispatch = time.monotonic()
                 self._observe("serving_queue_wait_seconds",
                               r.t_dispatch - r.arrival)
+            sp = r._span_wait
+            if sp is not None and not sp._ended:
+                sp.end("ok")
+            if r._trace is not None and not r._trace.closed:
+                # one span per dispatch attempt — failovers and decode
+                # re-entry steps each get their own
+                r._attempt_span = r._trace.span(
+                    "execute", attempt=r.attempts, replica=replica.idx,
+                    generation=replica.generation, batch_seq=job.seq,
+                    bucket=bucket, rows=r.rows, phase=self._phase_of(r))
         self._count("serving_batches_total")
         self._gauge("serving_batch_occupancy", rows / float(bucket))
         replica.queue.put(job)
@@ -612,6 +652,10 @@ class InferenceServer:
         """May ``r`` join the forming batch? Base packs by summed rows;
         subclasses add their own capacity axes (token budget + row cap)."""
         return rows + r.rows <= self.cfg.max_batch
+
+    def _phase_of(self, r: Request) -> str:
+        """Trace-span phase label for a dispatch of ``r``."""
+        return "infer"
 
     def _pad_concat(self, batch: List[Request], bucket: int) -> List[np.ndarray]:
         n_inputs = len(batch[0].inputs)
@@ -699,6 +743,9 @@ class InferenceServer:
         for r in job.requests:
             sl = [o[off:off + r.rows] for o in outs]
             off += r.rows
+            sp = r._attempt_span
+            if sp is not None and not sp._ended:
+                sp.end("ok")
             if r._seal(COMPLETED, outputs=sl):
                 self._count_outcome(COMPLETED)
                 self._count("serving_tokens_total", n=r.tokens)
@@ -758,6 +805,9 @@ class InferenceServer:
         now = time.monotonic()
         back: List[Request] = []
         for r in requests:
+            sp = r._attempt_span
+            if sp is not None and not sp._ended:
+                sp.end("failover")
             if r.done():
                 continue
             if r.expired(now):
@@ -793,6 +843,10 @@ class InferenceServer:
         self._count("serving_requests_shed_total", cause=cause)
         with self._clock:
             self.shed_causes[cause] += 1
+        # burn-rate watch: a shed spike is exactly when the rolling-window
+        # SLO monitor should look (no-op unless one is installed)
+        from ..telemetry import slo as _slo
+        _slo.maybe_poll()
 
     def _count_outcome(self, outcome: str):
         with self._clock:
@@ -982,6 +1036,11 @@ class DecodeServer(InferenceServer):
             deadline_s = self.cfg.default_deadline_s
         req = GenerationRequest(prompt_tokens, max_new_tokens,
                                 deadline_s=deadline_s)
+        if _tracing.enabled():
+            req._trace = _tracing.start_trace(
+                "serving_request", req_id=req.id, kind="generate",
+                prompt_tokens=len(req.prompt), max_new=req.max_new)
+            req._span_wait = req._trace.span("admission_wait")
         self._count_only("submitted")
         if self._draining or self._stopped:
             self._terminal(req, SHED, cause="draining")
@@ -991,7 +1050,9 @@ class DecodeServer(InferenceServer):
             raise ValueError(
                 f"generation spans {self.cache.pages_needed(total)} pages "
                 f"> max_pages_per_seq={self.max_pages_per_seq}")
-        with self._cv:
+        # ambient span: the cache reports prefix hits / evictions into
+        # the admission_wait span without signature changes
+        with _tracing.use_span(req._span_wait), self._cv:
             if len(self._deque) >= self.cfg.max_queue:
                 cause = "queue_full"
             else:
@@ -1059,6 +1120,10 @@ class DecodeServer(InferenceServer):
         return (len(batch) < self.max_batch_rows
                 and rows + r.rows <= self.cfg.max_batch)
 
+    def _phase_of(self, r: Request) -> str:
+        return ("decode" if r.seq is not None
+                and r.seq.length >= len(r.prompt) else "prefill")
+
     def _pad_concat(self, batch: List[Request],
                     bucket: int) -> List[np.ndarray]:
         """Flattened varlen layout: every request's chunk tokens
@@ -1117,15 +1182,21 @@ class DecodeServer(InferenceServer):
         off = 0
         for i, r in enumerate(job.requests):
             n = len(r.chunk)
+            sp = r._attempt_span
             try:
-                self._advance(r, int(next_tokens[i]),
-                              k_new[:, off:off + n], v_new[:, off:off + n],
-                              back)
+                # ambient span: cache append/evict events land on this
+                # step's execute span
+                with _tracing.use_span(sp):
+                    self._advance(r, int(next_tokens[i]),
+                                  k_new[:, off:off + n],
+                                  v_new[:, off:off + n], back)
             except Exception as e:  # noqa: BLE001 - CacheOOM et al.
                 if r._seal(FAILED, error=e if isinstance(e, ServingError)
                            else ServingError(
                                f"request {r.id} step failed: {e!r}")):
                     self._count_outcome(FAILED)
+            if sp is not None and not sp._ended:
+                sp.end("ok", tokens=n)
             off += n
         if back:
             with self._cv:

@@ -269,7 +269,8 @@ class _PrefetchIter:
             finally:
                 self.q.put(self.done)
 
-        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread = threading.Thread(target=run, name="data-prefetch",
+                                       daemon=True)
         self.thread.start()
 
     def __iter__(self):

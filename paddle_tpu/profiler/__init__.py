@@ -29,13 +29,14 @@ import jax
 __all__ = [
     "RecordEvent", "record_event", "start_profiler", "stop_profiler",
     "reset_profiler", "profiler", "is_profiler_enabled", "export_chrome_tracing",
-    "snapshot_events",
+    "snapshot_events", "thread_names",
 ]
 
 _state = threading.local()
 _lock = threading.Lock()
 _enabled = False
 _events = []          # completed: (name, parent_path, start_ns, end_ns, tid)
+_tid_names = {}       # tid -> thread name at record time (export metadata)
 _trace_dir = None     # jax.profiler output dir when device tracing is on
 _start_wall_ns = 0
 _session = 0          # bumped by start/stop; pairs RecordEvent begin/end
@@ -94,9 +95,10 @@ class RecordEvent:
         if _enabled and self._session == _session:
             parent = "/".join(e.name for e in stack
                               if e._session == _session)
+            cur = threading.current_thread()
             with _lock:
-                _events.append((self.name, parent, self._t0, t1,
-                                threading.get_ident()))
+                _events.append((self.name, parent, self._t0, t1, cur.ident))
+                _tid_names[cur.ident] = cur.name
         if self._scope is not None:
             self._scope.__exit__(None, None, None)
             self._scope = None
@@ -219,6 +221,13 @@ def snapshot_events():
     that merge host ranges with other timelines (telemetry.export)."""
     with _lock:
         return list(_events), _start_wall_ns
+
+
+def thread_names():
+    """tid -> thread-name map observed while recording (chrome ``ph:"M"``
+    thread_name metadata in the merged export)."""
+    with _lock:
+        return dict(_tid_names)
 
 
 def export_chrome_tracing(path: str):

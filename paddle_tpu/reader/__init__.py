@@ -119,7 +119,8 @@ def buffered(reader, size):
             finally:
                 q.put(end)
 
-        t = threading.Thread(target=fill, daemon=True)
+        t = threading.Thread(target=fill, name="reader-buffered",
+                             daemon=True)
         t.start()
         while True:
             e = q.get()
@@ -184,9 +185,11 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
             finally:
                 out_q.put(end)
 
-        threading.Thread(target=feed, daemon=True).start()
-        for _ in range(process_num):
-            threading.Thread(target=work, daemon=True).start()
+        threading.Thread(target=feed, name="reader-xmap-feed",
+                         daemon=True).start()
+        for i in range(process_num):
+            threading.Thread(target=work, name=f"reader-xmap-{i}",
+                             daemon=True).start()
         done = 0
         while done < process_num:
             e = out_q.get()
@@ -221,8 +224,9 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             finally:
                 q.put(end)
 
-        for r in readers:
-            threading.Thread(target=run, args=(r,), daemon=True).start()
+        for i, r in enumerate(readers):
+            threading.Thread(target=run, args=(r,), name=f"reader-mp-{i}",
+                             daemon=True).start()
         done = 0
         while done < len(readers):
             e = q.get()

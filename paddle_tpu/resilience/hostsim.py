@@ -172,6 +172,15 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     from .runner import run_resilient
 
     telemetry.enable()
+    # Every simulated host records flight spans into a shared directory;
+    # the supervisor (or chaos_smoke) merges the per-host dumps rank-0
+    # style, keyed by process_index.
+    from ..telemetry import flight, tracing
+    _pidx = int(args.host.lstrip("host") or 0) \
+        if args.host.startswith("host") else 0
+    flight.configure(os.path.join(args.root, "flight"),
+                     process_index=_pidx)
+    tracing.enable()  # always-on ring: a hang dump shows recent spans
     trainer = _tiny_trainer(seed=args.seed, data_degree=2)
     loader = _SlowLoader(_tiny_batches(), delay=args.step_delay)
 
@@ -198,7 +207,8 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
                 pass
             hb_stop.wait(min(0.2, args.hb_timeout / 4))
 
-    threading.Thread(target=_beat, daemon=True).start()
+    threading.Thread(target=_beat, name="elastic-heartbeat",
+                     daemon=True).start()
     # rendezvous: wait for the full initial world before entering
     deadline = time.time() + 60.0
     while len(em.hosts()) < args.world and time.time() < deadline:

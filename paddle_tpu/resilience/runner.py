@@ -189,9 +189,12 @@ def run_resilient(trainer, loader: Iterable, steps: int,
     loop also registers a ``dirty_probe`` so a quarantine verdict that
     lands while a snapshot is in flight suppresses its commit."""
     from .. import telemetry
+    from ..telemetry import flight as _flight
+    from ..telemetry import tracing as _tracing
     from . import integrity
     from ..distributed.fleet.elastic import ElasticManager, ElasticStatus
     tel = telemetry.enabled()
+    _flight.install_signal_handler()   # SIGUSR2 -> dump (main thread only)
     if elastic is True:
         elastic = ElasticManager()
     # an elastic object that re-enters in place (elastic.ElasticRuntime)
@@ -229,6 +232,9 @@ def run_resilient(trainer, loader: Iterable, steps: int,
         watchdog = integrity.HangWatchdog(
             hang_timeout,
             heartbeat_fn=getattr(beat_src, "heartbeat", None),
+            # the flight dump happens INSIDE on_fire — before a hang_exit
+            # hard-exits the process, so the ring survives as a file
+            on_fire=lambda s: _flight.dump("hang_watchdog", step=s),
             exit_code=hang_exit).start()
 
     def _result(exit_code, status, loss=None):
@@ -305,6 +311,7 @@ def run_resilient(trainer, loader: Iterable, steps: int,
                     hasattr(elastic, "simulate_join"):
                 elastic.simulate_join()
             if stop.signum is not None:
+                _flight.dump("drain", step=step)
                 if manager is not None and step > 0 and not dirty:
                     _save(manager, trainer, step - 1, epoch, batch)
                     manager.wait_until_finished()
@@ -347,8 +354,14 @@ def run_resilient(trainer, loader: Iterable, steps: int,
                     it = iter(loader)
                     return next(it)
 
+            # one trace per step (tail-sampled: kept only when slow or
+            # when the step diverged / crashed)
+            tr_step = _tracing.start_trace("train_step", step=step)
+            sp_fetch = tr_step.span("fetch") if tr_step is not None else None
             inputs, labels = call_with_retry(
                 _fetch, site="dataloader_fetch", tries=3, base_delay=0.01)
+            if sp_fetch is not None:
+                sp_fetch.end()
 
             taint = float("nan") if faults.fires(
                 "nan_grad", step, site="train_step") else None
@@ -361,14 +374,21 @@ def run_resilient(trainer, loader: Iterable, steps: int,
             try:
                 if watchdog is not None:
                     watchdog.arm(step)
+                sp_run = (tr_step.span("step") if tr_step is not None
+                          else None)
                 try:
                     if faults.fires("host_hang", step, site="train_step"):
                         # wedge like a stuck collective; released by the
                         # watchdog firing (or its timeout backstop)
                         integrity.simulate_hang()
-                    last_loss = trainer.train_step(inputs, labels, lr=lr,
-                                                   grad_taint=taint)
+                    # ambient span: engine stage/ckpt-snapshot child
+                    # spans attach under this step
+                    with _tracing.use_span(sp_run):
+                        last_loss = trainer.train_step(
+                            inputs, labels, lr=lr, grad_taint=taint)
                 finally:
+                    if sp_run is not None:
+                        sp_run.end()
                     if watchdog is not None:
                         watchdog.disarm()
                 diverged = (trainer.consume_divergence()
@@ -381,6 +401,9 @@ def run_resilient(trainer, loader: Iterable, steps: int,
                     # Cleared below only by a verified rollback restore.
                     dirty = True
                     divergences += 1
+                    _flight.dump("divergence", step=step,
+                                 extra={"leaves": [str(x)
+                                                   for x in diverged]})
                     q = integrity.quarantine_outliers(
                         trainer, leaves=diverged, elastic=elastic)
                     quarantined += q["quarantined"]
@@ -399,6 +422,9 @@ def run_resilient(trainer, loader: Iterable, steps: int,
                             cur[0] if cur is not None else -1)
                         if cur is not None:
                             dirty = False
+                            if tr_step is not None:
+                                tr_step.close("divergence",
+                                              rollback_to=cur[0])
                             step, epoch, batch = cur[0] + 1, cur[1], cur[2]
                             it = _iter_from_cursor()
                             continue
@@ -419,8 +445,12 @@ def run_resilient(trainer, loader: Iterable, steps: int,
                 if manager is not None and not dirty and (
                         step % save_every == 0 or step == steps - 1):
                     _save(manager, trainer, step, epoch, batch)
+                if tr_step is not None:
+                    tr_step.close("divergence" if diverged else "ok")
                 step += 1
             except faults.SimulatedCrash:
+                if tr_step is not None:
+                    tr_step.close("failed")
                 restarts += 1
                 if tel:
                     telemetry.counter(

@@ -48,6 +48,7 @@ grad_sync_overlap_efficiency   gauge      analysis.cost.overlap_summary
                                           under backward compute)
 collective_calls_total         counter    collective.py, trace time {op=...}
 dataloader_fetch_seconds       histogram  io.DataLoader batch fetch
+dataloader_batches_total       counter    io.DataLoader batches served
 checkpoint_save_seconds        histogram  distributed.checkpoint
 checkpoint_restore_seconds     histogram  distributed.checkpoint
 checkpoint_bytes_total         counter    distributed.checkpoint {op=...}
@@ -147,6 +148,20 @@ predicted_reshard_collectives  gauge      engine.compile(analyze=True):
 predicted_reshard_seconds      gauge      modeled per-step wall seconds
                                           of that implicit resharding
                                           (ring model over axis_links)
+spans_recorded_total           counter    telemetry.tracing span ends
+                                          (every one also lands in the
+                                          flight-recorder ring)
+traces_kept_total              counter    tail-sampled traces kept at
+                                          close {reason=shed|expired|
+                                          failed|failover|divergence|
+                                          deadline|latency_percentile|
+                                          forced}
+flight_dumps_total             counter    flight-recorder ring dumps
+                                          written {reason=hang_watchdog|
+                                          divergence|drain|sigusr2|
+                                          slo_*}
+slo_alerts_total               counter    telemetry.slo rolling-window
+                                          burn-rate breaches {rule=...}
 =============================  =========  =================================
 
 Multi-host merge: ``telemetry.aggregate.gather_registries()`` allgathers
@@ -166,7 +181,7 @@ from .scope import TelemetryScope, scope  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "DEFAULT_BUCKETS",
-    "scope", "TelemetryScope", "aggregate",
+    "scope", "TelemetryScope", "aggregate", "tracing", "flight", "slo",
     "enable", "disable", "enabled", "is_enabled",
     "get_registry", "counter", "gauge", "histogram",
     "prometheus_text", "emit", "peak_flops_per_sec",
@@ -234,6 +249,9 @@ def emit(event: str, **fields):
 
 
 from . import aggregate  # noqa: E402,F401  (stdlib-only module, safe here)
+from . import flight  # noqa: E402,F401
+from . import slo  # noqa: E402,F401
+from . import tracing  # noqa: E402,F401
 
 
 def peak_flops_per_sec() -> float:

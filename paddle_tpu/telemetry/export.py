@@ -13,6 +13,7 @@ Three consumers, three formats:
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
 import time
@@ -25,6 +26,12 @@ __all__ = ["prometheus_text", "JsonlSink", "chrome_trace"]
 
 def _esc(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _esc_help(v: str) -> str:
+    # HELP text escapes only backslash and newline — a double quote is
+    # legal there and escaping it corrupts the exposition.
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _labels_str(key, extra: str = "") -> str:
@@ -46,13 +53,24 @@ def prometheus_text(registry: Registry) -> str:
     lines = []
     for m in registry.metrics():
         if m.help:
-            lines.append(f"# HELP {m.name} {_esc(m.help)}")
+            lines.append(f"# HELP {m.name} {_esc_help(m.help)}")
         lines.append(f"# TYPE {m.name} {m.kind}")
         if isinstance(m, Histogram):
-            for key, s in sorted(m.series().items()):
+            series = sorted(m.series().items())
+            if not series:
+                # A declared-but-unobserved histogram still needs a
+                # consistent scrape: +Inf bucket, _sum and _count at 0.
+                lines.append(f'{m.name}_bucket{{le="+Inf"}} 0')
+                lines.append(f"{m.name}_sum 0")
+                lines.append(f"{m.name}_count 0")
+            for key, s in series:
                 cum = 0
                 for ub, c in zip(m.buckets, s.counts):
                     cum += c
+                    if not math.isfinite(ub):
+                        # a user-supplied inf bound would duplicate the
+                        # +Inf line (and render as le="inf")
+                        continue
                     le = 'le="%s"' % _num(ub)
                     lines.append(
                         f"{m.name}_bucket{_labels_str(key, le)} {cum}")
@@ -92,13 +110,18 @@ class JsonlSink:
 
 
 def chrome_trace(path: str, registry: Optional[Registry] = None) -> dict:
-    """Write a chrome://tracing JSON merging profiler host ranges with the
-    registry's metric marks as counter events; returns the trace dict.
+    """Write a chrome://tracing JSON merging profiler host ranges, the
+    registry's metric marks (counter events), and kept-trace spans from
+    ``telemetry.tracing``; returns the trace dict.
 
-    Both sources are rebased to one origin = the earliest timestamp seen
-    across profiler start, host events, and marks — never negative.
+    All sources share the ``perf_counter_ns`` timebase and are rebased to
+    one origin = the earliest timestamp seen — never negative.  Threads
+    observed by the profiler or on kept spans get ``ph:"M"``
+    ``thread_name`` metadata so the committer / batcher / replica-worker
+    rows are readable in the viewer.
     """
     from .. import profiler as _profiler  # lazy: keep import graph acyclic
+    from . import tracing as _tracing
 
     events, start_wall_ns = _profiler.snapshot_events()
     marks = registry.marks() if registry is not None else []
@@ -106,6 +129,9 @@ def chrome_trace(path: str, registry: Optional[Registry] = None) -> dict:
     stamps = [start_wall_ns]
     stamps += [t0 for (_n, _p, t0, _t1, _tid) in events]
     stamps += [t for (t, _n, _k, _v) in marks]
+    span_t0 = _tracing.min_t0_ns()
+    if span_t0 is not None:
+        stamps.append(span_t0)
     base = min(stamps)
 
     pid = os.getpid()
@@ -124,6 +150,24 @@ def chrome_trace(path: str, registry: Optional[Registry] = None) -> dict:
             "ts": (t - base) / 1e3, "pid": pid, "tid": 0,
             "args": {args_key: value},
         })
+    trace_events += _tracing.chrome_events(base)
+
+    tid_names = {}
+    try:
+        tid_names.update(_profiler.thread_names())
+    except AttributeError:  # pragma: no cover - older profiler
+        pass
+    tid_names.update(_tracing.thread_names())
+    for th in threading.enumerate():   # fallback for still-live threads
+        tid_names.setdefault(th.ident, th.name)
+    seen_tids = {e["tid"] for e in trace_events}
+    for tid in sorted(t for t in seen_tids if t):
+        name = tid_names.get(tid)
+        if name:
+            trace_events.append({
+                "name": "thread_name", "ph": "M", "ts": 0,
+                "pid": pid, "tid": tid, "args": {"name": name},
+            })
     trace = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
     with open(path, "w", encoding="utf-8") as f:
         json.dump(trace, f)

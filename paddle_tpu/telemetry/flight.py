@@ -1,0 +1,238 @@
+"""paddle_tpu.telemetry.flight — always-on flight recorder.
+
+A bounded per-process ring buffer of the most recent completed spans
+(fed by ``telemetry.tracing`` on every span end — including spans whose
+traces are later dropped by tail sampling) plus the registry's recent
+metric marks.  When something anomalous happens the ring is dumped to
+``flight_<reason>_<step>.json`` so the "what was the process doing in
+the seconds before" question is answerable after the fact.
+
+Dump triggers wired across the repo:
+
+- hang watchdog fire       (resilience.runner → HangWatchdog.on_fire)
+- divergence quarantine    (resilience.runner integrity verdict)
+- drain                    (serving shutdown(drain=True), runner SIGTERM)
+- shed burn-rate breach    (telemetry.slo rolling-window monitor)
+- SIGUSR2                  (install_signal_handler; operator-initiated)
+
+Dumps land in the configured output directory (``configure(out_dir)``,
+set automatically by ``telemetry.scope(run_dir)``; overridable with
+``PADDLE_TPU_FLIGHT_DIR``).  Without a destination, ``dump`` is a no-op
+returning None — the ring itself always records.
+
+Multi-host: each process dumps locally; ``merge_dumps`` combines per-host
+dump files rank-0-side, tagging every metric series and span with
+``process_index`` via the same ``telemetry.aggregate`` key-tagging used
+for registry merges.  ``gather_via_coordinator``-style transport is not
+needed for dumps — they are files already, so the FileCoordinator root
+(or any shared directory) is the rendezvous.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = [
+    "FlightRecorder", "get_recorder", "configure", "record", "dump",
+    "spans_dumped", "install_signal_handler", "merge_dumps",
+    "find_dumps", "reset",
+]
+
+
+def _registry():
+    from paddle_tpu import telemetry
+    return telemetry.get_registry()
+
+
+class FlightRecorder:
+    """Ring of recent span records + dump-to-JSON on demand."""
+
+    def __init__(self, capacity: int = 2048, marks_tail: int = 4096):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self._marks_tail = marks_tail
+        self.out_dir: Optional[str] = None
+        self.process_index: int = 0
+        self.dumps: List[str] = []
+        self._spans_dumped = 0
+
+    # -- hot path ---------------------------------------------------------
+    def record(self, span_rec: dict):
+        self._ring.append(span_rec)   # deque.append is atomic
+
+    # -- configuration ----------------------------------------------------
+    def configure(self, out_dir: Optional[str], process_index: int = 0):
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+        self.out_dir = out_dir
+        self.process_index = process_index
+
+    def _resolve_dir(self) -> Optional[str]:
+        if self.out_dir:
+            return self.out_dir
+        env = os.environ.get("PADDLE_TPU_FLIGHT_DIR")
+        if env:
+            os.makedirs(env, exist_ok=True)
+            return env
+        return None
+
+    # -- dumping ----------------------------------------------------------
+    def dump(self, reason: str, step: Optional[int] = None,
+             extra: Optional[dict] = None) -> Optional[str]:
+        """Write the ring + a registry snapshot; returns the path or None
+        when no output directory is configured."""
+        out_dir = self._resolve_dir()
+        if out_dir is None:
+            return None
+        with self._lock:
+            spans = list(self._ring)
+            self._spans_dumped += len(spans)
+        reg = _registry()
+        marks = reg.marks()
+        payload = {
+            "reason": reason,
+            "step": int(step) if step is not None else 0,
+            "pid": os.getpid(),
+            "process_index": self.process_index,
+            "wall_time": time.time(),
+            "perf_counter_ns": time.perf_counter_ns(),
+            "spans": spans,
+            "metrics": reg.to_dict(),
+            # recent metric deltas: the tail of the registry's mark stream
+            # (timestamped per-observation events, when marks_enabled)
+            "marks": [list(m) for m in marks[-self._marks_tail:]],
+        }
+        if extra:
+            payload["extra"] = extra
+        base = f"flight_{reason}_{payload['step']}"
+        path = os.path.join(out_dir, base + ".json")
+        k = 0
+        while os.path.exists(path):  # same reason+step twice / shared dir
+            k += 1
+            path = os.path.join(out_dir, f"{base}_{os.getpid()}_{k}.json")
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except OSError:
+            return None
+        with self._lock:
+            self.dumps.append(path)
+        reg.counter("flight_dumps_total").inc(reason=reason)
+        return path
+
+    def spans_dumped(self) -> int:
+        with self._lock:
+            return self._spans_dumped
+
+    def ring_len(self) -> int:
+        return len(self._ring)
+
+
+_recorder = FlightRecorder()
+
+
+def get_recorder() -> FlightRecorder:
+    return _recorder
+
+
+def reset(capacity: int = 2048):
+    """Fresh recorder (tests); drops configuration and dump history."""
+    global _recorder
+    _recorder = FlightRecorder(capacity=capacity)
+
+
+def configure(out_dir: Optional[str], process_index: int = 0):
+    _recorder.configure(out_dir, process_index=process_index)
+
+
+def record(span_rec: dict):
+    _recorder.record(span_rec)
+
+
+def dump(reason: str, step: Optional[int] = None,
+         extra: Optional[dict] = None) -> Optional[str]:
+    return _recorder.dump(reason, step=step, extra=extra)
+
+
+def spans_dumped() -> int:
+    return _recorder.spans_dumped()
+
+
+def install_signal_handler(signum=None):
+    """Dump on SIGUSR2 (operator "what are you doing right now").  Only
+    possible from the main thread; elsewhere a no-op returning False."""
+    signum = signum if signum is not None else signal.SIGUSR2
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    prev = signal.getsignal(signum)
+
+    def _handler(sig, frame):
+        _recorder.dump("sigusr2")
+        if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
+            prev(sig, frame)
+
+    try:
+        signal.signal(signum, _handler)
+    except (ValueError, OSError):
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# rank-0 merge of per-host dumps
+
+def find_dumps(root: str, reason: Optional[str] = None) -> List[str]:
+    """All flight dump files under ``root`` (recursive), optionally
+    filtered by reason."""
+    out = []
+    prefix = f"flight_{reason}_" if reason else "flight_"
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in files:
+            if fn.startswith(prefix) and fn.endswith(".json"):
+                out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+def merge_dumps(paths: List[str], out_path: Optional[str] = None) -> dict:
+    """Merge per-host dump files rank-0-side.
+
+    Metric series are merged through
+    ``telemetry.aggregate.merge_process_dicts`` so every series key gains
+    a ``process_index=N`` label (per-host values stay distinct); spans
+    are concatenated with a ``process_index`` field.  ``process_index``
+    comes from the dump payload (written by each host's recorder).
+    """
+    from . import aggregate
+    snapshots: Dict[int, dict] = {}
+    spans: List[dict] = []
+    dumps_meta = []
+    for p in paths:
+        with open(p) as f:
+            d = json.load(f)
+        idx = int(d.get("process_index", len(snapshots)))
+        while idx in snapshots:   # two dumps from one host: keep both spans,
+            idx += 1000           # displace the duplicate metrics snapshot
+        snapshots[idx] = d.get("metrics", {})
+        for sp in d.get("spans", []):
+            sp = dict(sp)
+            sp["process_index"] = d.get("process_index", idx)
+            spans.append(sp)
+        dumps_meta.append({"path": p, "reason": d.get("reason"),
+                           "step": d.get("step"),
+                           "process_index": d.get("process_index", idx)})
+    merged = {
+        "dumps": dumps_meta,
+        "metrics": aggregate.merge_process_dicts(snapshots),
+        "spans": spans,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(merged, f)
+    return merged

@@ -55,6 +55,7 @@ def scope(run_dir: Optional[str] = None, fresh: bool = True,
 
     sink = None
     sc = TelemetryScope(reg, str(run_dir) if run_dir else None)
+    prev_flight_dir = None
     if sc.run_dir:
         os.makedirs(sc.run_dir, exist_ok=True)
         sink = JsonlSink(sc.jsonl_path)
@@ -62,6 +63,11 @@ def scope(run_dir: Optional[str] = None, fresh: bool = True,
         sink.emit({"event": "scope_start", "ts": time.time(),
                    "run_dir": sc.run_dir})
         reg.marks_enabled = True  # marks feed the chrome counter track
+        # flight-recorder dumps land next to the run's other artifacts
+        from . import flight
+        prev_flight_dir = flight.get_recorder().out_dir
+        flight.configure(sc.run_dir,
+                         process_index=flight.get_recorder().process_index)
 
     own_profiler = False
     if profile:
@@ -80,11 +86,19 @@ def scope(run_dir: Optional[str] = None, fresh: bool = True,
                 with open(sc.prom_path, "w", encoding="utf-8") as f:
                     f.write(prometheus_text(reg))
                 chrome_trace(sc.trace_path, reg)
+                from . import tracing
+                tracing.write_kept(
+                    os.path.join(sc.run_dir, "traces_kept.json"))
                 if sink is not None:
                     sink.emit({"event": "summary", "ts": time.time(),
                                "metrics": reg.to_dict()})
         finally:
             reg.marks_enabled = False
+            if sc.run_dir:
+                from . import flight
+                flight.configure(
+                    prev_flight_dir,
+                    process_index=flight.get_recorder().process_index)
             if sink is not None:
                 _set_sink(None)
                 sink.close()

@@ -1,0 +1,177 @@
+"""paddle_tpu.telemetry.slo — rolling-window burn-rate monitoring.
+
+A small SLO monitor over the metrics registry: each rule watches the
+ratio of two counter families (numerator / denominator, summed across
+label series) over a rolling time window and fires when the burn rate
+crosses a threshold — e.g. "more than 30% of admissions shed over the
+last 5 seconds".  Firing bumps ``slo_alerts_total{rule}`` and invokes
+the rule's callback; the default callback dumps the flight recorder
+(``flight_slo_<rule>_<step>.json`` via reason ``slo_<rule>``), which is
+how "shedding crossed a burn-rate threshold" becomes a post-mortem
+artifact.
+
+Hysteresis: a rule that fired stays latched until its burn rate falls
+below half the threshold, so a sustained breach produces one alert (and
+one dump), not one per poll.
+
+``poll(now=)`` takes an explicit timestamp so tests drive time directly;
+``maybe_poll`` rate-limits polling for hot-path callers (the serving
+admission path pokes it on shed).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional
+
+__all__ = ["SloRule", "SloMonitor", "get_monitor", "set_monitor",
+           "maybe_poll", "install_shed_rule", "reset"]
+
+
+def _registry():
+    from paddle_tpu import telemetry
+    return telemetry.get_registry()
+
+
+def _counter_total(reg, family: str) -> float:
+    m = reg.get(family)
+    if m is None:
+        return 0.0
+    try:
+        return float(sum(m.series().values()))
+    except Exception:
+        return 0.0
+
+
+class SloRule:
+    """Burn rate = Δnumerator / Δdenominator over ``window_s``."""
+
+    def __init__(self, name: str, numerator: str, denominator: str,
+                 threshold: float, window_s: float = 5.0,
+                 min_denominator: float = 10.0,
+                 on_alert: Optional[Callable] = None):
+        self.name = name
+        self.numerator = numerator
+        self.denominator = denominator
+        self.threshold = threshold
+        self.window_s = window_s
+        self.min_denominator = min_denominator
+        self.on_alert = on_alert
+        self._samples = deque()   # (t, num_total, den_total)
+        self.latched = False
+        self.alerts = 0
+        self.last_burn = 0.0
+
+    def sample(self, now: float, reg) -> Optional[float]:
+        """Record a sample; return the burn rate when the rule fires."""
+        num = _counter_total(reg, self.numerator)
+        den = _counter_total(reg, self.denominator)
+        self._samples.append((now, num, den))
+        while self._samples and self._samples[0][0] < now - self.window_s:
+            self._samples.popleft()
+        t0, num0, den0 = self._samples[0]
+        d_num, d_den = num - num0, den - den0
+        if d_den < self.min_denominator:
+            return None
+        burn = d_num / d_den if d_den > 0 else 0.0
+        self.last_burn = burn
+        if self.latched:
+            if burn < self.threshold / 2.0:
+                self.latched = False
+            return None
+        if burn > self.threshold:
+            self.latched = True
+            self.alerts += 1
+            return burn
+        return None
+
+
+class SloMonitor:
+    def __init__(self, rules: List[SloRule],
+                 registry=None, min_poll_interval_s: float = 0.25):
+        self.rules = rules
+        self._registry = registry
+        self.min_poll_interval_s = min_poll_interval_s
+        self._lock = threading.Lock()
+        self._last_poll = 0.0
+
+    def _reg(self):
+        return self._registry if self._registry is not None else _registry()
+
+    def poll(self, now: Optional[float] = None):
+        """Sample every rule; fire callbacks for threshold crossings."""
+        now = time.monotonic() if now is None else now
+        reg = self._reg()
+        fired = []
+        with self._lock:
+            self._last_poll = now
+            for rule in self.rules:
+                burn = rule.sample(now, reg)
+                if burn is not None:
+                    fired.append((rule, burn))
+        for rule, burn in fired:
+            reg.counter("slo_alerts_total").inc(rule=rule.name)
+            cb = rule.on_alert or _default_alert
+            try:
+                cb(rule, burn)
+            except Exception:
+                pass   # monitoring must never take down the monitored
+        return fired
+
+    def maybe_poll(self, now: Optional[float] = None):
+        """Rate-limited poll for hot-path callers; cheap when recently
+        polled."""
+        now = time.monotonic() if now is None else now
+        if now - self._last_poll < self.min_poll_interval_s:
+            return []
+        return self.poll(now)
+
+
+def _default_alert(rule: SloRule, burn: float):
+    from . import flight
+    flight.dump(f"slo_{rule.name}",
+                extra={"burn_rate": burn, "threshold": rule.threshold,
+                       "window_s": rule.window_s})
+
+
+_monitor: Optional[SloMonitor] = None
+
+
+def get_monitor() -> Optional[SloMonitor]:
+    return _monitor
+
+
+def set_monitor(monitor: Optional[SloMonitor]):
+    global _monitor
+    _monitor = monitor
+
+
+def reset():
+    set_monitor(None)
+
+
+def install_shed_rule(threshold: float = 0.3, window_s: float = 5.0,
+                      min_denominator: float = 10.0,
+                      on_alert: Optional[Callable] = None) -> SloMonitor:
+    """The default serving SLO: shed burn rate over admissions.
+
+    ``serving_requests_shed_total / serving_requests_total`` over the
+    window; on breach, flight-dump with reason ``slo_shed_burn``.
+    """
+    mon = SloMonitor([
+        SloRule("shed_burn",
+                numerator="serving_requests_shed_total",
+                denominator="serving_requests_total",
+                threshold=threshold, window_s=window_s,
+                min_denominator=min_denominator, on_alert=on_alert),
+    ])
+    set_monitor(mon)
+    return mon
+
+
+def maybe_poll():
+    """Module-level poke used by hot paths; no-op without a monitor."""
+    mon = _monitor
+    if mon is not None:
+        mon.maybe_poll()

@@ -1,0 +1,494 @@
+"""paddle_tpu.telemetry.tracing — Dapper-style tail-sampled request tracing.
+
+A trace follows ONE unit of work (a serving request, a training step, an
+async checkpoint save) through every thread it touches.  Spans share the
+``time.perf_counter_ns()`` timebase with the profiler's host events and
+the registry's metric marks, so kept traces merge into the same
+chrome-trace timeline (``telemetry.export.chrome_trace``).
+
+Design (tail sampling, after Dapper / modern OTel tail collectors):
+
+- Recording is always cheap: a span is a plain object; ending it appends
+  one dict to the flight-recorder ring (``telemetry.flight``) and bumps a
+  counter.  No I/O, no serialization on the hot path.
+- The keep/drop decision happens once, at *trace close*, when the outcome
+  is known: traces are kept only when they ended in shed / expired /
+  failed, failed over between replicas, blew a fraction of their
+  deadline, or landed above a rolling latency percentile.  Everything
+  else is dropped on the spot — steady-state cost is the ring append.
+- When tracing is disabled (the default), instrumentation sites perform a
+  single module-global read (``tracing.enabled()``) and allocate nothing.
+
+Cross-thread context is handed off *explicitly*: a ``Span`` object is
+carried on the request / staged-snapshot / job object from the thread
+that opened it to the thread that closes it.  The thread-local
+``use_span``/``add_event`` pair exists only for *ambient* event
+attachment (e.g. the KV cache reporting hits/evictions without threading
+a span through its signature); it never implicitly propagates across
+thread boundaries.
+
+Accounting is closed: every recorded span is classified kept or dropped
+at trace close (late spans ending after their trace closed count as
+dropped), and ``accounted()`` checks
+``recorded == kept + dropped + still-open``.  Spans written into a
+flight dump are counted separately (``spans_dumped``) — dumping is
+orthogonal to the keep/drop decision, a dumped span may be either.
+
+Counters (see the telemetry catalogue): ``spans_recorded_total``,
+``traces_kept_total{reason}``.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "Span", "Trace", "Tracer", "KeepPolicy",
+    "enable", "disable", "enabled", "get_tracer", "reset",
+    "start_trace", "use_span", "current_span", "add_event", "child_span",
+    "snapshot_kept", "write_kept", "accounting", "accounted",
+]
+
+_KEEP_OUTCOMES = ("shed", "expired", "failed", "failover", "divergence")
+
+
+def _registry():
+    from paddle_tpu import telemetry
+    return telemetry.get_registry()
+
+
+class KeepPolicy:
+    """Tail-sampling rules evaluated once, at trace close.
+
+    Rules (first match wins, reason becomes the ``traces_kept_total``
+    label): bad outcome, failover (any re-dispatch), duration over
+    ``deadline_fraction`` of the trace's deadline, duration above the
+    rolling ``latency_percentile`` of recent closes (needs at least
+    ``percentile_min_samples`` priors).  ``keep_all``/``keep_none``
+    override everything — ``keep_none`` is what the overhead bench uses
+    to measure record-everything-keep-nothing steady state.
+    """
+
+    def __init__(self, keep_outcomes=_KEEP_OUTCOMES, deadline_fraction=0.9,
+                 latency_percentile=0.99, percentile_min_samples=50,
+                 keep_all=False, keep_none=False, reservoir=512):
+        self.keep_outcomes = frozenset(keep_outcomes)
+        self.deadline_fraction = deadline_fraction
+        self.latency_percentile = latency_percentile
+        self.percentile_min_samples = percentile_min_samples
+        self.keep_all = keep_all
+        self.keep_none = keep_none
+        self._latencies = deque(maxlen=reservoir)
+        self._closes = 0
+        self._cached_threshold = None
+
+    def _percentile_threshold(self):
+        n = len(self._latencies)
+        if n < self.percentile_min_samples:
+            return None
+        # Recompute every 64 closes; a stale threshold only shifts which
+        # borderline traces are kept, never breaks accounting.
+        if self._cached_threshold is None or self._closes % 64 == 0:
+            xs = sorted(self._latencies)
+            idx = min(n - 1, int(self.latency_percentile * n))
+            self._cached_threshold = xs[idx]
+        return self._cached_threshold
+
+    def decide(self, outcome: str, duration_s: float,
+               deadline_s: Optional[float], failover: bool) -> Optional[str]:
+        """Return the keep reason, or None to drop."""
+        self._closes += 1
+        try:
+            if self.keep_none:
+                return None
+            if self.keep_all:
+                return "forced"
+            if outcome in self.keep_outcomes:
+                return outcome
+            if failover:
+                return "failover"
+            if deadline_s and duration_s > self.deadline_fraction * deadline_s:
+                return "deadline"
+            thr = self._percentile_threshold()
+            if thr is not None and duration_s > thr:
+                return "latency_percentile"
+            return None
+        finally:
+            self._latencies.append(duration_s)
+
+
+class Span:
+    """One timed operation inside a trace.
+
+    Carry the object itself across threads for explicit handoff; ``end``
+    may be called from a different thread than the one that opened it
+    (the recording notes both threads' identities).
+    """
+
+    __slots__ = ("trace", "span_id", "parent_id", "name", "t0_ns", "t1_ns",
+                 "attrs", "tid", "thread_name", "status", "events", "_ended")
+
+    def __init__(self, trace: "Trace", span_id: int, parent_id: Optional[int],
+                 name: str, attrs: Dict[str, Any]):
+        self.trace = trace
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.t0_ns = time.perf_counter_ns()
+        self.t1_ns = None
+        self.attrs = attrs
+        cur = threading.current_thread()
+        self.tid = cur.ident
+        self.thread_name = cur.name
+        self.status = "open"
+        self.events: List[dict] = []
+        self._ended = False
+
+    def event(self, name: str, **attrs):
+        """Attach a point-in-time event to this span (thread-safe append)."""
+        self.events.append({"t_ns": time.perf_counter_ns(), "name": name,
+                            **attrs})
+
+    def end(self, status: str = "ok", **attrs):
+        if self._ended:
+            return
+        self._ended = True
+        self.t1_ns = time.perf_counter_ns()
+        self.status = status
+        if attrs:
+            self.attrs.update(attrs)
+        end_thread = threading.current_thread()
+        if end_thread.ident != self.tid:
+            self.attrs.setdefault("end_thread", end_thread.name)
+        self.trace._span_ended(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.end("error" if exc_type is not None else "ok")
+        return False
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace.trace_id, "span_id": self.span_id,
+            "parent_id": self.parent_id, "name": self.name,
+            "t0_ns": self.t0_ns, "t1_ns": self.t1_ns,
+            "tid": self.tid, "thread": self.thread_name,
+            "status": self.status, "attrs": self.attrs,
+            "events": list(self.events),
+        }
+
+
+class Trace:
+    """A tree of spans under one root; closed exactly once with an outcome."""
+
+    def __init__(self, tracer: "Tracer", trace_id: str, name: str,
+                 attrs: Dict[str, Any]):
+        self.tracer = tracer
+        self.trace_id = trace_id
+        self.name = name
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._spans: List[Span] = []       # ended spans, recorded order
+        self._open = 0                     # spans begun but not ended
+        self._ended_pending = 0            # ended spans awaiting close
+        self.closed = False
+        self.outcome: Optional[str] = None
+        self.keep_reason: Optional[str] = None
+        self.root = self.span(name, parent=None, **attrs)
+
+    def span(self, name: str, parent: Optional[Span] = None, **attrs) -> Span:
+        """Open a child span.  ``parent`` defaults to the root span."""
+        with self._lock:
+            sid = next(self._ids)
+            pid = None
+            if sid > 1:
+                pid = (parent.span_id if parent is not None
+                       else self.root.span_id)
+            self._open += 1
+        return Span(self, sid, pid, name, dict(attrs))
+
+    def _span_ended(self, span: Span):
+        rec = span.to_dict()
+        with self._lock:
+            self._open -= 1
+            late = self.closed
+            if not late:
+                self._spans.append(rec)
+                self._ended_pending += 1
+        self.tracer._record(rec, late=late)
+
+    def close(self, outcome: str, deadline_s: Optional[float] = None,
+              failover: bool = False, **attrs):
+        """End the root (if still open) and run the keep/drop decision."""
+        if attrs:
+            self.root.attrs.update(attrs)
+        if not self.root._ended:
+            self.root.end(outcome)
+        with self._lock:
+            if self.closed:
+                return
+            self.closed = True
+            spans = list(self._spans)
+            pending = self._ended_pending
+            self._ended_pending = 0
+        dur_s = (self.root.t1_ns - self.root.t0_ns) / 1e9
+        self.outcome = outcome
+        self.tracer._close(self, spans, pending, outcome, dur_s,
+                           deadline_s, failover)
+
+
+class Tracer:
+    """Process-wide span recorder with tail sampling and closed accounting."""
+
+    def __init__(self, policy: Optional[KeepPolicy] = None, kept_max=256):
+        self.policy = policy or KeepPolicy()
+        self._lock = threading.Lock()
+        self._kept = deque(maxlen=kept_max)   # trace dicts
+        self._ids = itertools.count(1)
+        self.traces_started = 0
+        self.traces_closed = 0
+        self.spans_recorded = 0
+        self.spans_kept = 0
+        self.spans_dropped = 0
+        self._pending = 0    # ended spans inside still-open traces
+
+    def start_trace(self, name: str, **attrs) -> Trace:
+        with self._lock:
+            self.traces_started += 1
+            tid = f"t{next(self._ids):08x}"
+        return Trace(self, tid, name, attrs)
+
+    def _record(self, rec: dict, late: bool = False):
+        from . import flight
+        with self._lock:
+            self.spans_recorded += 1
+            if late:
+                self.spans_dropped += 1   # trace already closed: drop now
+            else:
+                self._pending += 1
+        flight.record(rec)
+        reg = _registry()
+        reg.counter("spans_recorded_total").inc()
+
+    def _close(self, trace: Trace, spans: List[dict], pending: int,
+               outcome: str, dur_s: float, deadline_s, failover: bool):
+        reason = self.policy.decide(outcome, dur_s, deadline_s, failover)
+        with self._lock:
+            self.traces_closed += 1
+            self._pending -= pending
+            if reason is not None:
+                self.spans_kept += pending
+            else:
+                self.spans_dropped += pending
+            if reason is not None:
+                trace.keep_reason = reason
+                self._kept.append({
+                    "trace_id": trace.trace_id, "name": trace.name,
+                    "outcome": outcome, "keep_reason": reason,
+                    "duration_s": dur_s, "deadline_s": deadline_s,
+                    "spans": spans,
+                })
+        if reason is not None:
+            _registry().counter(
+                "traces_kept_total").inc(reason=reason)
+
+    def snapshot_kept(self) -> List[dict]:
+        with self._lock:
+            return list(self._kept)
+
+    def accounting(self) -> dict:
+        from . import flight
+        with self._lock:
+            return {
+                "traces_started": self.traces_started,
+                "traces_closed": self.traces_closed,
+                "recorded": self.spans_recorded,
+                "kept": self.spans_kept,
+                "dropped": self.spans_dropped,
+                "open": self._pending,
+                "dumped": flight.spans_dumped(),
+            }
+
+    def accounted(self) -> bool:
+        """Closed accounting: every recorded span is kept, dropped, or
+        still inside an open trace (and dumps never exceed recordings)."""
+        a = self.accounting()
+        return (a["recorded"] == a["kept"] + a["dropped"] + a["open"]
+                and a["dumped"] >= 0)
+
+
+# ---------------------------------------------------------------------------
+# module-level state: one tracer, one enabled flag, a thread-local span stack
+
+_enabled = False
+_tracer = Tracer()
+_local = threading.local()
+
+
+def enable(on: bool = True, policy: Optional[KeepPolicy] = None,
+           kept_max: int = 256):
+    """Turn span recording on (optionally with a fresh policy/tracer).
+
+    Passing ``policy`` (or calling ``reset``) swaps in a new tracer so
+    accounting starts from zero — what tests and benches want.
+    """
+    global _enabled, _tracer
+    if policy is not None:
+        _tracer = Tracer(policy=policy, kept_max=kept_max)
+    _enabled = bool(on)
+
+
+def disable():
+    enable(False)
+
+
+def enabled() -> bool:
+    """The one check every instrumentation site makes per span.
+
+    When False, sites skip span creation entirely — zero allocation on
+    the hot path (verified by tests/test_tracing.py).
+    """
+    return _enabled
+
+
+def reset(policy: Optional[KeepPolicy] = None, kept_max: int = 256):
+    """Fresh tracer (zeroed accounting); keeps the enabled flag as-is."""
+    global _tracer
+    _tracer = Tracer(policy=policy, kept_max=kept_max)
+
+
+def get_tracer() -> Tracer:
+    return _tracer
+
+
+def start_trace(name: str, **attrs) -> Optional[Trace]:
+    """Open a trace, or return None when tracing is disabled."""
+    if not _enabled:
+        return None
+    return _tracer.start_trace(name, **attrs)
+
+
+def _stack():
+    st = getattr(_local, "stack", None)
+    if st is None:
+        st = _local.stack = []
+    return st
+
+
+class use_span:
+    """Make ``span`` the thread's ambient span for ``add_event`` /
+    ``child_span`` callers that can't receive it explicitly (e.g. the KV
+    cache).  Accepts None (no-op) so call sites don't need a branch."""
+
+    def __init__(self, span: Optional[Span]):
+        self.span = span
+
+    def __enter__(self):
+        if self.span is not None:
+            _stack().append(self.span)
+        return self.span
+
+    def __exit__(self, *exc):
+        if self.span is not None:
+            st = _stack()
+            if st and st[-1] is self.span:
+                st.pop()
+            else:  # defensive: remove by identity wherever it is
+                try:
+                    st.remove(self.span)
+                except ValueError:
+                    pass
+        return False
+
+
+def current_span() -> Optional[Span]:
+    st = getattr(_local, "stack", None)
+    return st[-1] if st else None
+
+
+def add_event(name: str, **attrs):
+    """Attach an event to the thread's ambient span; no-op without one.
+
+    This is the zero-signature-change hook: deep call sites (KV cache
+    eviction, page pinning) report into whatever span their caller
+    established with ``use_span``."""
+    if not _enabled:
+        return
+    sp = current_span()
+    if sp is not None and not sp._ended:
+        sp.event(name, **attrs)
+
+
+def child_span(name: str, **attrs) -> Optional[Span]:
+    """Open a child of the thread's ambient span; None without one."""
+    if not _enabled:
+        return None
+    sp = current_span()
+    if sp is None:
+        return None
+    return sp.trace.span(name, parent=sp, **attrs)
+
+
+def snapshot_kept() -> List[dict]:
+    return _tracer.snapshot_kept()
+
+
+def write_kept(path: str) -> Optional[str]:
+    """Write kept traces to ``path`` as JSON; None when nothing was kept."""
+    kept = _tracer.snapshot_kept()
+    if not kept:
+        return None
+    with open(path, "w") as f:
+        json.dump({"traces": kept}, f, indent=1)
+    return path
+
+
+def accounting() -> dict:
+    return _tracer.accounting()
+
+
+def accounted() -> bool:
+    return _tracer.accounted()
+
+
+def chrome_events(base_ns: int) -> List[dict]:
+    """Kept-trace spans as chrome-trace ``ph:"X"`` events (rebased to
+    ``base_ns``), for the merged ``telemetry.export.chrome_trace``."""
+    import os
+    out = []
+    pid = os.getpid()
+    for tr in _tracer.snapshot_kept():
+        for sp in tr["spans"]:
+            if sp["t1_ns"] is None:
+                continue
+            out.append({
+                "name": sp["name"], "cat": "trace", "ph": "X",
+                "ts": (sp["t0_ns"] - base_ns) / 1e3,
+                "dur": (sp["t1_ns"] - sp["t0_ns"]) / 1e3,
+                "pid": pid, "tid": sp["tid"],
+                "args": {"trace_id": sp["trace_id"],
+                         "status": sp["status"], **sp["attrs"]},
+            })
+    return out
+
+
+def thread_names() -> Dict[int, str]:
+    """tid -> thread-name map observed on recorded spans (kept traces)."""
+    names: Dict[int, str] = {}
+    for tr in _tracer.snapshot_kept():
+        for sp in tr["spans"]:
+            names[sp["tid"]] = sp["thread"]
+    return names
+
+
+def min_t0_ns() -> Optional[int]:
+    """Earliest span start among kept traces (for export rebasing)."""
+    t0s = [sp["t0_ns"] for tr in _tracer.snapshot_kept()
+           for sp in tr["spans"]]
+    return min(t0s) if t0s else None

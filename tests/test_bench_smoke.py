@@ -183,6 +183,11 @@ def test_chaos_sdc_scenario():
     assert res["restored_step"] == 4
     assert res["fingerprint_collectives_nocheck"] == 0
     assert res["fingerprint_collectives_check"] > 0
+    # the divergence verdict must have dumped the flight ring and the
+    # tainted step's trace must be tail-kept, with closed accounting
+    assert res["flight_dumps_divergence"] >= 1
+    assert res["kept_divergence_traces"] >= 1
+    assert res["trace_accounting_closed"] is True
 
 
 @pytest.mark.slow
@@ -203,6 +208,11 @@ def test_chaos_host_hang_scenario():
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert res["hosts_hung"] == 1
     assert res["remeshes"] >= 1
+    # the wedged host's watchdog flight-dumped before os._exit, tagged
+    # with its process_index, and the per-host dumps merge rank-0 side
+    assert res["flight_dumps_hang"] == 1
+    assert res["hang_dump_hosts"] == [1]
+    assert res["merged_span_count"] > 0
 
 
 def test_fsck_ckpt_smoke():
@@ -272,6 +282,12 @@ def test_bench_ckpt_smoke():
     assert extra["bitwise_identical"] is True
     assert all(extra["telemetry_series"].values())
     assert extra["accounting"]["accounted"] is True
+    assert res["schema_version"] >= 1
+    # every ckpt_save trace kept (snapshot on the step thread, commit on
+    # the committer) and written to the run dir for trace_view
+    assert extra["ckpt_traces_kept"] >= 1
+    assert extra["trace_accounting_closed"] is True
+    assert extra["kept_traces_path"]
 
 
 @pytest.mark.slow
@@ -335,6 +351,40 @@ def test_bench_serving_smoke():
     assert extra["accounted"] is True
     assert extra["serving_recompiles_total"]["closed"] is True
     assert extra["telemetry"]["prometheus_bytes"] > 0
+    # tracing acceptance: always-on recording with nothing kept costs
+    # <= 3% p50, the disabled path allocates nothing, the failover phase
+    # tail-keeps traces, and the drain shutdown wrote a flight dump
+    assert res["schema_version"] >= 1
+    tr = extra["tracing"]
+    assert tr["overhead_frac"] is not None and tr["overhead_frac"] <= 0.03
+    assert tr["spans_recorded"] > 0 and tr["kept_while_keep_none"] == 0
+    assert tr["failover_traces_kept"] >= 1
+    assert tr["kept_traces_path"]
+    assert any("flight_drain_" in p for p in extra["flight_dumps"])
+
+
+def test_metric_catalogue_in_sync():
+    """tools/check_metric_catalogue.py: every metric registered in the
+    source tree has a catalogue row in paddle_tpu/telemetry/__init__.py
+    and vice versa — catalogue drift fails tier-1 here."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "check_metric_catalogue.py")],
+        capture_output=True, text=True, timeout=120, env=_env())
+    assert proc.returncode == 0, proc.stdout + proc.stderr[-1000:]
+    assert "catalogue ok" in proc.stdout
+
+
+def test_trace_view_smoke():
+    """tools/trace_view.py --smoke: the text summariser renders a
+    synthetic kept trace (waterfall, events, slowest-span table)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_view.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=120, env=_env())
+    assert proc.returncode == 0, proc.stdout + proc.stderr[-1000:]
+    res = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert res["exit_code"] == 0 and all(res["checks"].values()), res
 
 
 def test_numerics_smoke_cpu():

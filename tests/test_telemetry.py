@@ -109,6 +109,46 @@ class TestPrometheusText:
         text = prometheus_text(reg)
         assert 'path="a\\"b\\\\c"' in text
 
+    def test_label_newline_escaping(self):
+        reg = Registry()
+        reg.counter("c").inc(path="a\nb")
+        text = prometheus_text(reg)
+        assert 'path="a\\nb"' in text
+        # the exposition stays line-oriented: no raw newline inside a
+        # label value
+        assert all(line.count('"') % 2 == 0
+                   for line in text.splitlines())
+
+    def test_empty_histogram_scrapes_consistently(self):
+        # a declared-but-unobserved histogram must still expose the
+        # +Inf bucket, _sum and _count (at 0) — scrapers reject a TYPE
+        # histogram with no samples
+        reg = Registry()
+        reg.histogram("lat", "latency", buckets=(0.1, 1.0))
+        text = prometheus_text(reg)
+        assert 'lat_bucket{le="+Inf"} 0' in text
+        assert "lat_sum 0" in text
+        assert "lat_count 0" in text
+
+    def test_help_keeps_double_quotes_escapes_newline(self):
+        # HELP text escapes ONLY backslash and newline; a double quote
+        # is legal and escaping it corrupts the exposition
+        reg = Registry()
+        reg.counter("c", 'fraction of "bad" rows\nsecond line')
+        text = prometheus_text(reg)
+        assert '# HELP c fraction of "bad" rows\\nsecond line' in text
+
+    def test_nonfinite_histogram_bound_not_duplicated(self):
+        import math as _math
+        reg = Registry()
+        h = reg.histogram("lat", buckets=(0.1, _math.inf))
+        h.observe(0.05)
+        text = prometheus_text(reg)
+        # the user-supplied inf bound must not render as le="inf"
+        # alongside the synthesized +Inf line
+        assert text.count('le="+Inf"') == 1
+        assert 'le="inf"' not in text
+
 
 def test_jsonl_sink_append_and_close(tmp_path):
     p = tmp_path / "events.jsonl"

@@ -1,0 +1,490 @@
+"""End-to-end tracing + flight recorder (ISSUE 15): the span model and
+explicit cross-thread handoff, tail-sampling keep/drop, the
+decode-failover span-tree walk (one kept trace covering admission wait,
+both dispatch attempts across the replica respawn, KV events, and every
+decode re-entry), the zero-allocation disabled path, flight-dump
+triggers (SIGUSR2, SLO shed burn rate), and the rank-0 merge of
+per-host dumps."""
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import telemetry
+from paddle_tpu.resilience import faults
+from paddle_tpu.telemetry import flight, slo, tracing
+from paddle_tpu.telemetry.export import chrome_trace
+from paddle_tpu.telemetry.metrics import Registry
+from paddle_tpu.telemetry.slo import SloMonitor, SloRule
+from paddle_tpu.telemetry.tracing import KeepPolicy, Tracer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_trace_state():
+    tracing.disable()
+    tracing.reset()
+    flight.reset()
+    slo.reset()
+    yield
+    faults.reset()
+    tracing.disable()
+    tracing.reset()
+    flight.reset()
+    slo.reset()
+
+
+# ---------------------------------------------------------------------------
+# span model
+
+
+class TestSpanModel:
+    def test_span_tree_parent_ids(self):
+        tr = Tracer(policy=KeepPolicy(keep_all=True))
+        t = tr.start_trace("work", job=7)
+        a = t.span("phase_a")
+        b = t.span("inner", parent=a)
+        b.end("ok")
+        a.end("ok")
+        t.close("completed")
+        [kept] = tr.snapshot_kept()
+        by_id = {s["span_id"]: s for s in kept["spans"]}
+        root = [s for s in kept["spans"] if s["parent_id"] is None]
+        assert len(root) == 1 and root[0]["name"] == "work"
+        assert root[0]["attrs"]["job"] == 7
+        sa = next(s for s in kept["spans"] if s["name"] == "phase_a")
+        sb = next(s for s in kept["spans"] if s["name"] == "inner")
+        assert sa["parent_id"] == root[0]["span_id"]
+        assert sb["parent_id"] == sa["span_id"]
+        assert all(s["t1_ns"] >= s["t0_ns"] for s in by_id.values())
+
+    def test_cross_thread_handoff_records_end_thread(self):
+        # the explicit handoff contract: the Span object is carried to
+        # another thread, which ends it — recording both identities
+        tr = Tracer(policy=KeepPolicy(keep_all=True))
+        t = tr.start_trace("ckpt_save")
+        sp = t.span("commit")
+
+        def _finish():
+            sp.end("committed")
+            t.close("committed")
+
+        th = threading.Thread(target=_finish, name="committer-sim")
+        th.start()
+        th.join()
+        [kept] = tr.snapshot_kept()
+        commit = next(s for s in kept["spans"] if s["name"] == "commit")
+        assert commit["thread"] == threading.current_thread().name
+        assert commit["attrs"]["end_thread"] == "committer-sim"
+        root = next(s for s in kept["spans"] if s["parent_id"] is None)
+        assert root["attrs"]["end_thread"] == "committer-sim"
+
+    def test_late_span_counts_dropped_and_accounting_closes(self):
+        tr = Tracer(policy=KeepPolicy(keep_all=True))
+        t = tr.start_trace("work")
+        straggler = t.span("late")
+        t.close("completed")
+        assert tr.accounting()["recorded"] == 1   # root only, so far
+        straggler.end("ok")         # ends after its trace closed
+        a = tr.accounting()
+        assert a["recorded"] == 2 and a["dropped"] == 1 and a["open"] == 0
+        assert a["recorded"] == a["kept"] + a["dropped"]
+        assert tr.accounted()
+        # the late span never joins the kept trace's tree
+        [kept] = tr.snapshot_kept()
+        assert all(s["name"] != "late" for s in kept["spans"])
+
+    def test_events_attach_in_order(self):
+        tr = Tracer(policy=KeepPolicy(keep_all=True))
+        t = tr.start_trace("work")
+        sp = t.span("io")
+        sp.event("read", bytes=10)
+        sp.event("write", bytes=20)
+        sp.end("ok")
+        t.close("completed")
+        [kept] = tr.snapshot_kept()
+        io = next(s for s in kept["spans"] if s["name"] == "io")
+        assert [e["name"] for e in io["events"]] == ["read", "write"]
+        assert io["events"][0]["t_ns"] <= io["events"][1]["t_ns"]
+
+
+class TestKeepPolicy:
+    def test_bad_outcomes_and_failover_kept(self):
+        p = KeepPolicy()
+        assert p.decide("shed", 0.01, None, False) == "shed"
+        assert p.decide("failed", 0.01, None, False) == "failed"
+        assert p.decide("completed", 0.01, None, True) == "failover"
+        assert p.decide("completed", 0.01, None, False) is None
+
+    def test_deadline_fraction(self):
+        p = KeepPolicy(deadline_fraction=0.9)
+        assert p.decide("completed", 0.95, 1.0, False) == "deadline"
+        assert p.decide("completed", 0.5, 1.0, False) is None
+
+    def test_latency_percentile_needs_priors(self):
+        p = KeepPolicy(percentile_min_samples=50)
+        for _ in range(60):
+            assert p.decide("completed", 0.001, None, False) is None
+        assert p.decide("completed", 10.0, None, False) \
+            == "latency_percentile"
+
+    def test_keep_none_overrides_everything(self):
+        p = KeepPolicy(keep_none=True)
+        assert p.decide("failed", 10.0, 1.0, True) is None
+
+    def test_keep_all(self):
+        p = KeepPolicy(keep_all=True)
+        assert p.decide("completed", 0.0, None, False) == "forced"
+
+
+class TestDisabledPath:
+    def test_everything_noops_and_allocates_no_spans(self):
+        assert not tracing.enabled()
+        assert tracing.start_trace("x") is None
+        assert tracing.child_span("y") is None
+        with tracing.use_span(None):
+            tracing.add_event("ev", k=1)     # no ambient span: no-op
+        a = tracing.accounting()
+        assert a["recorded"] == 0 and a["traces_started"] == 0
+
+    def test_enable_disable_roundtrip(self):
+        tracing.enable()
+        t = tracing.start_trace("x")
+        assert t is not None
+        t.close("completed")
+        tracing.disable()
+        assert tracing.start_trace("x") is None
+        assert tracing.accounted()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+
+class TestFlightRecorder:
+    def test_dump_payload_and_filename(self, tmp_path):
+        flight.configure(str(tmp_path), process_index=3)
+        flight.record({"name": "s1", "t0_ns": 1, "t1_ns": 2})
+        path = flight.dump("hang_watchdog", step=12,
+                           extra={"stalled": "host1"})
+        assert path is not None
+        assert os.path.basename(path) == "flight_hang_watchdog_12.json"
+        with open(path) as f:
+            d = json.load(f)
+        assert d["reason"] == "hang_watchdog" and d["step"] == 12
+        assert d["process_index"] == 3
+        assert d["spans"] == [{"name": "s1", "t0_ns": 1, "t1_ns": 2}]
+        assert d["extra"] == {"stalled": "host1"}
+        assert "metrics" in d and "marks" in d
+        assert flight.spans_dumped() == 1
+
+    def test_same_reason_step_twice_never_clobbers(self, tmp_path):
+        flight.configure(str(tmp_path))
+        p1 = flight.dump("drain", step=0)
+        p2 = flight.dump("drain", step=0)
+        assert p1 != p2 and os.path.exists(p1) and os.path.exists(p2)
+
+    def test_dump_without_destination_is_noop(self):
+        assert flight.get_recorder()._resolve_dir() is None
+        assert flight.dump("drain") is None
+        assert flight.spans_dumped() == 0
+
+    def test_env_var_destination(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path / "env"))
+        path = flight.dump("sigusr2")
+        assert path is not None and str(tmp_path / "env") in path
+
+    def test_ring_bounds_memory(self):
+        flight.reset(capacity=4)
+        for i in range(10):
+            flight.record({"i": i})
+        rec = flight.get_recorder()
+        assert rec.ring_len() == 4
+
+    def test_find_dumps_filters_by_reason(self, tmp_path):
+        flight.configure(str(tmp_path / "h0"))
+        flight.dump("divergence", step=5)
+        flight.dump("drain", step=5)
+        root = str(tmp_path)
+        assert len(flight.find_dumps(root)) == 2
+        div = flight.find_dumps(root, reason="divergence")
+        assert len(div) == 1 and "flight_divergence_5" in div[0]
+
+    def test_merge_dumps_tags_process_index(self, tmp_path):
+        reg = telemetry.get_registry()
+        paths = []
+        for pidx in (0, 1):
+            flight.reset()
+            flight.configure(str(tmp_path / f"h{pidx}"),
+                             process_index=pidx)
+            flight.record({"name": f"span_h{pidx}", "t0_ns": 1,
+                           "t1_ns": 2})
+            paths.append(flight.dump("hang_watchdog", step=9))
+        out = str(tmp_path / "merged.json")
+        merged = flight.merge_dumps(paths, out_path=out)
+        assert {s["process_index"] for s in merged["spans"]} == {0, 1}
+        assert {m["process_index"] for m in merged["dumps"]} == {0, 1}
+        # per-host metric series stay distinct via process_index labels
+        flat = json.dumps(merged["metrics"])
+        assert "process_index" in flat
+        with open(out) as f:
+            assert json.load(f)["spans"] == merged["spans"]
+
+    def test_merge_duplicate_process_index_keeps_all_spans(self, tmp_path):
+        paths = []
+        for k in range(2):   # two dumps claiming the same host index
+            flight.reset()
+            flight.configure(str(tmp_path / f"d{k}"), process_index=0)
+            flight.record({"name": f"s{k}"})
+            paths.append(flight.dump("drain"))
+        merged = flight.merge_dumps(paths)
+        assert len(merged["spans"]) == 2
+
+
+class TestDumpTriggers:
+    def test_sigusr2_dumps_flight_ring(self, tmp_path):
+        flight.configure(str(tmp_path))
+        flight.record({"name": "pre_signal"})
+        prev = signal.getsignal(signal.SIGUSR2)
+        try:
+            assert flight.install_signal_handler()
+            os.kill(os.getpid(), signal.SIGUSR2)
+            deadline = time.monotonic() + 5.0
+            while (not flight.find_dumps(str(tmp_path), "sigusr2")
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+        finally:
+            signal.signal(signal.SIGUSR2, prev)
+        # >= 1: a handler installed earlier in the process chains through
+        # ours and may dump a second time — both land in this dir
+        dumps = flight.find_dumps(str(tmp_path), "sigusr2")
+        assert len(dumps) >= 1
+        with open(dumps[0]) as f:
+            d = json.load(f)
+        assert any(s.get("name") == "pre_signal" for s in d["spans"])
+
+    def test_install_signal_handler_off_main_thread_refuses(self):
+        out = {}
+
+        def _try():
+            out["ok"] = flight.install_signal_handler()
+
+        th = threading.Thread(target=_try)
+        th.start()
+        th.join()
+        assert out["ok"] is False
+
+    def test_slo_shed_burn_rate_dump_latches(self, tmp_path):
+        flight.configure(str(tmp_path))
+        reg = Registry()
+        rule = SloRule("shed_burn",
+                       numerator="serving_requests_shed_total",
+                       denominator="serving_requests_total",
+                       threshold=0.3, window_s=5.0, min_denominator=10.0)
+        mon = SloMonitor([rule], registry=reg)
+        mon.poll(now=0.0)                      # baseline sample
+        reg.counter("serving_requests_total").inc(20)
+        reg.counter("serving_requests_shed_total").inc(10)
+        mon.poll(now=1.0)                      # burn 0.5 > 0.3: fires
+        assert rule.alerts == 1 and rule.latched
+        dumps = flight.find_dumps(str(tmp_path), "slo_shed_burn")
+        assert len(dumps) == 1
+        with open(dumps[0]) as f:
+            extra = json.load(f)["extra"]
+        assert extra["burn_rate"] == pytest.approx(0.5)
+        # hysteresis: a sustained breach is ONE alert, not one per poll
+        reg.counter("serving_requests_total").inc(20)
+        reg.counter("serving_requests_shed_total").inc(10)
+        mon.poll(now=2.0)
+        assert rule.alerts == 1
+        assert len(flight.find_dumps(str(tmp_path), "slo_shed_burn")) == 1
+        # recovery below threshold/2 unlatches; a new breach re-alerts
+        reg.counter("serving_requests_total").inc(200)
+        mon.poll(now=6.5)
+        assert not rule.latched
+        reg.counter("serving_requests_total").inc(20)
+        reg.counter("serving_requests_shed_total").inc(15)
+        mon.poll(now=7.5)
+        assert rule.alerts == 2
+
+    def test_install_shed_rule_registers_global_monitor(self):
+        mon = slo.install_shed_rule(threshold=0.25)
+        assert slo.get_monitor() is mon
+        slo.maybe_poll()     # must be callable from hot paths, cheap
+        slo.reset()
+        slo.maybe_poll()     # and a no-op without a monitor
+
+
+# ---------------------------------------------------------------------------
+# chrome export: kept spans + thread_name metadata
+
+
+def test_chrome_trace_includes_spans_and_thread_names(tmp_path):
+    tracing.enable(policy=KeepPolicy(keep_all=True))
+    t = tracing.start_trace("unit")
+    sp = t.span("work")
+
+    def _end():
+        sp.end("ok")
+
+    th = threading.Thread(target=_end, name="worker-thread-x")
+    th.start()
+    th.join()
+    t.close("completed")
+    tracing.disable()
+    path = str(tmp_path / "trace.json")
+    trace = chrome_trace(path)
+    evs = trace["traceEvents"]
+    span_evs = [e for e in evs if e.get("cat") == "trace"]
+    assert {e["name"] for e in span_evs} >= {"unit", "work"}
+    assert all(e["ts"] >= 0 for e in evs)
+    metas = {e["tid"]: e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "thread_name"}
+    work = next(e for e in span_evs if e["name"] == "work")
+    # the span's opening thread is named in the viewer metadata
+    assert metas.get(work["tid"]) == threading.current_thread().name
+
+
+# ---------------------------------------------------------------------------
+# decode serving end-to-end: the acceptance span-tree walk
+
+
+@pytest.mark.slow
+class TestDecodeFailoverTrace:
+    def _stack(self):
+        from paddle_tpu.inference import serving
+        from paddle_tpu.inference.decode_model import (init_decode_model,
+                                                       make_step_fn)
+        from paddle_tpu.inference.kv_cache import PagedKVCache
+        params = init_decode_model(vocab=128, num_heads=2, head_dim=32,
+                                   seed=7)
+        cache = PagedKVCache(64, 4, 2, 32)
+        fn = make_step_fn(params, cache)
+        cfg = serving.ServingConfig(max_batch=32, batch_wait_s=0.002,
+                                    call_timeout_s=1.0,
+                                    probation_base_s=0.02,
+                                    probation_max_s=0.2, seed=3)
+        # ONE replica: the retry must land on the respawned worker, so
+        # the generation bump is visible in the kept trace
+        srv = serving.DecodeServer(fn, cache, replicas=1, config=cfg,
+                                   prefill_chunk=8, max_pages_per_seq=16)
+        return srv
+
+    @staticmethod
+    def _prompt(i, extra=4):
+        rs = np.random.RandomState(11)
+        system = [int(t) for t in rs.randint(0, 128, 8)]
+        rs = np.random.RandomState(100 + i)
+        return system + [int(t) for t in rs.randint(0, 128, extra)]
+
+    def test_single_kept_trace_covers_whole_request(self):
+        max_new = 4
+        tracing.enable(policy=KeepPolicy())   # the production policy
+        srv = self._stack()
+        with srv:
+            # warm-up completes cleanly -> its trace must be DROPPED
+            srv.submit_generate(self._prompt(0), 3).result(timeout=60)
+            with faults.inject("replica_stall") as spec:
+                r = srv.submit_generate(self._prompt(1), max_new)
+                out = r.result(timeout=60)
+            assert spec.fired == 1
+            assert srv.stats()["failovers"] >= 1
+            assert srv.accounted()
+        tracing.disable()
+        assert len(out[0]) == max_new
+
+        kept = tracing.snapshot_kept()
+        assert len(kept) == 1, "exactly the failover request is kept"
+        tr = kept[0]
+        assert tr["keep_reason"] == "failover"
+        assert tr["outcome"] == "completed"
+
+        spans = tr["spans"]
+        assert len({s["trace_id"] for s in spans}) == 1
+        roots = [s for s in spans if s["parent_id"] is None]
+        assert len(roots) == 1 and roots[0]["name"] == "serving_request"
+        root = roots[0]
+        assert root["attrs"]["attempts"] == 1
+
+        # admission wait, with the KV prefix hit reported ambiently
+        waits = [s for s in spans if s["name"] == "admission_wait"]
+        assert len(waits) == 1
+        assert waits[0]["parent_id"] == root["span_id"]
+        assert any(e["name"] == "kv_prefix_hit"
+                   for e in waits[0]["events"])
+
+        execs = sorted((s for s in spans if s["name"] == "execute"),
+                       key=lambda s: s["t0_ns"])
+        assert execs and all(s["parent_id"] == root["span_id"]
+                             for s in execs)
+
+        # attempt 0: dispatched to generation 0, ended by the requeue
+        failed = [s for s in execs if s["status"] == "failover"]
+        assert len(failed) == 1
+        assert failed[0]["attrs"]["attempt"] == 0
+        assert failed[0]["attrs"]["generation"] == 0
+
+        # attempt 1: every span on the RESPAWNED worker (generation 1)
+        retries = [s for s in execs if s["attrs"]["attempt"] == 1]
+        assert retries and all(s["attrs"]["generation"] == 1
+                               and s["attrs"]["replica"] == 0
+                               for s in retries)
+        assert all(s["t0_ns"] > failed[0]["t1_ns"] for s in retries)
+        assert all(s["status"] in ("ok", "completed") for s in retries)
+
+        # every decode re-entry is its own span, each committing KV
+        decodes = [s for s in retries if s["attrs"]["phase"] == "decode"]
+        assert len(decodes) >= max_new - 2
+        assert all(any(e["name"] == "kv_append" for e in s["events"])
+                   for s in retries)
+
+        a = tracing.accounting()
+        assert a["traces_closed"] == 2     # warm-up + failover request
+        assert tracing.accounted()
+
+    def test_disabled_serving_path_allocates_nothing(self):
+        assert not tracing.enabled()
+        srv = self._stack()
+        with srv:
+            r = srv.submit_generate(self._prompt(0), 2)
+            r.result(timeout=60)
+            assert r._trace is None and r._span_wait is None \
+                and r._attempt_span is None
+        assert tracing.accounting()["recorded"] == 0
+
+
+# ---------------------------------------------------------------------------
+# async checkpoint: the staged-tuple cross-thread handoff
+
+
+@pytest.mark.slow
+def test_ckpt_trace_commit_ends_on_committer_thread(tmp_path):
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+    rng = np.random.RandomState(0)
+    state = {"w": rng.randn(16, 4).astype(np.float32),
+             "step": np.int64(1)}
+    tracing.enable(policy=KeepPolicy(keep_all=True))
+    m = CheckpointManager(str(tmp_path), async_commit=True)
+    m.save(1, state)
+    m.flush()
+    m.close()
+    tracing.disable()
+    kept = [t for t in tracing.snapshot_kept() if t["name"] == "ckpt_save"]
+    assert len(kept) == 1 and kept[0]["outcome"] == "committed"
+    spans = kept[0]["spans"]
+    snap = next(s for s in spans if s["name"] == "snapshot")
+    commit = next(s for s in spans if s["name"] == "commit")
+    root = next(s for s in spans if s["parent_id"] is None)
+    # snapshot runs on the saving thread; the Trace object rides the
+    # staged tuple to the committer, which opens the commit span and
+    # closes the trace — the root records the cross-thread end
+    assert snap["thread"] == threading.current_thread().name
+    assert "end_thread" not in snap["attrs"]
+    assert commit["thread"] == "ckpt-committer"
+    assert root["thread"] == threading.current_thread().name
+    assert root["attrs"]["end_thread"] == "ckpt-committer"
+    assert commit["t0_ns"] >= snap["t1_ns"]
+    assert tracing.accounted()

@@ -78,6 +78,7 @@ def bench(saves: int, steps_between: int, run_dir: str) -> dict:
     from paddle_tpu.distributed.checkpoint import CheckpointManager
     from paddle_tpu.resilience.integrity import (compare_digests,
                                                  tree_digests)
+    from paddle_tpu.telemetry import flight, tracing
 
     trainer = build_trainer()
     x, y = make_batch()
@@ -91,6 +92,10 @@ def bench(saves: int, steps_between: int, run_dir: str) -> dict:
             lambda a: np.asarray(jax.device_get(a))
             if hasattr(a, "shape") else a, trainer.state))
 
+    # keep every ckpt_save trace: the bench artifact shows the snapshot
+    # (step thread) vs commit (committer thread) split per save
+    tracing.reset(policy=tracing.KeepPolicy(keep_all=True))
+    tracing.enable()
     with telemetry.scope(run_dir):
         sync_dir = tempfile.mkdtemp(prefix="bench_ckpt_sync_")
         m_sync = CheckpointManager(sync_dir, max_to_keep=saves + 1,
@@ -136,6 +141,12 @@ def bench(saves: int, steps_between: int, run_dir: str) -> dict:
         }
         m_sync.close()
         m_async.close()
+    kept = tracing.snapshot_kept()
+    trace_accounting = tracing.accounted()
+    tracing.disable()
+    traces_path = os.path.join(run_dir, "traces_kept.json")
+    if not os.path.exists(traces_path):
+        traces_path = None
 
     sync_p50 = statistics.median(sync_stall)
     async_p50 = statistics.median(async_stall)
@@ -149,6 +160,11 @@ def bench(saves: int, steps_between: int, run_dir: str) -> dict:
         "bitwise_identical": identical,
         "telemetry_series": series,
         "accounting": accounting,
+        "ckpt_traces_kept": len([t for t in kept
+                                 if t.get("name") == "ckpt_save"]),
+        "trace_accounting_closed": trace_accounting,
+        "kept_traces_path": traces_path,
+        "flight_dumps": list(flight.get_recorder().dumps),
         "saves": saves,
         "device_count": jax.device_count(),
         "platform": jax.devices()[0].platform,
@@ -175,9 +191,13 @@ def main(argv=None) -> int:
         ok = (r["ratio"] is not None and r["ratio"] < 0.5
               and r["bitwise_identical"]
               and all(r["telemetry_series"].values())
-              and r["accounting"]["accounted"])
+              and r["accounting"]["accounted"]
+              and r["ckpt_traces_kept"] >= 1
+              and r["trace_accounting_closed"]
+              and r["kept_traces_path"] is not None)
     extra = dict(r, smoke=bool(args.smoke))
     print(json.dumps({
+        "schema_version": 1,
         "metric": "ckpt_async_stall_ratio",
         "value": r["ratio"],
         "unit": "x",

@@ -105,6 +105,7 @@ def run_overlap(args) -> None:
         extra["k1"] = base
         extra[f"k{k}"] = bucketed
     print(json.dumps({
+        "schema_version": 1,
         "metric": "grad_sync_overlap_efficiency",
         "value": effk,
         "unit": "frac",
@@ -300,6 +301,7 @@ def main():
             "n_collectives": ov["n_collectives"],
             "buckets": ov["buckets"]}
     print(json.dumps({
+        "schema_version": 1,
         "metric": "int8_vs_fp32_bytes_x",
         "value": round(ratio, 3),
         "unit": "x",

@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import tempfile
 import time
@@ -323,6 +324,7 @@ def run_bench(smoke: bool, seed: int = 0) -> dict:
     from paddle_tpu import inference, telemetry
     from paddle_tpu.inference import serving
     from paddle_tpu.resilience import faults
+    from paddle_tpu.telemetry import flight, tracing
 
     telemetry.enable()
     replicas, max_batch = 2, 4
@@ -332,6 +334,9 @@ def run_bench(smoke: bool, seed: int = 0) -> dict:
     duration = 1.5 if smoke else 6.0
 
     tmp = tempfile.mkdtemp(prefix="bench_serving_")
+    flight.reset()
+    flight.configure(tmp)      # drain at shutdown writes a dump here
+    tracing.reset()            # start from a clean (disabled) tracer
     prefix = build_model(tmp)
     cfg = inference.Config(prefix)
     pool = inference.PredictorPool(cfg, replicas)
@@ -353,19 +358,46 @@ def run_bench(smoke: bool, seed: int = 0) -> dict:
 
         baseline = run_phase(server, 0.5 * capacity, duration,
                              deadline_s, rng)
+        # -- disabled path: no tracer work at all — the request carries
+        # no trace object and the span counter never moves
+        probe = server.submit([rng.rand(1, IN_DIM).astype("float32")],
+                              deadline_s=30.0)
+        probe.result(timeout=60)
+        tracing_disabled_zero = (probe._trace is None
+                                 and tracing.accounting()["recorded"] == 0)
+
+        # -- tracing overhead: same rate as baseline with recording ON
+        # but tail sampling keeping NOTHING — the always-on cost
+        tracing.reset(policy=tracing.KeepPolicy(keep_none=True))
+        tracing.enable()
+        traced = run_phase(server, 0.5 * capacity, duration,
+                           deadline_s, rng)
+        acct_traced = tracing.accounting()
+        tracing.disable()
+
         overload = run_phase(server, 2.0 * capacity, duration,
                              deadline_s, rng)
 
-        # -- failover: wedge one replica a few batches into the phase
+        # -- failover: wedge one replica a few batches into the phase,
+        # with tail sampling armed — the failover must yield kept traces
+        tracing.reset()
+        tracing.enable()
         stall_at = server.stats()["batches"] + 4
         with faults.inject("replica_stall", at_step=stall_at) as spec:
             failover = run_phase(server, 0.6 * capacity,
                                  max(duration, 2.0), 2.0, rng)
+        kept_failover = [t for t in tracing.snapshot_kept()
+                         if t.get("keep_reason") == "failover"]
+        traces_path = tracing.write_kept(
+            os.path.join(tmp, "traces_kept.json"))
+        trace_accounting_closed = tracing.accounted()
+        tracing.disable()
         failover["stall_fired"] = spec.fired
         stats = server.stats()
         recompiles_final = stats["recompiles"]
         accounted = server.accounted()
         server.shutdown(drain=True)
+    flight_dumps = list(flight.get_recorder().dumps)
 
     decode = run_decode_bench(smoke, seed)
     decode_checks = decode.pop("checks")
@@ -374,6 +406,9 @@ def run_bench(smoke: bool, seed: int = 0) -> dict:
     goodput_band_ok = (
         baseline["goodput_rps"] > 0
         and overload["goodput_rps"] >= 0.5 * baseline["goodput_rps"])
+    overhead = None
+    if baseline["p50_s"] and traced["p50_s"]:
+        overhead = (traced["p50_s"] - baseline["p50_s"]) / baseline["p50_s"]
     checks = {
         "overload_sheds": shed_total > 0,
         "overload_p99_within_deadline": (
@@ -384,9 +419,19 @@ def run_bench(smoke: bool, seed: int = 0) -> dict:
         and failover["stall_fired"] == 1,
         "zero_requests_lost": accounted and failover["failed"] == 0,
         "buckets_closed": recompiles_final == recompiles_warm,
+        # tracing acceptance: always-on recording with nothing kept must
+        # cost <= 3% p50, and the disabled path must allocate nothing
+        "tracing_overhead_p50_within_3pct": (
+            overhead is not None and overhead <= 0.03),
+        "tracing_nothing_kept": (acct_traced["recorded"] > 0
+                                 and acct_traced["kept"] == 0),
+        "tracing_disabled_zero_span_alloc": tracing_disabled_zero,
+        "failover_trace_kept": len(kept_failover) >= 1,
+        "trace_accounting_closed": trace_accounting_closed,
     }
     checks.update(decode_checks)
     return {
+        "schema_version": 1,
         "metric": "serving_overload_goodput_rps",
         "value": overload["goodput_rps"],
         "unit": "req/s",
@@ -397,6 +442,7 @@ def run_bench(smoke: bool, seed: int = 0) -> dict:
             "replicas": replicas,
             "max_batch": max_batch,
             "baseline": baseline,
+            "traced": traced,
             "overload": overload,
             "failover": failover,
             "requests_shed_total": shed_total,
@@ -410,6 +456,16 @@ def run_bench(smoke: bool, seed: int = 0) -> dict:
             "decode": decode,
             "kv_cache_hit_rate": decode["kv_cache_hit_rate"],
             "stats": stats,
+            "tracing": {
+                "baseline_p50_s": baseline["p50_s"],
+                "traced_p50_s": traced["p50_s"],
+                "overhead_frac": overhead,
+                "spans_recorded": acct_traced["recorded"],
+                "kept_while_keep_none": acct_traced["kept"],
+                "failover_traces_kept": len(kept_failover),
+                "kept_traces_path": traces_path,
+            },
+            "flight_dumps": flight_dumps,
             "telemetry": {
                 "prometheus_bytes": len(telemetry.prometheus_text()),
             },

@@ -218,11 +218,19 @@ def run_sdc(steps: int, ckpt_dir: str):
     one-line summary dict."""
     from paddle_tpu.distributed.checkpoint import CheckpointManager
     from paddle_tpu.resilience import faults, integrity, run_resilient
+    from paddle_tpu.telemetry import flight, tracing
 
     trainer = build_sdc_trainer()
     loader = make_loader()
     manager = CheckpointManager(ckpt_dir, max_to_keep=steps + 2,
                                 use_async=False)
+    # anomaly-dump proof: the divergence verdict must trigger a flight
+    # dump, and the tainted step's trace must be tail-kept
+    flight_dir = os.path.join(ckpt_dir, "flight")
+    flight.reset()
+    flight.configure(flight_dir)
+    tracing.reset()
+    tracing.enable()
     # zero-overhead contract: the plain program must carry NO fingerprint
     # collectives; the check program must carry them
     x, y = loader[0]
@@ -230,13 +238,21 @@ def run_sdc(steps: int, ckpt_dir: str):
         trainer.staged_jaxpr(x, y, do_check=False))
     check = integrity.count_fingerprint_collectives(
         trainer.staged_jaxpr(x, y, do_check=True))
-    with faults.inject("param_flip", at_step=5, seed=11) as f_flip:
-        res = run_resilient(trainer, loader, steps, manager=manager,
-                            save_every=1)
+    try:
+        with faults.inject("param_flip", at_step=5, seed=11) as f_flip:
+            res = run_resilient(trainer, loader, steps, manager=manager,
+                                save_every=1)
+        dumps = flight.find_dumps(flight_dir, reason="divergence")
+        kept_div = [t for t in tracing.snapshot_kept()
+                    if t["outcome"] == "divergence"]
+        accounted = tracing.accounted()
+    finally:
+        tracing.disable()
     ok = (res.exit_code == 0 and f_flip.fired == 1
           and res.divergences >= 1 and res.hosts_quarantined >= 1
           and bool(res.rollback_steps)
-          and nocheck == 0 and check > 0)
+          and nocheck == 0 and check > 0
+          and len(dumps) >= 1 and len(kept_div) >= 1 and accounted)
     return {
         "scenario": "sdc",
         "divergence_detected": int(res.divergences > 0),
@@ -246,6 +262,9 @@ def run_sdc(steps: int, ckpt_dir: str):
         "fingerprint_collectives_nocheck": nocheck,
         "fingerprint_collectives_check": check,
         "divergences": res.divergences,
+        "flight_dumps_divergence": len(dumps),
+        "kept_divergence_traces": len(kept_div),
+        "trace_accounting_closed": accounted,
         "steps_done": res.last_step + 1,
         "loss": res.loss,
         "exit_code": 0 if ok else 1,
@@ -257,6 +276,7 @@ def run_host_hang(steps: int, root: str):
     watchdog must fire (exit 10, heartbeats stop) and the survivors must
     remesh around it like a machine loss."""
     from paddle_tpu.resilience import hostsim
+    from paddle_tpu.telemetry import flight
 
     # hang detection is inherently slower than a crash: the watchdog
     # must time out (3s) and THEN the heartbeat must go stale (1s) —
@@ -271,15 +291,30 @@ def run_host_hang(steps: int, root: str):
                 "exit_code": 1, "error": "no surviving host wrote results",
                 "worker_exit_codes": out["exit_codes"],
                 "stderr": out["stderr"]}
+    # the wedged host's watchdog must have flight-dumped before os._exit;
+    # merge every per-host dump rank-0 style (process_index-tagged)
+    flight_dir = os.path.join(root, "flight")
+    hang_dumps = flight.find_dumps(flight_dir, reason="hang_watchdog")
+    hang_hosts = []
+    for p in hang_dumps:
+        with open(p) as f:
+            hang_hosts.append(json.load(f).get("process_index"))
+    all_dumps = flight.find_dumps(flight_dir)
+    merged = flight.merge_dumps(all_dumps) if all_dumps else {"spans": []}
     ok = (out["hosts_hung"] == 1 and len(survivors) == 2
           and all(r["exit_code"] == 0 for r in survivors)
-          and max(r["remeshes"] for r in survivors) >= 1)
+          and max(r["remeshes"] for r in survivors) >= 1
+          and len(hang_dumps) == 1 and hang_hosts == [1])
     return {
         "scenario": "host_hang",
         "hosts_hung": out["hosts_hung"],
         "hosts_lost": out["hosts_lost"],
         "remeshes": max(r["remeshes"] for r in survivors),
         "steps_done": min(r["steps_done"] for r in survivors),
+        "flight_dumps_hang": len(hang_dumps),
+        "hang_dump_hosts": hang_hosts,
+        "merged_dump_count": len(all_dumps),
+        "merged_span_count": len(merged["spans"]),
         "worker_exit_codes": out["exit_codes"],
         "exit_code": 0 if ok else 1,
     }

@@ -1,0 +1,136 @@
+"""Check the telemetry metric catalogue against the source tree.
+
+The catalogue in ``paddle_tpu/telemetry/__init__.py``'s module docstring
+is the contract dashboards are built against, and it rots silently: an
+instrumentation site gains a metric, the docstring doesn't, and the next
+person greps the catalogue and concludes the metric doesn't exist. This
+tool makes the drift a CI failure in both directions:
+
+- every metric name registered by a string literal anywhere under
+  ``paddle_tpu/`` must have a catalogue row;
+- every catalogue row must correspond to a registration site (or be on
+  the small dynamic-name allowlist below).
+
+Usage::
+
+    python tools/check_metric_catalogue.py            # exit 1 on drift
+    python tools/check_metric_catalogue.py --list     # dump both sets
+
+Registered in tests/test_bench_smoke.py so tier-1 runs it.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(_REPO, "paddle_tpu")
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+_KINDS = ("counter", "gauge", "histogram")
+
+# Registration sites whose metric name is not a single literal (built
+# dynamically); they must still appear in the catalogue — listed here so
+# the "catalogued but never registered" direction doesn't flag them.
+_DYNAMIC_NAMES = {
+    # distributed.checkpoint._record: f"checkpoint_{op}_seconds"
+    "checkpoint_save_seconds",
+    "checkpoint_restore_seconds",
+}
+
+# Names matched by the literal scan that are NOT part of the public
+# catalogue contract (test-local or internal scratch metrics).
+_IGNORE_REGISTERED: set = set()
+
+_CALL_RE = re.compile(
+    r"\b(?:counter|gauge|histogram)\(\s*[\"']([a-z][a-z0-9_]*)[\"']",
+    re.S)
+
+# The serving stack registers through thin wrappers whose literal first
+# argument IS the metric name. Scoped to paddle_tpu/inference/ — the
+# collectives module has an unrelated ``_count(op, axis)`` helper whose
+# first argument is a label value, not a metric.
+_WRAPPER_RE = re.compile(
+    r"\b(?:_count|_gauge|_observe)\(\s*[\"']([a-z][a-z0-9_]*)[\"']",
+    re.S)
+_WRAPPER_SCOPE = os.path.join("paddle_tpu", "inference") + os.sep
+
+
+def catalogue_names() -> set:
+    """Metric names from the docstring table: lines whose second token
+    is a metric kind (continuation lines are indented and skipped)."""
+    from paddle_tpu import telemetry
+    names = set()
+    for line in (telemetry.__doc__ or "").splitlines():
+        if line[:1].isspace() or not line.strip():
+            continue
+        toks = line.split()
+        if len(toks) >= 2 and toks[1] in _KINDS:
+            names.add(toks[0])
+    return names
+
+
+def registered_names(root: str = _PKG) -> set:
+    """Metric names passed as string literals to counter()/gauge()/
+    histogram() anywhere under ``root``."""
+    names = set()
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            names.update(_CALL_RE.findall(src))
+            if _WRAPPER_SCOPE in path:
+                names.update(_WRAPPER_RE.findall(src))
+    return names - _IGNORE_REGISTERED
+
+
+def check() -> dict:
+    cat = catalogue_names()
+    reg = registered_names()
+    return {
+        "catalogued": sorted(cat),
+        "registered": sorted(reg),
+        "unregistered": sorted(n for n in cat - reg
+                               if n not in _DYNAMIC_NAMES),
+        "uncatalogued": sorted(reg - cat),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--list", action="store_true",
+                   help="print both name sets, not just the drift")
+    args = p.parse_args(argv)
+    res = check()
+    if args.list:
+        for k in ("catalogued", "registered"):
+            print(f"{k} ({len(res[k])}):")
+            for n in res[k]:
+                print(f"  {n}")
+    ok = True
+    if res["uncatalogued"]:
+        ok = False
+        print("registered in source but missing from the catalogue "
+              "(add a row to paddle_tpu/telemetry/__init__.py):")
+        for n in res["uncatalogued"]:
+            print(f"  {n}")
+    if res["unregistered"]:
+        ok = False
+        print("catalogued but no registration site found "
+              "(stale row, or add to _DYNAMIC_NAMES with a reason):")
+        for n in res["unregistered"]:
+            print(f"  {n}")
+    if ok:
+        print(f"catalogue ok: {len(res['catalogued'])} metrics, "
+              f"{len(res['registered'])} registration names")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,200 @@
+"""Text summariser for kept traces and flight-recorder dumps.
+
+Renders the JSON artifacts the tracing spine writes — without leaving
+the terminal, no Perfetto needed:
+
+- ``runs/<x>/traces_kept.json``   (telemetry.tracing.write_kept)
+- ``flight_<reason>_<step>.json`` (telemetry.flight dumps)
+- merged multi-host dumps         (telemetry.flight.merge_dumps output)
+
+For every trace: a one-line header (name, outcome, keep reason,
+duration) and a waterfall — each span a bar positioned/scaled on the
+trace's own timeline, indented by tree depth, with status, thread and
+event count. Then one cross-trace table of the slowest span names.
+
+Usage::
+
+    python tools/trace_view.py runs/gpt/traces_kept.json
+    python tools/trace_view.py /tmp/run/flight_drain_0.json --top 10
+    python tools/trace_view.py --smoke        # self-test, prints JSON
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+_BAR_W = 36
+
+
+def _load_traces(path: str):
+    """Normalise any of the three artifact shapes to a list of
+    {name, outcome, keep_reason, duration_s, spans} trace dicts."""
+    with open(path, encoding="utf-8") as f:
+        d = json.load(f)
+    if "traces" in d:
+        return list(d["traces"]), {"kind": "kept"}
+    spans = d.get("spans", [])
+    by_trace = defaultdict(list)
+    for sp in spans:
+        by_trace[sp.get("trace_id", "?")].append(sp)
+    traces = []
+    for tid, sps in by_trace.items():
+        root = min(sps, key=lambda s: s.get("span_id", 1 << 30))
+        t0 = min(s["t0_ns"] for s in sps)
+        t1 = max(s["t1_ns"] for s in sps)
+        traces.append({
+            "trace_id": tid, "name": root.get("name", "?"),
+            "outcome": root.get("status", "?"), "keep_reason": None,
+            "duration_s": (t1 - t0) / 1e9, "spans": sps,
+        })
+    traces.sort(key=lambda t: min(s["t0_ns"] for s in t["spans"]))
+    meta = {"kind": "flight", "reason": d.get("reason"),
+            "step": d.get("step")} if "reason" in d else {"kind": "merged"}
+    return traces, meta
+
+
+def _depth(sp, by_id):
+    d, pid = 0, sp.get("parent_id")
+    while pid is not None and d < 16:
+        d += 1
+        parent = by_id.get(pid)
+        pid = parent.get("parent_id") if parent else None
+    return d
+
+
+def _fmt_ms(ns: float) -> str:
+    return f"{ns / 1e6:8.2f}ms"
+
+
+def render_trace(tr: dict, out) -> None:
+    dur = tr.get("duration_s") or 0.0
+    head = f"trace {tr.get('name')}  outcome={tr.get('outcome')}"
+    if tr.get("keep_reason"):
+        head += f"  keep={tr['keep_reason']}"
+    head += f"  dur={dur * 1e3:.2f}ms  spans={len(tr.get('spans', []))}"
+    tid = tr.get("trace_id")
+    if tid:
+        head += f"  id={tid}"
+    print(head, file=out)
+    spans = sorted(tr.get("spans", []), key=lambda s: s["t0_ns"])
+    if not spans:
+        return
+    base = min(s["t0_ns"] for s in spans)
+    span_ns = max(max(s["t1_ns"] for s in spans) - base, 1)
+    by_id = {s.get("span_id"): s for s in spans}
+    for s in spans:
+        off = int(_BAR_W * (s["t0_ns"] - base) / span_ns)
+        w = max(1, int(_BAR_W * (s["t1_ns"] - s["t0_ns"]) / span_ns))
+        w = min(w, _BAR_W - off)
+        bar = " " * off + "#" * w + " " * (_BAR_W - off - w)
+        label = "  " * _depth(s, by_id) + s.get("name", "?")
+        status = s.get("status", "?")
+        thread = s.get("thread") or ""
+        pi = s.get("process_index")
+        if pi is not None:
+            thread = f"h{pi}:{thread}"
+        nev = len(s.get("events") or [])
+        ev = f" ev={nev}" if nev else ""
+        print(f"  |{bar}| {_fmt_ms(s['t1_ns'] - s['t0_ns'])} "
+              f"{label:<32.32} {status:<10.10} {thread}{ev}", file=out)
+        for e in (s.get("events") or [])[:8]:
+            name = e.get("name") if isinstance(e, dict) else str(e)
+            print(" " * (_BAR_W + 4) + f". {name}", file=out)
+
+
+def slowest_table(traces, top: int, out) -> None:
+    agg = defaultdict(lambda: [0, 0.0, 0.0])   # count, total_ms, max_ms
+    for tr in traces:
+        for s in tr.get("spans", []):
+            ms = (s["t1_ns"] - s["t0_ns"]) / 1e6
+            a = agg[s.get("name", "?")]
+            a[0] += 1
+            a[1] += ms
+            a[2] = max(a[2], ms)
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][1])[:top]
+    if not rows:
+        return
+    print(f"\nslowest spans (by total time, top {len(rows)}):", file=out)
+    print(f"  {'name':<32} {'count':>6} {'total_ms':>10} {'max_ms':>10}",
+          file=out)
+    for name, (n, tot, mx) in rows:
+        print(f"  {name:<32.32} {n:>6} {tot:>10.2f} {mx:>10.2f}", file=out)
+
+
+def render(path: str, top: int = 15, limit: int = 0, out=None) -> int:
+    out = out or sys.stdout
+    traces, meta = _load_traces(path)
+    if meta.get("kind") == "flight":
+        print(f"flight dump reason={meta.get('reason')} "
+              f"step={meta.get('step')} traces={len(traces)}", file=out)
+    shown = traces[:limit] if limit else traces
+    for tr in shown:
+        render_trace(tr, out)
+    if limit and len(traces) > limit:
+        print(f"  ... {len(traces) - limit} more traces "
+              f"(raise --limit)", file=out)
+    slowest_table(traces, top, out)
+    return 0 if traces else 1
+
+
+def _smoke() -> int:
+    """Self-test: synthesize a kept trace, render it, check the output."""
+    import io
+    import tempfile
+    import time
+    from paddle_tpu.telemetry import tracing
+    tracing.reset(policy=tracing.KeepPolicy(keep_all=True))
+    tracing.enable()
+    tr = tracing.start_trace("smoke_request", rows=1)
+    with tr.span("admission_wait"):
+        time.sleep(0.002)
+    with tr.span("execute", attempt=0) as sp:
+        sp.event("kv_prefix_hit", tokens=4)
+        time.sleep(0.005)
+    tr.close("completed")
+    tracing.disable()
+    path = os.path.join(tempfile.mkdtemp(), "traces_kept.json")
+    assert tracing.write_kept(path)
+    buf = io.StringIO()
+    rc = render(path, out=buf)
+    text = buf.getvalue()
+    checks = {
+        "rendered": rc == 0,
+        "waterfall_has_spans": "admission_wait" in text
+                               and "execute" in text,
+        "keep_reason_shown": "keep=" in text,
+        "event_listed": "kv_prefix_hit" in text,
+        "slowest_table": "slowest spans" in text,
+    }
+    print(json.dumps({"tool": "trace_view", "checks": checks,
+                      "exit_code": 0 if all(checks.values()) else 1}))
+    return 0 if all(checks.values()) else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("path", nargs="?",
+                   help="traces_kept.json / flight dump / merged dump")
+    p.add_argument("--top", type=int, default=15,
+                   help="rows in the slowest-span table")
+    p.add_argument("--limit", type=int, default=0,
+                   help="waterfalls to print (0 = all)")
+    p.add_argument("--smoke", action="store_true",
+                   help="self-test on a synthetic trace; prints JSON")
+    args = p.parse_args(argv)
+    if args.smoke:
+        return _smoke()
+    if not args.path:
+        p.error("path required (or --smoke)")
+    return render(args.path, top=args.top, limit=args.limit)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
