@@ -12,18 +12,24 @@ Executor contract (``make_step_fn``): the DecodeServer hands the step
 function its flattened varlen batch —
 ``[tokens (T,), row_id (T,), positions (T,), valid (T,),
 block_tables (R, W), ctx_lens (R,), last_idx (R,)]`` — and expects
-``[next_tokens (R,), k_new (1, T, H, D), v_new (1, T, H, D)]`` back.
-The step function only COMPUTES (attention reads cached KV through the
-block tables; the chunk's own K/V is returned, not written) — the
-server commits cache writes after the batch finishes, so failovers
-re-run steps idempotently.
+``[next_tokens (T,), k_new (1, T, H, D), v_new (1, T, H, D)]`` back.
+``next_tokens[t]`` is the greedy argmax of the logits AT flattened slot
+``t`` (garbage on padded slots) — per-POSITION next tokens, so a
+speculative-verify chunk accepts/rejects its whole draft from one step
+while a plain step just reads its row's ``last_idx`` slot. The step
+function only COMPUTES (attention reads cached KV through the block
+tables; the chunk's own K/V is returned, not written) — the server
+commits cache writes after the batch finishes, so failovers re-run
+steps idempotently.
 
 Pure-decode batches (every context-bearing row carries exactly one
 token) route to :func:`~paddle_tpu.ops.pallas.paged_attention.
-paged_decode_attention` (XLA gather or the Pallas kernel); mixed
-prefill/decode batches use the ragged XLA path. Both are jitted per
-(token-bucket, row-bucket) shape, so the compiled set closes with the
-server's bucket set.
+paged_decode_attention` (XLA gather or the Pallas kernel); uniform
+multi-token extension batches — speculative-verify chunks, same-width
+prefill chunks — repack to a rectangular (R, S) layout and route to the
+same dispatcher's multi-query verify path; everything else takes the
+ragged XLA path. All are jitted per bucketed shape, so the compiled set
+closes with the server's bucket set.
 
 :func:`dense_generate` is the oracle: same parameters, full dense
 recompute each step, no cache — paged serving must reproduce its token
@@ -88,16 +94,19 @@ def make_step_fn(params: Dict[str, np.ndarray], cache,
     emb = jnp.asarray(params["embed"])
     pos = jnp.asarray(params["pos"])
 
+    max_len = int(np.asarray(params["pos"]).shape[0])
+
     @jax.jit
     def _mixed(kp, vp, tokens, row_id, positions, valid, tables,
                ctx_lens, last_idx):
+        del last_idx
         x = emb[tokens] + pos[positions]                    # (T, E)
         q, k, v = _qkv(params, x)
         o = paged_prefill_attention(q, k, v, row_id, positions, valid,
                                     kp, vp, tables, ctx_lens)
         y = x + o.reshape(-1, e) @ params["wo"]
-        nxt = jnp.argmax((y @ params["head"])[last_idx],
-                         axis=-1).astype(jnp.int32)         # (R,)
+        nxt = jnp.argmax(y @ params["head"],
+                         axis=-1).astype(jnp.int32)         # (T,)
         return nxt, k[None], v[None]
 
     @jax.jit
@@ -113,32 +122,94 @@ def make_step_fn(params: Dict[str, np.ndarray], cache,
             kernel=kernel, interpret=interpret)             # (R, 1, H, D)
         y = x + o[:, 0].reshape(-1, e) @ params["wo"]
         nxt = jnp.argmax(y @ params["head"], axis=-1).astype(jnp.int32)
-        # scatter each row's K/V back to its flattened token slot;
-        # padded rows (ctx_lens == 0) are routed out of bounds + dropped
-        # so they cannot clobber slot 0
+        # scatter each row's next token and K/V back to its flattened
+        # token slot; padded rows (ctx_lens == 0) are routed out of
+        # bounds + dropped so they cannot clobber slot 0
         idx = jnp.where(ctx_lens > 0, last_idx, t_b)
+        nxt_flat = jnp.zeros(t_b, jnp.int32).at[idx].set(nxt, mode="drop")
         k_flat = jnp.zeros((t_b, h, d), k.dtype).at[idx].set(k, mode="drop")
         v_flat = jnp.zeros((t_b, h, d), v.dtype).at[idx].set(v, mode="drop")
-        return nxt, k_flat[None], v_flat[None]
+        return nxt_flat, k_flat[None], v_flat[None]
+
+    @jax.jit
+    def _verify(kp, vp, tok2d, n_tok, tables, ctx_lens):
+        """Rectangular extension batch: row i's ``n_tok[i]`` chunk
+        tokens sit at positions ``ctx_lens[i] + col``. Used for
+        speculative-verify chunks (and any uniform-width prefill), where
+        every chunk token needs its own next-token argmax."""
+        r_b, s_b = tok2d.shape
+        cols = jnp.arange(s_b, dtype=jnp.int32)
+        posn = ctx_lens.astype(jnp.int32)[:, None] + cols[None, :]
+        posn = jnp.clip(posn, 0, max_len - 1)               # pad cols only
+        x = emb[tok2d] + pos[posn]                          # (R, S, E)
+        xf = x.reshape(r_b * s_b, e)
+        q, k, v = _qkv(params, xf)
+        q4 = q.reshape(r_b, s_b, h, d)
+        k4 = k.reshape(r_b, s_b, h, d)
+        v4 = v.reshape(r_b, s_b, h, d)
+        o = paged_decode_attention(
+            q4, kp, vp, tables, ctx_lens, k_new=k4, v_new=v4,
+            kernel=kernel, interpret=interpret)             # (R, S, H, D)
+        y = x + o.reshape(r_b, s_b, e) @ params["wo"]
+        nxt = jnp.argmax(y @ params["head"],
+                         axis=-1).astype(jnp.int32)         # (R, S)
+        return nxt, k4, v4
 
     def step(arrays: List[np.ndarray]) -> List[np.ndarray]:
         tokens, row_id, positions, valid, tables, ctx_lens, last_idx = \
             [np.asarray(a) for a in arrays]
         kp, vp = cache.pools(0)
+        t_b = tokens.shape[0]
+        r_b = ctx_lens.shape[0]
         # pure decode <=> every valid token belongs to a row that already
         # has context and carries exactly one token (semantically: each
         # such row computes a single next position)
         n_valid = int(valid.sum())
         real_rows = int((ctx_lens > 0).sum())
-        pure_decode = n_valid > 0 and n_valid == real_rows
-        fn = _decode if pure_decode else _mixed
-        nxt, k_new, v_new = fn(kp, vp, tokens, row_id, positions, valid,
-                               tables, ctx_lens, last_idx)
+        if n_valid > 0 and n_valid == real_rows:
+            nxt, k_new, v_new = _decode(kp, vp, tokens, row_id, positions,
+                                        valid, tables, ctx_lens, last_idx)
+            return [np.asarray(nxt), np.asarray(k_new), np.asarray(v_new)]
+        # uniform multi-token extension (speculative verify, same-width
+        # prefill): repack rectangular and take the multi-query kernel
+        # path. S is bucketed to a power of two so the compiled set
+        # stays closed alongside the server's token buckets.
+        counts = np.bincount(row_id[valid > 0], minlength=r_b)
+        live = counts[counts > 0]
+        if live.size and live.min() == live.max() and live[0] > 1:
+            s = int(live[0])
+            s_b = 1 << (s - 1).bit_length()
+            tok2d = np.zeros((r_b, s_b), np.int32)
+            n_tok = np.zeros(r_b, np.int32)
+            offs = []
+            off = 0
+            for i in range(r_b):
+                if counts[i] == 0:
+                    offs.append(None)
+                    continue
+                tok2d[i, :s] = tokens[off:off + s]
+                n_tok[i] = s
+                offs.append(off)
+                off += s
+            nxt2d, k2d, v2d = [np.asarray(o) for o in _verify(
+                kp, vp, tok2d, n_tok, tables, ctx_lens)]
+            nxt = np.zeros(t_b, np.int32)
+            k_new = np.zeros((t_b, h, d), k2d.dtype)
+            v_new = np.zeros((t_b, h, d), v2d.dtype)
+            for i, off in enumerate(offs):
+                if off is None:
+                    continue
+                nxt[off:off + s] = nxt2d[i, :s]
+                k_new[off:off + s] = k2d[i, :s]
+                v_new[off:off + s] = v2d[i, :s]
+            return [nxt, k_new[None], v_new[None]]
+        nxt, k_new, v_new = _mixed(kp, vp, tokens, row_id, positions,
+                                   valid, tables, ctx_lens, last_idx)
         return [np.asarray(nxt), np.asarray(k_new), np.asarray(v_new)]
 
     # exposed so harnesses (tools/bench_serving.py) can measure the
     # compiled-shape set directly via _cache_size()
-    step.jit_fns = (_mixed, _decode)
+    step.jit_fns = (_mixed, _decode, _verify)
     return step
 
 

@@ -962,7 +962,8 @@ class GenerationRequest(Request):
     token-denominated without touching it."""
 
     def __init__(self, prompt_tokens, max_new_tokens: int,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 eos_token: Optional[int] = None):
         prompt = [int(t) for t in np.asarray(prompt_tokens).reshape(-1)]
         if not prompt:
             raise ValueError("generation needs a non-empty prompt")
@@ -973,6 +974,7 @@ class GenerationRequest(Request):
                          tokens=len(prompt) + int(max_new_tokens))
         self.prompt = prompt
         self.max_new = int(max_new_tokens)
+        self.eos_token = None if eos_token is None else int(eos_token)
         self.generated: List[int] = []
         self.seq = None                 # kv_cache.CacheSeq (set at admission)
         self.chunk: List[int] = []      # tokens of the NEXT step
@@ -989,7 +991,10 @@ class DecodeServer(InferenceServer):
 
     ``step_fns`` are per-replica executors with the decode contract —
     ``fn([tokens, row_id, positions, valid, tables, ctx_lens, last_idx])
-    -> [next_tokens (R,), k_new (L, T, H, D), v_new (L, T, H, D)]`` (see
+    -> [next_tokens (T,), k_new (L, T, H, D), v_new (L, T, H, D)]``
+    where ``next_tokens[t]`` is the greedy next token AT flattened slot
+    ``t`` — a plain step consumes its chunk's last slot, a
+    speculative-verify chunk consumes every slot at once (see
     ``inference.decode_model.make_step_fn``); ``T`` is the token-budget
     bucket, ``R = min(T, max_batch_rows)`` the row bucket, so the
     compiled-shape set stays closed. The executor only COMPUTES; the
@@ -1028,14 +1033,17 @@ class DecodeServer(InferenceServer):
                         "submit_generate(prompt_tokens, max_new_tokens)")
 
     def submit_generate(self, prompt_tokens, max_new_tokens: int,
-                        deadline_s: Optional[float] = None
+                        deadline_s: Optional[float] = None,
+                        eos_token: Optional[int] = None
                         ) -> GenerationRequest:
         """Admit a generation (or shed it: the returned request is then
-        already terminal with the cause recorded)."""
+        already terminal with the cause recorded). ``eos_token`` seals
+        the request early when greedy decode emits it."""
         if deadline_s is None:
             deadline_s = self.cfg.default_deadline_s
         req = GenerationRequest(prompt_tokens, max_new_tokens,
-                                deadline_s=deadline_s)
+                                deadline_s=deadline_s,
+                                eos_token=eos_token)
         if _tracing.enabled():
             req._trace = _tracing.start_trace(
                 "serving_request", req_id=req.id, kind="generate",
@@ -1098,6 +1106,12 @@ class DecodeServer(InferenceServer):
         return wait
 
     def _release_request(self, req: Request):
+        # a speculative draft fork may still be pinned if the request
+        # seals mid-verify (drain, failover exhaustion, deadline)
+        fork = getattr(req, "draft_fork", None)
+        if fork is not None:
+            self.cache.release(fork)
+            req.draft_fork = None
         if getattr(req, "seq", None) is not None:
             self.cache.release(req.seq)
 
@@ -1187,7 +1201,7 @@ class DecodeServer(InferenceServer):
                 # ambient span: cache append/evict events land on this
                 # step's execute span
                 with _tracing.use_span(sp):
-                    self._advance(r, int(next_tokens[i]),
+                    self._advance(r, next_tokens[off:off + n],
                                   k_new[:, off:off + n],
                                   v_new[:, off:off + n], back)
             except Exception as e:  # noqa: BLE001 - CacheOOM et al.
@@ -1205,22 +1219,31 @@ class DecodeServer(InferenceServer):
                 self._gauge("serving_queue_depth", len(self._deque))
                 self._cv.notify_all()
 
-    def _advance(self, r: GenerationRequest, next_tok: int,
-                 k_chunk: np.ndarray, v_chunk: np.ndarray,
-                 back: List[Request]):
-        """Commit one completed step: write the chunk's K/V, consume the
-        sampled token when the step produced real logits (prompt fully
-        processed), then complete / expire / re-enqueue."""
-        if r.done():
-            return  # sealed while in flight (e.g. drain-expire race)
+    def _commit_chunk(self, r: GenerationRequest, nxt: np.ndarray,
+                      k_chunk: np.ndarray, v_chunk: np.ndarray):
+        """Write the chunk's K/V and consume its sampled token(s).
+        ``nxt`` is the per-slot next-token slice for this chunk; the
+        plain path samples from the last slot only. Speculative serving
+        overrides this to accept a draft run from the full slice."""
         self.cache.append(r.seq, r.chunk, k_chunk, v_chunk)
         if r.seq.length >= len(r.prompt):
             # the step's last token was prompt-final or a decode token:
             # its logits sample the next generated token
-            r.generated.append(int(next_tok))
+            r.generated.append(int(nxt[-1]))
             self._count_only("decode_tokens")
             self._count("decode_tokens_total")
-        if len(r.generated) >= r.max_new:
+
+    def _advance(self, r: GenerationRequest, nxt: np.ndarray,
+                 k_chunk: np.ndarray, v_chunk: np.ndarray,
+                 back: List[Request]):
+        """Commit one completed step (``_commit_chunk``), then
+        complete / expire / re-enqueue."""
+        if r.done():
+            return  # sealed while in flight (e.g. drain-expire race)
+        self._commit_chunk(r, nxt, k_chunk, v_chunk)
+        eos_hit = (r.eos_token is not None and r.generated
+                   and r.generated[-1] == r.eos_token)
+        if len(r.generated) >= r.max_new or eos_hit:
             if r._seal(COMPLETED,
                        outputs=[np.asarray(r.generated, np.int32)]):
                 self._count_outcome(COMPLETED)

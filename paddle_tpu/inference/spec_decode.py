@@ -1,0 +1,257 @@
+"""Speculative decoding on the paged KV cache (ISSUE 16).
+
+Every generated token normally costs one full target-model step. A
+*drafter* breaks that coupling: it proposes K cheap draft tokens, the
+request's next chunk becomes ``[last_generated, d1..dK]`` — a K+1-token
+"prefill" row against the shared prefix — and ONE target-model step
+verifies the whole window by re-entering the same token-denominated
+mixed prefill/decode batcher (:class:`~paddle_tpu.inference.serving.
+DecodeServer`). The executor already returns per-slot next tokens, so
+slot ``i`` of the chunk yields the greedy continuation after
+``chunk[:i+1]``; draft ``d_i`` is accepted iff it equals slot ``i-1``'s
+greedy token, and the accepted run always ends with one *bonus* token
+the target model produced itself. Greedy acceptance makes the output
+token stream EXACTLY the non-speculative greedy stream
+(``decode_model.dense_generate`` is the oracle) — speculation changes
+cost, never content.
+
+Cache discipline: at draft time the sequence's ``CacheSeq`` is
+COW-forked (:meth:`PagedKVCache.fork`) — the fork pins the shared
+prefix pages for the in-flight verify window, so eviction pressure
+cannot pull pages out from under a speculative step. On a full accept
+the chunk's K/V is appended to the FORK (exercising copy-on-write off
+the shared tail page) and the fork becomes the sequence; on a partial
+or zero accept the fork is released first and only the verified prefix
+of the chunk is appended to the original sequence. All of this happens
+in ``_commit_chunk`` — after ``try_finish``, like every cache write in
+the server — so a failover mid-verify re-runs the identical chunk
+idempotently and a cancelled step never touched the cache.
+
+Shape closure: a drafter must return EXACTLY ``k`` tokens or none, so
+chunk lengths stay in ``{1, 1+k}`` and the executor's (T, R) bucket
+set — now with the K+1-token verify rows bucketed like any prefill
+chunk — remains closed. ``k = 0`` (or a drafter with nothing to say)
+degrades to plain one-token decode.
+
+Drafters:
+
+- :class:`NGramDrafter` — self-speculative: match the longest recent
+  n-gram of the history against its earlier occurrences and replay the
+  continuation. Free (no model), surprisingly effective on repetitive
+  or prefix-heavy workloads.
+- :class:`DraftModelDrafter` — pluggable small-model hook; any
+  ``fn(history_tokens, k) -> tokens``. ``from_decode_server`` routes
+  drafting through another (smaller) :class:`DecodeServer`, so the
+  draft model runs on the same serving machinery.
+
+Observability: ``spec_draft_tokens_total`` / ``spec_accepted_tokens_
+total`` counters, a ``spec_accept_rate`` histogram and
+``spec_verify_steps_total`` land in the metrics registry; each verify
+dispatch carries a ``spec_verify`` phase label on its per-re-entry
+trace span plus a ``spec_verify`` event with drafted/accepted counts.
+``stats()["spec_decode"]`` reports the aggregate accept rate and decode
+tokens per target-model step — the quantity speculation multiplies.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..telemetry import tracing as _tracing
+from .serving import DecodeServer, GenerationRequest
+
+__all__ = ["NGramDrafter", "DraftModelDrafter", "SpeculativeDecodeServer"]
+
+
+class NGramDrafter:
+    """Self-speculative drafter: if the last ``n`` tokens of the history
+    occurred before, propose the ``k`` tokens that followed that earlier
+    occurrence (longest ``n`` wins, most recent occurrence wins). Short
+    continuations are padded by repeating their last token — the
+    contract is exactly ``k`` tokens or none."""
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1,
+                 max_history: int = 512):
+        if not 1 <= int(min_ngram) <= int(max_ngram):
+            raise ValueError("need 1 <= min_ngram <= max_ngram")
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+        self.max_history = int(max_history)
+
+    def propose(self, history: Sequence[int], k: int) -> List[int]:
+        hist = [int(t) for t in history][-self.max_history:]
+        for n in range(min(self.max_ngram, len(hist) - 1),
+                       self.min_ngram - 1, -1):
+            tail = hist[-n:]
+            for i in range(len(hist) - n - 1, -1, -1):
+                if hist[i:i + n] == tail:
+                    cont = hist[i + n:i + n + k]
+                    if cont:
+                        cont += [cont[-1]] * (k - len(cont))
+                        return cont
+        return []
+
+
+class DraftModelDrafter:
+    """Drafts from a small model: ``draft_fn(history_tokens, k)`` returns
+    the proposed continuation (truncated / padded here to exactly ``k``;
+    an empty or failed draft degrades to plain decode)."""
+
+    def __init__(self, draft_fn: Callable[[List[int], int], Sequence[int]]):
+        self.draft_fn = draft_fn
+
+    def propose(self, history: Sequence[int], k: int) -> List[int]:
+        try:
+            out = [int(t) for t in self.draft_fn(list(history), k)]
+        except Exception:  # noqa: BLE001 - a failed draft is just "no draft"
+            return []
+        if not out:
+            return []
+        out = out[:k]
+        return out + [out[-1]] * (k - len(out))
+
+    @classmethod
+    def from_decode_server(cls, server: DecodeServer,
+                           timeout: Optional[float] = 30.0
+                           ) -> "DraftModelDrafter":
+        """Route drafting through another DecodeServer (the small draft
+        model on the same serving machinery). Shed / failed / timed-out
+        draft generations degrade to plain decode."""
+
+        def fn(history: List[int], k: int) -> List[int]:
+            req = server.submit_generate(history, k)
+            return [int(t) for t in req.result(timeout=timeout)[0]]
+
+        return cls(fn)
+
+
+class SpeculativeDecodeServer(DecodeServer):
+    """:class:`DecodeServer` whose decode steps are speculative.
+
+    ``drafter`` proposes ``spec_k`` tokens per decode step (exactly
+    ``spec_k`` or none); verify rides the normal batcher as a 1+K-token
+    chunk, so prefill, mixed batches, admission, failover and drain are
+    untouched. Exactness: output == plain greedy decode, token for
+    token."""
+
+    def __init__(self, step_fns, cache, drafter=None, spec_k: int = 4,
+                 **kw):
+        super().__init__(step_fns, cache, **kw)
+        if drafter is None:
+            drafter = NGramDrafter()
+        self.drafter = drafter
+        # 1 + k must fit the per-dispatch token budget
+        self.spec_k = max(0, min(int(spec_k), self.cfg.max_batch - 1))
+
+    # -- scheduling ----------------------------------------------------------
+
+    def _assign_chunk(self, req: GenerationRequest):
+        super()._assign_chunk(req)          # prefill walk / plain decode
+        req.spec_draft = []
+        if (self.spec_k < 1 or len(req.chunk) != 1
+                or req.seq.length < len(req.prompt)
+                or req.max_new - len(req.generated) < 2):
+            return
+        draft = self.drafter.propose(req.prompt + req.generated,
+                                     self.spec_k)
+        if not draft:
+            return                          # K=0 fallback: plain decode
+        if len(draft) != self.spec_k:
+            raise ValueError(
+                f"drafter returned {len(draft)} tokens, wants 0 or "
+                f"{self.spec_k} (chunk lengths must stay bucketed)")
+        # pin the shared prefix for the in-flight verify window; the
+        # fork survives failover requeues (chunk re-runs identically)
+        req.draft_fork = self.cache.fork(req.seq)
+        req.spec_draft = [int(t) for t in draft]
+        req.chunk = req.chunk + req.spec_draft
+        req.rows = len(req.chunk)
+        self._count("spec_draft_tokens_total", n=self.spec_k)
+        self._count_only("spec_drafted", self.spec_k)
+
+    def _phase_of(self, r) -> str:
+        if getattr(r, "spec_draft", None):
+            return "spec_verify"
+        return super()._phase_of(r)
+
+    # -- commit --------------------------------------------------------------
+
+    def _commit_chunk(self, r: GenerationRequest, nxt: np.ndarray,
+                      k_chunk: np.ndarray, v_chunk: np.ndarray):
+        draft = getattr(r, "spec_draft", None)
+        if not draft:
+            before = len(r.generated)
+            super()._commit_chunk(r, nxt, k_chunk, v_chunk)
+            if len(r.generated) > before:
+                self._count_only("target_steps")
+            return
+        k = len(draft)
+        # slot i holds the greedy token AFTER chunk[:i+1]; draft d_i is
+        # accepted iff it matches slot i-1's token (chained — a miss
+        # invalidates everything behind it)
+        j = 0
+        while j < k and int(nxt[j]) == draft[j]:
+            j += 1
+        # the accepted run [nxt[0..j]] always includes the bonus token
+        # the target model computed at the last matching position
+        accepted = [int(t) for t in nxt[:j + 1]]
+        room = r.max_new - len(r.generated)
+        accepted = accepted[:room]
+        if r.eos_token is not None and r.eos_token in accepted:
+            accepted = accepted[:accepted.index(r.eos_token) + 1]
+        # cache commit: chunk rows 0..j carry KV for tokens that are now
+        # canonical (the step input + the j matched drafts), capped at
+        # ``room`` so a max_new-truncating accept cannot push the
+        # sequence past its admission-checked page budget. Full accept
+        # adopts the fork (append COWs the shared tail page); otherwise
+        # release the fork FIRST so the partial append doesn't COW
+        # against our own speculative pin.
+        n_kv = min(1 + j, room)
+        fork = getattr(r, "draft_fork", None)
+        r.draft_fork = None
+        if j == k and fork is not None:
+            self.cache.append(fork, r.chunk[:n_kv],
+                              k_chunk[:, :n_kv], v_chunk[:, :n_kv])
+            self.cache.release(r.seq)
+            r.seq = fork
+        else:
+            if fork is not None:
+                self.cache.release(fork)
+            self.cache.append(r.seq, r.chunk[:n_kv],
+                              k_chunk[:, :n_kv], v_chunk[:, :n_kv])
+        r.generated.extend(accepted)
+        r.spec_draft = []
+        self._count("spec_accepted_tokens_total", n=j)
+        self._count("spec_verify_steps_total")
+        self._observe("spec_accept_rate", j / float(k))
+        self._count_only("spec_accepted", j)
+        self._count_only("spec_verify_steps")
+        self._count_only("target_steps")
+        self._count_only("decode_tokens", len(accepted))
+        self._count("decode_tokens_total", n=len(accepted))
+        _tracing.add_event("spec_verify", drafted=k, accepted=j,
+                           tokens=len(accepted))
+
+    # -- reporting -----------------------------------------------------------
+
+    def stats(self):
+        s = super().stats()
+        with self._clock:
+            drafted = self.counts.get("spec_drafted", 0)
+            acc = self.counts.get("spec_accepted", 0)
+            vsteps = self.counts.get("spec_verify_steps", 0)
+            tsteps = self.counts.get("target_steps", 0)
+            toks = self.counts.get("decode_tokens", 0)
+        s["spec_decode"] = {
+            "draft_tokens": drafted,
+            "accepted_tokens": acc,
+            "verify_steps": vsteps,
+            "accept_rate": acc / drafted if drafted else 0.0,
+            # decode tokens over EVERY target-model step that produced
+            # any (plain + verify) — the quantity speculation
+            # multiplies (1.0 == plain decode)
+            "tokens_per_target_step":
+                toks / tsteps if tsteps else 0.0,
+        }
+        return s

@@ -13,21 +13,28 @@ Two interchangeable paths (numerics asserted identical in tests):
   to each row's true context length. Also provides the ragged
   *mixed-batch* path (:func:`paged_prefill_attention`) where prefill-chunk
   and decode-step rows share one flattened token axis.
-- **Pallas kernel** (decode steps, Tq == 1): the flash-attention streaming
-  structure — grid ``(batch, heads, pages)``, online softmax in VMEM
-  scratch — with the KV *block index maps reading the block table from
-  scalar-prefetch SMEM* (``PrefetchScalarGridSpec``), so each grid step
-  DMAs exactly one page and fully-masked pages are skipped. Page-tail
-  masking reuses flash's ``kv_lens`` column-mask idiom (finite ``NEG_INF``
-  plus explicit ``p`` zeroing so fully-masked rows yield 0, not NaN). The
-  kernel returns *unnormalized* (acc, m, l) running stats; the current
-  token's self-attention term is folded in a tiny jnp epilogue — the new
-  K/V never has to be scattered into the pool before attention reads it.
+- **Pallas kernel** (decode steps Tq == 1, and the ragged speculative-
+  verify path 1 < Tq <= 32): the flash-attention streaming structure —
+  grid ``(batch, heads, pages)``, online softmax in VMEM scratch — with
+  the KV *block index maps reading the block table from scalar-prefetch
+  SMEM* (``PrefetchScalarGridSpec``), so each grid step DMAs exactly one
+  page and fully-masked pages are skipped. Page-tail masking reuses
+  flash's ``kv_lens`` column-mask idiom (finite ``NEG_INF`` plus explicit
+  ``p`` zeroing so fully-masked rows yield 0, not NaN). The kernel
+  returns *unnormalized* (acc, m, l) running stats; the chunk tokens'
+  self-attention term — a single score for a decode step, a causal
+  (Tq, Tq) block for a verify chunk — is folded in a tiny jnp epilogue,
+  so the new K/V never has to be scattered into the pool before
+  attention reads it. The kernel body is row-wise: the verify path packs
+  the Tq distinct queries into the sublane rows the decode path
+  broadcasts one query across (``_paged_verify_kernel`` is the same body
+  under its own name so the tuner/lint keying can tell the shapes apart).
 
-Config (``q_pad`` — sublane padding of the broadcast single query row, 8
-for f32 tiles / 16 for the bf16 tile shape) resolves through the tuning DB
-under kernel name ``"paged_attention"``; interpret-validated seeds ship in
-``tuning_db.json``.
+Config (``q_pad`` — sublane rows holding the query/queries, 8 for f32
+tiles / 16 for the bf16 tile shape; the verify path needs
+``q_pad >= bucket(Tq)``) resolves through the tuning DB under kernel name
+``"paged_attention"``; interpret-validated seeds ship in
+``tuning_db.json`` — verify shapes carry an extra ``sq`` dim in the key.
 
 Public API:
     paged_decode_attention(q, k_pool, v_pool, block_tables, context_lens,
@@ -48,23 +55,38 @@ from jax.experimental.pallas import tpu as pltpu
 from .flash_attention import NEG_INF, STAT_LANES, LANES
 
 DEFAULT_Q_PAD = 8  # sublane rows the single decode query is broadcast to
+MAX_VERIFY_TQ = 32  # widest speculative-verify chunk the kernel packs
 
 
-def paged_dims(d: int, page_size: int, num_pages: int) -> dict:
+def verify_rows(tq: int) -> int:
+    """Sublane rows a Tq-query verify chunk occupies: Tq rounded up to
+    the next power of two, floored at the f32 tile height. This — not
+    the config's ``q_pad`` — keys the tuning DB for verify shapes, so
+    the key is recoverable from the traced kernel's padded query shape."""
+    from .tuner import shape_bucket
+    return shape_bucket(int(tq), floor=8)
+
+
+def paged_dims(d: int, page_size: int, num_pages: int, tq: int = 1) -> dict:
     """Tuning-DB dims for a paged decode call: head_dim and page size
     exact (hardware tiles), max context bucketed (one entry serves every
-    block-table width whose capacity lands in the bucket)."""
+    block-table width whose capacity lands in the bucket). Verify calls
+    (tq > 1) add the padded query-row bucket as ``sq``; decode keys stay
+    exactly as the shipped seeds spell them."""
     from .tuner import shape_bucket
-    return {"d": int(d), "ps": int(page_size),
+    dims = {"d": int(d), "ps": int(page_size),
             "sk": shape_bucket(int(page_size) * int(num_pages))}
+    if int(tq) > 1:
+        dims["sq"] = verify_rows(tq)
+    return dims
 
 
 def paged_decode_supported(q, k_pool, interpret: bool = False) -> bool:
-    """Gate for the Pallas paged-decode kernel: single query token per
-    row, tileable head_dim, sublane-aligned page size. Interpret mode
-    lifts the backend requirement (CPU tests)."""
+    """Gate for the Pallas paged-decode/verify kernel: 1..MAX_VERIFY_TQ
+    query tokens per row, tileable head_dim, sublane-aligned page size.
+    Interpret mode lifts the backend requirement (CPU tests)."""
     return ((interpret or jax.default_backend() == "tpu") and
-            q.ndim == 4 and q.shape[1] == 1 and
+            q.ndim == 4 and 1 <= q.shape[1] <= MAX_VERIFY_TQ and
             q.shape[-1] in (32, 64, 128, 256) and
             k_pool.shape[1] % 8 == 0)
 
@@ -166,22 +188,29 @@ def _paged_decode_kernel(tables_ref, lens_ref,      # scalar prefetch (SMEM)
                                        (l_scr.shape[0], STAT_LANES))
 
 
-def _pallas_paged_decode(q, k_pool, v_pool, tables, lens, k_new, v_new,
-                         sm_scale, q_pad, interpret):
-    b, tq, h, d = q.shape
+def _paged_verify_kernel(*args, **kwargs):
+    """Same body as :func:`_paged_decode_kernel`, under its own name:
+    the sublane rows hold Tq DISTINCT queries (speculative verify)
+    instead of one broadcast query, and the tuner/lint keying
+    (``entry_for_traced_call``) recovers the ``sq`` dim from the traced
+    query-row count only for this kernel name."""
+    return _paged_decode_kernel(*args, **kwargs)
+
+
+def _run_paged_kernel(kernel_fn, qhp, k_pool, v_pool, tables, lens,
+                      sm_scale, interpret):
+    """pallas_call plumbing shared by the decode and verify wrappers:
+    qhp is (B, H, q_pad, D); returns unnormalized (acc, m, l)."""
+    b, h, q_pad, d = qhp.shape
     num_pool_pages, ps, _, _ = k_pool.shape
     npages = tables.shape[1]
     # masked-out table slots may hold sentinel ids: the index map fetches
     # even skipped pages, so clamp every slot into the pool
     tables = jnp.clip(tables.astype(jnp.int32), 0, num_pool_pages - 1)
     lens = jnp.minimum(lens.astype(jnp.int32), npages * ps).reshape(b)
-    # (B, 1, H, D) → (B, H, q_pad, D): broadcast the single query row
-    # across the sublane tile (all rows compute identical stats)
-    qhp = jnp.broadcast_to(jnp.transpose(q, (0, 2, 1, 3)),
-                           (b, h, q_pad, d))
 
     kernel = functools.partial(
-        _paged_decode_kernel, sm_scale=sm_scale, page_size=ps,
+        kernel_fn, sm_scale=sm_scale, page_size=ps,
         num_pages=npages)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -220,7 +249,19 @@ def _pallas_paged_decode(q, k_pool, v_pool, tables, lens, k_new, v_new,
         ],
         interpret=interpret,
     )(tables, lens, qhp, k_pool, v_pool)
+    return acc, m, l
 
+
+def _pallas_paged_decode(q, k_pool, v_pool, tables, lens, k_new, v_new,
+                         sm_scale, q_pad, interpret):
+    b, tq, h, d = q.shape
+    # (B, 1, H, D) → (B, H, q_pad, D): broadcast the single query row
+    # across the sublane tile (all rows compute identical stats)
+    qhp = jnp.broadcast_to(jnp.transpose(q, (0, 2, 1, 3)),
+                           (b, h, q_pad, d))
+    acc, m, l = _run_paged_kernel(_paged_decode_kernel, qhp, k_pool,
+                                  v_pool, tables, lens, sm_scale,
+                                  interpret)
     acc = acc[:, :, 0, :]                                   # (B, H, D)
     m = m[:, :, 0, 0]                                       # (B, H)
     l = l[:, :, 0, 0]
@@ -243,6 +284,44 @@ def _pallas_paged_decode(q, k_pool, v_pool, tables, lens, k_new, v_new,
     return out[:, None].astype(q.dtype)                     # (B, 1, H, D)
 
 
+def _pallas_paged_verify(q, k_pool, v_pool, tables, lens, k_new, v_new,
+                         sm_scale, q_pad, interpret):
+    """Speculative-verify path (1 < Tq <= MAX_VERIFY_TQ): the Tq chunk
+    queries ride the sublane rows the decode path broadcasts across —
+    the kernel body is already row-wise, so each row streams the SAME
+    cached context (every chunk token attends to the full prefix) with
+    per-row online-softmax stats. The causal (Tq, Tq) self block over
+    the chunk's own new K/V folds in the epilogue."""
+    b, tq, h, d = q.shape
+    rows = max(int(q_pad), verify_rows(tq))
+    qt = jnp.transpose(q, (0, 2, 1, 3))                     # (B, H, Tq, D)
+    qhp = jnp.pad(qt, ((0, 0), (0, 0), (0, rows - tq), (0, 0)))
+    acc, m, l = _run_paged_kernel(_paged_verify_kernel, qhp, k_pool,
+                                  v_pool, tables, lens, sm_scale,
+                                  interpret)
+    acc = acc[:, :, :tq, :]                                 # (B, H, Tq, D)
+    m = m[:, :, :tq, 0]                                     # (B, H, Tq)
+    l = l[:, :, :tq, 0]
+    if k_new is None:
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out = acc / l_safe[..., None]
+    else:
+        qf = q.astype(jnp.float32) * sm_scale
+        s_self = jnp.einsum("bqhd,buhd->bhqu", qf,
+                            k_new.astype(jnp.float32))      # (B,H,Tq,Tq)
+        rng = jnp.arange(tq, dtype=jnp.int32)
+        causal = rng[None, None, :, None] >= rng[None, None, None, :]
+        s_self = jnp.where(causal, s_self, NEG_INF)
+        m2 = jnp.maximum(m, jnp.max(s_self, axis=-1))
+        alpha = jnp.exp(m - m2)       # finite NEG_INF → underflows to 0
+        p_self = jnp.exp(s_self - m2[..., None]) * (s_self > NEG_INF * 0.5)
+        l2 = l * alpha + jnp.sum(p_self, axis=-1)
+        out = (acc * alpha[..., None] +
+               jnp.einsum("bhqu,buhd->bhqd", p_self,
+                          v_new.astype(jnp.float32))) / l2[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # (B,Tq,H,D)
+
+
 # ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
@@ -252,7 +331,9 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, context_lens,
                            kernel="auto", q_pad=None, interpret=False):
     """Decode attention through a block table.
 
-    q: (B, Tq, H, D) new-token queries (Tq == 1 for pure decode).
+    q: (B, Tq, H, D) new-token queries (Tq == 1 for pure decode;
+    Tq = 1 + K for a speculative-verify chunk — every query attends the
+    full cached context plus the chunk's earlier tokens causally).
     k_pool/v_pool: (P, page_size, H, D) page pools.
     block_tables: (B, n_pages) int32 page ids per row (padded slots may
     hold any value; only the first ceil(len/page_size) are read).
@@ -281,12 +362,14 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, context_lens,
         if q_pad is None:
             cfg, _ = resolve("paged_attention", q.dtype,
                              paged_dims(d, k_pool.shape[1],
-                                        block_tables.shape[1]),
-                             {"q_pad": DEFAULT_Q_PAD})
+                                        block_tables.shape[1], tq=tq),
+                             {"q_pad": (DEFAULT_Q_PAD if tq == 1
+                                        else verify_rows(tq))})
             q_pad = cfg["q_pad"]
-        return _pallas_paged_decode(q, k_pool, v_pool, block_tables,
-                                    context_lens, k_new, v_new, sm_scale,
-                                    int(q_pad), interpret)
+        impl = _pallas_paged_decode if tq == 1 else _pallas_paged_verify
+        return impl(q, k_pool, v_pool, block_tables,
+                    context_lens, k_new, v_new, sm_scale,
+                    int(q_pad), interpret)
     if kernel == "auto":
         from .tuner import record_fallback
         record_fallback("paged_attention")

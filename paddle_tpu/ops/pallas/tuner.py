@@ -318,14 +318,18 @@ def entry_for_traced_call(kernel_name: str, avals: List, grid) -> \
         return make_key(
             "fused_ce", device_kind(), hid.dtype,
             {"h": int(h), "v": int(vpad), "t": tb}), None
-    if kernel_name == "_paged_decode_kernel":
-        # paged decode attention: invars (tables, lens, q, k_pool, v_pool)
-        # with q (B, H, q_pad, D) and k_pool (P, page_size, H, D)
+    if kernel_name in ("_paged_decode_kernel", "_paged_verify_kernel"):
+        # paged decode/verify attention: invars (tables, lens, q, k_pool,
+        # v_pool) with q (B, H, rows, D) and k_pool (P, page_size, H, D).
+        # For the verify kernel the traced row count IS the bucketed
+        # speculative chunk width, so it keys the ``sq`` dim directly.
         if len(avals) < 4:
             return None, None
         tables, q, kpool = avals[0], avals[2], avals[3]
         from .paged_attention import paged_dims
-        dims = paged_dims(q.shape[-1], kpool.shape[1], tables.shape[1])
+        tq = q.shape[2] if kernel_name == "_paged_verify_kernel" else 1
+        dims = paged_dims(q.shape[-1], kpool.shape[1], tables.shape[1],
+                          tq=tq)
         for dev in (device_kind(), GENERIC_DEVICE):
             key = make_key("paged_attention", dev, q.dtype, dims)
             entry = db.lookup(key)
@@ -369,10 +373,13 @@ def ce_candidates(tokens: int, vocab: int) -> List[Dict[str, int]]:
                     "block_vocab": min(shape_bucket(vocab), 512)}]
 
 
-def paged_candidates() -> List[Dict[str, int]]:
+def paged_candidates(sq: Optional[int] = None) -> List[Dict[str, int]]:
     """q_pad grid for the paged decode kernel: the sublane rows the
     single query is broadcast to — 8 matches the f32 tile, 16 the bf16
-    tile shape."""
+    tile shape. For the speculative-verify path (``sq`` set) the rows
+    ARE the bucketed chunk width, so there is exactly one candidate."""
+    if sq is not None:
+        return [{"q_pad": int(sq)}]
     return [{"q_pad": 8}, {"q_pad": 16}]
 
 
@@ -532,34 +539,35 @@ def _time_ce(cfg, tokens, h, v, dtype, interpret, iters) -> float:
     return _time_op(step, (hid, w), iters=iters)
 
 
-def _paged_case_arrays(b, h, d, ps, pages, dtype):
+def _paged_case_arrays(b, h, d, ps, pages, dtype, tq=1):
     import jax.numpy as jnp
     import numpy as np
 
     rs = np.random.RandomState(0)
-    q = jnp.asarray(rs.randn(b, 1, h, d), dtype)
+    q = jnp.asarray(rs.randn(b, tq, h, d), dtype)
     kp = jnp.asarray(rs.randn(pages, ps, h, d), dtype)
     vp = jnp.asarray(rs.randn(pages, ps, h, d), dtype)
     # shuffled tables + ragged lens exercise the gather and masking
     tables = jnp.asarray(
         np.stack([rs.permutation(pages) for _ in range(b)]), jnp.int32)
     lens = jnp.asarray(rs.randint(0, ps * pages + 1, (b,)), jnp.int32)
-    kn = jnp.asarray(rs.randn(b, 1, h, d), dtype)
-    vn = jnp.asarray(rs.randn(b, 1, h, d), dtype)
+    kn = jnp.asarray(rs.randn(b, tq, h, d), dtype)
+    vn = jnp.asarray(rs.randn(b, tq, h, d), dtype)
     return q, kp, vp, tables, lens, kn, vn
 
 
 def _validate_paged(cfg, b, h, d, ps, pages, dtype, interpret,
-                    tol=2e-3) -> bool:
-    """Candidate gate: the Pallas paged decode output must match the XLA
-    gather baseline (the mandatory reference path) for the same pool."""
+                    tol=2e-3, tq=1) -> bool:
+    """Candidate gate: the Pallas paged decode/verify output must match
+    the XLA gather baseline (the mandatory reference path) for the same
+    pool."""
     import jax.numpy as jnp
     import numpy as np
 
     from .paged_attention import paged_decode_attention
 
     q, kp, vp, tables, lens, kn, vn = _paged_case_arrays(
-        b, h, d, ps, pages, dtype)
+        b, h, d, ps, pages, dtype, tq=tq)
     try:
         got = paged_decode_attention(q, kp, vp, tables, lens, k_new=kn,
                                      v_new=vn, kernel="pallas",
@@ -576,11 +584,12 @@ def _validate_paged(cfg, b, h, d, ps, pages, dtype, interpret,
         ref, np.float32))))) <= t
 
 
-def _time_paged(cfg, b, h, d, ps, pages, dtype, interpret, iters) -> float:
+def _time_paged(cfg, b, h, d, ps, pages, dtype, interpret, iters,
+                tq=1) -> float:
     from .paged_attention import paged_decode_attention
 
     q, kp, vp, tables, lens, kn, vn = _paged_case_arrays(
-        b, h, d, ps, pages, dtype)
+        b, h, d, ps, pages, dtype, tq=tq)
 
     def step(q, kp, vp):
         return paged_decode_attention(q, kp, vp, tables, lens, k_new=kn,
@@ -640,16 +649,19 @@ def tune_case(kernel: str, case: Dict[str, int], dtype,
                                     interpret, iters)
         defaults = _ce_defaults()
     elif kernel == "paged_attention":
-        from .paged_attention import DEFAULT_Q_PAD, paged_dims
+        from .paged_attention import (DEFAULT_Q_PAD, paged_dims,
+                                      verify_rows)
         b, h = case.get("b", 4), case.get("h", 2)
         d, ps, pages = case["d"], case["ps"], case["pages"]
-        dims = paged_dims(d, ps, pages)
-        cands = paged_candidates()
+        tq = case.get("tq", 1)          # 1 + K for speculative verify
+        dims = paged_dims(d, ps, pages, tq=tq)
+        cands = paged_candidates(verify_rows(tq) if tq > 1 else None)
         validate = lambda c: _validate_paged(c, b, h, d, ps, pages,  # noqa: E731
-                                             dtype, interpret)
+                                             dtype, interpret, tq=tq)
         timeit = lambda c: _time_paged(c, b, h, d, ps, pages, dtype,  # noqa: E731
-                                       interpret, iters)
-        defaults = {"q_pad": DEFAULT_Q_PAD}
+                                       interpret, iters, tq=tq)
+        defaults = ({"q_pad": DEFAULT_Q_PAD} if tq == 1
+                    else {"q_pad": verify_rows(tq)})
     else:
         raise ValueError(f"unknown kernel {kernel!r}")
 
@@ -740,6 +752,19 @@ def _suite(name: str) -> List[Tuple[str, Dict[str, int], Any]]:
                                  "pages": 8}, f32),
             ("paged_attention", {"b": 4, "h": 2, "d": 64, "ps": 16,
                                  "pages": 16}, bf16),
+            # speculative-verify chunks (tq = 1 + K for K in {4, 8})
+            ("paged_attention", {"b": 4, "h": 2, "d": 32, "ps": 16,
+                                 "pages": 16, "tq": 5}, f32),
+            ("paged_attention", {"b": 4, "h": 2, "d": 32, "ps": 16,
+                                 "pages": 8, "tq": 5}, f32),
+            ("paged_attention", {"b": 4, "h": 2, "d": 32, "ps": 16,
+                                 "pages": 16, "tq": 9}, f32),
+            ("paged_attention", {"b": 4, "h": 2, "d": 32, "ps": 16,
+                                 "pages": 8, "tq": 9}, f32),
+            ("paged_attention", {"b": 4, "h": 2, "d": 64, "ps": 16,
+                                 "pages": 16, "tq": 5}, bf16),
+            ("paged_attention", {"b": 4, "h": 2, "d": 64, "ps": 16,
+                                 "pages": 16, "tq": 9}, bf16),
         ]
     if name == "bench":       # the TPU bench GPT-base shapes
         return [

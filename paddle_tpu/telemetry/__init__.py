@@ -140,6 +140,13 @@ kv_cache_evictions_total       counter    registered pages reclaimed
                                           {cause=capacity|trim}
 decode_tokens_total            counter    generated tokens committed by
                                           the decode scheduler
+spec_draft_tokens_total        counter    draft tokens proposed by the
+                                          speculative-decode drafter
+spec_accepted_tokens_total     counter    draft tokens the verify step
+                                          accepted (greedy match)
+spec_accept_rate               histogram  per-verify-step accepted / K
+spec_verify_steps_total        counter    speculative verify target-model
+                                          steps committed
 predicted_reshard_collectives  gauge      engine.compile(analyze=True):
                                           implicit resharding collectives
                                           the static sharding pass

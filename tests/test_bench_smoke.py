@@ -28,6 +28,7 @@ def _env():
     return env
 
 
+@pytest.mark.slow
 def test_bench_parent_orchestration_all_configs_cpu():
     """`python bench.py` end-to-end: probe + all five configs in fresh
     children + the single-JSON-line stdout contract the driver parses."""
@@ -88,6 +89,7 @@ def test_bench_parent_timeout_path():
     assert "timed out" in err
 
 
+@pytest.mark.slow
 def test_bench_collectives_smoke_telemetry():
     """tools/bench_collectives.py --smoke: tiny shapes, telemetry wired
     through telemetry.scope, wire-byte counters asserted in-process and
@@ -114,6 +116,7 @@ def test_bench_collectives_smoke_telemetry():
     assert len(ov["buckets"]) >= 2
 
 
+@pytest.mark.slow
 def test_bench_collectives_overlap_suite_smoke():
     """tools/bench_collectives.py --suite overlap --smoke --json: the
     overlap-efficiency metric contract — staged K=1 vs K=buckets on the
@@ -137,6 +140,7 @@ def test_bench_collectives_overlap_suite_smoke():
     assert extra["hidden_wire_seconds"] > 0
 
 
+@pytest.mark.slow
 @pytest.mark.multihost(timeout=420)
 def test_chaos_host_loss_scenario():
     """tools/chaos_smoke.py --scenario host_loss: the ISSUE acceptance
@@ -161,6 +165,7 @@ def test_chaos_host_loss_scenario():
     assert res["merged_metric_count"] > 0
 
 
+@pytest.mark.slow
 def test_chaos_sdc_scenario():
     """tools/chaos_smoke.py --scenario sdc: the ISSUE 9 acceptance path —
     a flipped mantissa bit on replica 3 at step 5 is caught by the
@@ -238,6 +243,7 @@ def test_fsck_ckpt_smoke():
     assert res["latest_valid_step_deep"] == 2  # cheap-tier fallback
 
 
+@pytest.mark.slow
 @pytest.mark.multihost(timeout=600)
 def test_chaos_crash_during_async_save_scenario():
     """tools/chaos_smoke.py --scenario crash_during_async_save: the ISSUE
@@ -263,6 +269,7 @@ def test_chaos_crash_during_async_save_scenario():
     assert res["accounted"] is True
 
 
+@pytest.mark.slow
 def test_bench_ckpt_smoke():
     """tools/bench_ckpt.py --smoke: the ISSUE 13 perf acceptance — async
     ckpt_step_stall_ms p50 < 0.5x the synchronous save wall at the same
@@ -318,15 +325,27 @@ def test_bench_serving_smoke():
     plus the ISSUE 11 decode phase: prefix-heavy generations over the
     paged KV cache hit >= 0.5 of their prompt tokens, compute <= 0.5x
     the no-sharing prefill baseline, exercise LRU eviction, and add
-    zero compiled shapes beyond the primed set."""
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools", "bench_serving.py"),
-         "--smoke"],
-        capture_output=True, text=True, timeout=400, env=_env())
-    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
-    assert lines, f"no stdout; stderr: {proc.stderr[-2000:]}"
-    res = json.loads(lines[-1])
-    extra = res["extra"]
+    zero compiled shapes beyond the primed set — plus the spec-decode
+    phase: speculative generations exact vs dense_generate with
+    tokens/target-step >= 1.5 and zero leaked pages.
+
+    The contract includes wall-clock checks (p99-in-deadline, goodput
+    band, tracing-overhead p50); on a loaded CI box a single run can
+    flake on those, so one retry is allowed — two consecutive failures
+    fail the test, and the first failure's check names are printed."""
+    for attempt in range(2):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "bench_serving.py"),
+             "--smoke"],
+            capture_output=True, text=True, timeout=400, env=_env())
+        lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+        assert lines, f"no stdout; stderr: {proc.stderr[-2000:]}"
+        res = json.loads(lines[-1])
+        extra = res["extra"]
+        if extra["exit_code"] == 0:
+            break
+        print(f"bench_serving --smoke attempt {attempt} failed checks: "
+              f"{[k for k, v in extra['checks'].items() if not v]}")
     assert extra["exit_code"] == 0, res
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert res["metric"] == "serving_overload_goodput_rps"
@@ -387,6 +406,7 @@ def test_trace_view_smoke():
     assert res["exit_code"] == 0 and all(res["checks"].values()), res
 
 
+@pytest.mark.slow
 def test_numerics_smoke_cpu():
     """tools/numerics_smoke.py: all kernel-vs-dense checks pass on the
     CPU interpreter; on-chip runs reuse the same script (r3 item 10)."""
@@ -414,6 +434,7 @@ def test_lint_program_smoke_strict():
         f"lint rc={proc.returncode}\nstdout tail: {proc.stdout[-3000:]}\n"
         f"stderr tail: {proc.stderr[-2000:]}")
     out = json.loads(proc.stdout.strip().splitlines()[-1])
-    assert set(out) == {"gpt", "bert", "decode-mixed", "decode-decode"}
+    assert set(out) == {"gpt", "bert", "decode-mixed", "decode-decode",
+                        "decode-verify"}
     for name, rep in out.items():
         assert rep["ok"], f"{name}: {rep['findings']}"

@@ -42,6 +42,7 @@ def test_bert_attention_is_bidirectional():
     assert not np.allclose(np.asarray(s1)[0, 0], np.asarray(s2)[0, 0])
 
 
+@pytest.mark.slow
 def test_bert_pretraining_loss_decreases():
     paddle.seed(0)
     m = BertForPretraining(**_tiny(attn_dropout=0.0, hidden_dropout=0.0))
@@ -93,6 +94,7 @@ def test_bert_token_types_change_output():
     assert not np.allclose(np.asarray(s1), np.asarray(s2))
 
 
+@pytest.mark.slow
 def test_bert_tp_forward_matches_dense():
     """Vocab-sharded TP forward under shard_map must match the dense
     single-device forward (regression: decoder bias/weight pspecs)."""

@@ -283,6 +283,7 @@ class TestCompressedTreeMean:
         for i in range(1, N):
             np.testing.assert_array_equal(out[0], out[i])
 
+    @pytest.mark.slow
     def test_bucket_split_invariance(self):
         """Bucket boundaries are block-aligned, so splitting into many
         small buckets must be bit-identical to one big bucket."""

@@ -573,6 +573,7 @@ class TestSequenceParallelTraining:
     the SAME loss trajectory as dense training (SURVEY §5 long-context
     capability, exceeding the reference)."""
 
+    @pytest.mark.slow
     def test_gpt_sep2_matches_dense(self):
         from paddle_tpu.distributed.engine import ParallelTrainer
         from paddle_tpu.text.models import GPTForPretraining
@@ -595,6 +596,7 @@ class TestSequenceParallelTraining:
         l_sep = run({"data": 2, "sep": 2})
         np.testing.assert_allclose(l_dense, l_sep, rtol=1e-3)
 
+    @pytest.mark.slow
     def test_gpt_sep_with_tp_composition(self):
         from paddle_tpu.distributed.engine import ParallelTrainer
         from paddle_tpu.text.models import GPTForPretraining
@@ -617,6 +619,7 @@ class TestSequenceParallelTraining:
         l_hybrid = run({"data": 2, "sep": 2, "model": 2}, True)
         np.testing.assert_allclose(l_dense, l_hybrid, rtol=2e-3)
 
+    @pytest.mark.slow
     def test_sep_with_pytree_rank1_labels(self):
         """sep>1 with a label PYTREE containing a rank-1 leaf: the engine
         must pick per-leaf data specs (rank-1 leaves have no sequence dim to

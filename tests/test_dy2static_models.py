@@ -72,6 +72,7 @@ def _ce(logits, labels):
 
 # -- test_resnet.py / test_resnet_v2.py --------------------------------------
 class TestResNet:
+    @pytest.mark.slow
     def test_resnet18_forward_parity_under_jit(self):
         # ref: test_resnet.py ResNet conversion (full zoo model)
         from paddle_tpu.vision.models import resnet18
@@ -87,6 +88,7 @@ class TestResNet:
             np.asarray(e(x)), np.asarray(jax.jit(lambda z: c(z))(x)),
             rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_resnet18_train_one_step(self):
         # ref: test_resnet.py train_one_step static == dygraph
         from paddle_tpu.vision.models import resnet18
@@ -104,6 +106,7 @@ class TestBert:
                num_layers=2, num_heads=2, max_position_embeddings=16,
                attn_dropout=0.0, hidden_dropout=0.0)
 
+    @pytest.mark.slow
     def test_bert_pretraining_train_one_step(self):
         # ref: test_bert.py train_static == train_dygraph (MLM+NSP)
         from paddle_tpu.text.models import BertForPretraining

@@ -86,6 +86,7 @@ class TestKvLensMask:
 
 
 class TestKernelDropout:
+    @pytest.mark.slow
     def test_dropout_statistics_and_scaling(self):
         """Kernel dropout: output is a valid inverted-dropout sample —
         mean close to the undropped output, exact zeros pattern applied at

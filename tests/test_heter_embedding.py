@@ -313,7 +313,9 @@ class TestAlltoallLookup:
                        np.asarray(rows)[np.clip(ids, 0, R - 1)], 0.0)
         np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
 
-    @pytest.mark.parametrize("cap", [1, 3, 16])
+    @pytest.mark.parametrize("cap", [pytest.param(1, marks=pytest.mark.slow),
+                                     pytest.param(3, marks=pytest.mark.slow),
+                                     16])
     def test_grads_match_dense(self, cap):
         op, mesh, R, dim, rows, ids = self._setup()
         N = ids.shape[0]

@@ -132,6 +132,7 @@ ELASTIC_WORKER = """
 """
 
 
+@pytest.mark.slow
 def test_elastic_kill_rank_restart_and_resume(tmp_path):
     """End-to-end: stall a live trainer mid-run (SIGSTOP — a hang the
     process supervisor cannot detect); the surviving rank's

@@ -14,9 +14,19 @@ import subprocess
 import sys
 import textwrap
 
+import jax
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# jax's default CPU collectives cannot cross OS processes; the workers
+# select the gloo implementation, so this whole module needs a jax build
+# that ships it (probe the flag registry read-only — setting the flag in
+# the pytest process would leak into in-process tests).
+pytestmark = pytest.mark.skipif(
+    "jax_cpu_collectives_implementation" not in getattr(jax.config, "values", {}),
+    reason="jax build has no gloo CPU collectives; cross-process "
+           "collectives unsupported on CPU")
 
 
 def _free_port():
@@ -32,6 +42,9 @@ WORKER = """
     import jax
     # the axon plugin ignores JAX_PLATFORMS env — force via config before use
     jax.config.update("jax_platforms", "cpu")
+    # default CPU collectives cannot span OS processes; gloo can, and it
+    # must be selected before the coordinator rendezvous
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     rank = int(os.environ["PADDLE_TRAINER_ID"])
     nproc = int(os.environ["PADDLE_TRAINERS_NUM"])
     import paddle_tpu as paddle
@@ -105,6 +118,7 @@ TRAIN_WORKER = """
     import os, sys
     import jax
     jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     rank = int(os.environ["PADDLE_TRAINER_ID"])
     nproc = int(os.environ["PADDLE_TRAINERS_NUM"])
     import paddle_tpu as paddle

@@ -140,6 +140,7 @@ def _io_elem_types(graph):
     return out[11], out[12]
 
 
+@pytest.mark.slow
 def test_onnx_export_resnet50_via_trace(tmp_path):
     """ResNet-50 (the model someone would actually export) round-trips
     through the trace converter with all weights as initializers — with

@@ -173,6 +173,7 @@ class TestBucketParity:
             rel = abs(got - fp32) / fp32
             assert rel < 0.02, (k, got, fp32)
 
+    @pytest.mark.slow
     def test_int4_bucketed_convergence_matches_monolithic(self):
         """int4 wire noise dominates the mid-descent (step-30) loss, so
         its parity point is step 60, where EF has averaged the coarser

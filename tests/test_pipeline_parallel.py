@@ -79,8 +79,12 @@ def _descs(tie=True):
 
 class TestPipelineTrajectory:
     @pytest.mark.parametrize("tie,schedule",
-                             [(False, "gpipe"), (True, "gpipe"),
-                              (True, "1f1b")],
+                             [pytest.param(False, "gpipe",
+                                           marks=pytest.mark.slow),
+                              pytest.param(True, "gpipe",
+                                           marks=pytest.mark.slow),
+                              pytest.param(True, "1f1b",
+                                           marks=pytest.mark.slow)],
                              ids=["untied-gpipe", "tied-gpipe",
                                   "tied-1f1b"])
     def test_pp_5step_trajectory_matches_dense(self, tie, schedule):
@@ -96,6 +100,7 @@ class TestPipelineTrajectory:
         np.testing.assert_allclose(dense, pp, rtol=2e-4)
         assert dense[-1] < dense[0]  # actually learning
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
     def test_pp_sep_composition_matches_dense(self, schedule):
         """pipe=2 x sep=2 (ring attention inside pipeline stages): the
@@ -120,8 +125,11 @@ class TestPipelineTrajectory:
         np.testing.assert_allclose(dense, sep, rtol=2e-4)
 
     @pytest.mark.parametrize("pp_degree,M,schedule",
-                             [(2, 1, "1f1b"), (2, 1, "gpipe"),
-                              (2, 2, "1f1b")],
+                             [(2, 1, "1f1b"),
+                              pytest.param(2, 1, "gpipe",
+                                           marks=pytest.mark.slow),
+                              pytest.param(2, 2, "1f1b",
+                                           marks=pytest.mark.slow)],
                              ids=["1f1b-M1", "gpipe-M1", "1f1b-M=S"])
     def test_packed_schedule_boundary_shapes(self, pp_degree, M, schedule):
         """Round-5 packed-tick timing at the boundary shapes: a single
@@ -136,6 +144,7 @@ class TestPipelineTrajectory:
         pp = [float(tr_p.train_step(x, y)) for _ in range(3)]
         np.testing.assert_allclose(dense, pp, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_dispatch_knob(self):
         """pipeline_configs dispatch: 'switch' runs on a collective-free
         pipe-only mesh and matches dense; the same override REFUSES a
@@ -164,6 +173,7 @@ class TestPipelineTrajectory:
         with pytest.raises(ValueError, match="dispatch='switch' is unsafe"):
             pp2.build_pipeline_grads_fn(_loss_fn, 2)
 
+    @pytest.mark.slow
     def test_pp_tp_dp_composition_matches_dense(self):
         """Full hybrid composition: pipe=2 x model=2 x data=2 (8 devices,
         TP layers inside pipe-sharded stages, vocab-sharded loss) tracks
@@ -214,6 +224,7 @@ class TestPipelineTrajectory:
         f1b = [float(tr_f.train_step(x, y)) for _ in range(4)]
         np.testing.assert_allclose(dense, f1b, rtol=3e-4)
 
+    @pytest.mark.slow
     def test_pp_zero_composition_matches_dense(self):
         """pipe=2 x sharding=2 x data=2 with ZeRO-1 optimizer-state
         sharding composed with pipe-sharded stage params: 4-step
@@ -244,6 +255,7 @@ class TestPipelineTrajectory:
         hybrid = [float(tr_h.train_step(x, y)) for _ in range(4)]
         np.testing.assert_allclose(dense, hybrid, rtol=3e-4)
 
+    @pytest.mark.slow
     def test_pp_with_data_parallel_and_adam(self):
         """PP composed with DP under a stateful optimizer."""
         x, y = _data()
@@ -303,6 +315,7 @@ class TestPipeMemorySharding:
         v = tr.state["params"][emb[0]]
         assert v.addressable_shards[0].data.shape == v.shape
 
+    @pytest.mark.slow
     def test_tied_state_stays_replicated_across_pipe(self):
         """After real updates, every pipe rank holds bit-identical values
         for replicated (shared/tied) params — the round-2 verdict's
@@ -342,6 +355,7 @@ class TestPipeMemorySharding:
 
 
 class TestOneFOneBMemory:
+    @pytest.mark.slow
     def test_1f1b_peak_memory_flat_in_microbatches(self):
         """The 1F1B guarantee (reference section_worker.cc:139-183):
         in-flight microbatches — and hence stashed activations — are
@@ -445,6 +459,7 @@ class TestPipelineEdgeCases:
 
 
 class TestPipelineCheckpoint:
+    @pytest.mark.slow
     def test_pp_checkpoint_roundtrip_resumes_trajectory(self, tmp_path):
         x, y = _data()
         tr, _ = _pp_trainer(_descs(True), pp_degree=4, data_degree=2,

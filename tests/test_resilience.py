@@ -741,6 +741,7 @@ class TestRunner:
 # ---------------------------------------------------------------------------
 
 class TestChaosE2E:
+    @pytest.mark.slow
     def test_chaos_gpt_loop(self, tmp_path):
         import sys
         sys.path.insert(0, os.path.join(os.path.dirname(__file__),

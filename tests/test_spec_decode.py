@@ -1,0 +1,252 @@
+"""Speculative decoding e2e (SpeculativeDecodeServer over the paged KV
+cache): greedy acceptance must keep every generation EXACTLY equal to
+the dense no-cache oracle — through self-speculative n-gram drafts, a
+draft-model drafter (including one routed through a second
+DecodeServer), adversarial all-rejected drafts, EOS landing mid-verify-
+window, replica failover mid-verify (idempotent re-execution of the
+draft chunk), and LRU eviction pressure against pinned draft forks —
+while the zero-silent-loss (``accounted``) and page-accounting
+contracts keep holding.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import serving
+from paddle_tpu.inference.decode_model import (dense_generate,
+                                               init_decode_model,
+                                               make_step_fn)
+from paddle_tpu.inference.kv_cache import PagedKVCache
+from paddle_tpu.inference.spec_decode import (DraftModelDrafter,
+                                              NGramDrafter,
+                                              SpeculativeDecodeServer)
+from paddle_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.reset()
+
+
+# vocab-32 / seed-5: this toy LM's greedy continuations settle into a
+# short cycle, so the self-speculative n-gram drafter actually locks on
+# (the same workload the bench spec phase uses)
+VOCAB = 32
+PARAMS = init_decode_model(vocab=VOCAB, num_heads=2, head_dim=32, seed=5)
+RS = np.random.RandomState(11)
+SYSTEM = [int(t) for t in RS.randint(0, VOCAB, 8)]   # 2 full pages @ ps=4
+
+
+def prompt(i, extra=4):
+    rs = np.random.RandomState(100 + i)
+    return SYSTEM + [int(t) for t in rs.randint(0, VOCAB, extra)]
+
+
+def oracle(p, n):
+    return dense_generate(PARAMS, p, n)
+
+
+class OracleDrafter:
+    """Drafts the exact greedy continuation — every window fully
+    accepts, driving the fork-adoption (COW append) commit path."""
+
+    def propose(self, history, k):
+        return oracle([int(t) for t in history], k)
+
+
+class WrongDrafter:
+    """Drafts (true_token + 1) mod V — every draft token is rejected,
+    driving the fork-release commit path on every verify step."""
+
+    def propose(self, history, k):
+        return [(int(t) + 1) % VOCAB
+                for t in oracle([int(t) for t in history], k)]
+
+
+def make_stack(drafter=None, spec_k=4, num_pages=64, page_size=4,
+               max_pages_per_seq=16, replicas=2, **srv_kw):
+    cache = PagedKVCache(num_pages, page_size, 2, 32)
+    fn = make_step_fn(PARAMS, cache)
+    cfg_kw = dict(max_batch=32, call_timeout_s=30.0, batch_wait_s=0.002)
+    cfg_kw.update(srv_kw.pop("cfg_kw", {}))
+    cfg = serving.ServingConfig(**cfg_kw)
+    srv = SpeculativeDecodeServer(fn, cache, drafter=drafter,
+                                  spec_k=spec_k, replicas=replicas,
+                                  config=cfg, prefill_chunk=8,
+                                  max_pages_per_seq=max_pages_per_seq,
+                                  **srv_kw)
+    return srv, cache
+
+
+def assert_drained_leak_free(cache):
+    st = cache.stats()
+    assert st["pages_used"] == st["evictable"], st
+
+
+# -- drafter contracts --------------------------------------------------------
+
+def test_ngram_drafter_contract():
+    d = NGramDrafter(max_ngram=3, min_ngram=1)
+    # bigram (1,2) recurs; replay the continuation after its earlier
+    # occurrence — exactly k tokens
+    assert d.propose([1, 2, 3, 1, 2], 3) == [3, 1, 2]
+    # short continuation pads with its last token
+    assert d.propose([5, 9, 5], 4) == [9, 5, 5, 5]
+    # no repeated n-gram at all: no draft (plain decode)
+    assert d.propose([1, 2, 3, 4], 3) == []
+    assert d.propose([], 3) == []
+    with pytest.raises(ValueError):
+        NGramDrafter(max_ngram=1, min_ngram=2)
+
+
+def test_draft_model_drafter_contract():
+    assert DraftModelDrafter(lambda h, k: [1, 2, 3, 4, 5]).propose([0], 3) \
+        == [1, 2, 3]                                   # truncates
+    assert DraftModelDrafter(lambda h, k: [7]).propose([0], 3) \
+        == [7, 7, 7]                                   # pads
+    assert DraftModelDrafter(lambda h, k: []).propose([0], 3) == []
+    def boom(h, k):
+        raise RuntimeError("draft model down")
+    assert DraftModelDrafter(boom).propose([0], 3) == []  # degrades
+
+
+# -- exactness ----------------------------------------------------------------
+
+def test_spec_generations_match_dense_oracle():
+    srv, cache = make_stack(drafter=NGramDrafter())
+    with srv:
+        reqs = [(p, srv.submit_generate(p, 12))
+                for p in (prompt(i) for i in range(6))]
+        for i, (p, r) in enumerate(reqs):
+            assert [int(t) for t in r.result(timeout=60)[0]] \
+                == oracle(p, 12), f"request {i} diverged"
+        assert srv.accounted()
+        sd = srv.stats()["spec_decode"]
+        assert sd["draft_tokens"] > 0 and sd["verify_steps"] > 0
+    assert_drained_leak_free(cache)
+
+
+def test_full_accept_adopts_fork_and_multiplies_tokens_per_step():
+    srv, cache = make_stack(drafter=OracleDrafter(), replicas=1)
+    with srv:
+        p = prompt(0)
+        r = srv.submit_generate(p, 12)
+        assert [int(t) for t in r.result(timeout=60)[0]] == oracle(p, 12)
+        sd = srv.stats()["spec_decode"]
+        # a perfect drafter fully accepts every window...
+        assert sd["verify_steps"] >= 2
+        assert sd["accept_rate"] == 1.0
+        # ...so decode tokens per target-model step rises well above the
+        # plain-decode 1.0 (the whole point of speculation)
+        assert sd["tokens_per_target_step"] > 2.0
+        assert srv.accounted()
+    assert_drained_leak_free(cache)
+
+
+def test_k0_falls_back_to_plain_decode():
+    srv, cache = make_stack(drafter=OracleDrafter(), spec_k=0)
+    with srv:
+        p = prompt(1)
+        r = srv.submit_generate(p, 6)
+        assert [int(t) for t in r.result(timeout=60)[0]] == oracle(p, 6)
+        sd = srv.stats()["spec_decode"]
+        assert sd["draft_tokens"] == 0 and sd["verify_steps"] == 0
+        assert sd["tokens_per_target_step"] == 1.0
+        assert srv.accounted()
+    assert_drained_leak_free(cache)
+
+
+def test_all_rejected_drafts_stay_exact_and_release_forks():
+    srv, cache = make_stack(drafter=WrongDrafter(), replicas=1)
+    with srv:
+        for i in range(3):
+            p = prompt(i)
+            r = srv.submit_generate(p, 8)
+            assert [int(t) for t in r.result(timeout=60)[0]] \
+                == oracle(p, 8), f"request {i} diverged"
+        sd = srv.stats()["spec_decode"]
+        assert sd["verify_steps"] > 0
+        assert sd["accepted_tokens"] == 0 and sd["accept_rate"] == 0.0
+        # every rejected window still commits the one real token
+        assert sd["tokens_per_target_step"] == 1.0
+        assert srv.accounted()
+    # every draft fork was released — no speculative page leaked
+    assert_drained_leak_free(cache)
+
+
+def test_eos_mid_verify_window_truncates_exactly():
+    srv, cache = make_stack(drafter=OracleDrafter(), replicas=1)
+    with srv:
+        p = prompt(2)
+        stream = oracle(p, 16)
+        eos = stream[6]            # first occurrence lands mid-window
+        want = stream[:stream.index(eos) + 1]
+        r = srv.submit_generate(p, 16, eos_token=eos)
+        assert [int(t) for t in r.result(timeout=60)[0]] == want
+        assert srv.accounted()
+    assert_drained_leak_free(cache)
+
+
+# -- resilience / memory pressure ---------------------------------------------
+
+def test_failover_mid_verify_is_idempotent():
+    srv, cache = make_stack(
+        drafter=NGramDrafter(),
+        cfg_kw=dict(max_batch=32, call_timeout_s=1.0, batch_wait_s=0.002,
+                    probation_base_s=0.02, probation_max_s=0.2, seed=3))
+    with srv:
+        srv.submit_generate(prompt(0), 3).result(timeout=30)  # warm-up
+        with faults.inject("replica_stall") as spec:
+            reqs = [(prompt(i), srv.submit_generate(prompt(i), 10))
+                    for i in (1, 2, 3)]
+            for i, (p, r) in enumerate(reqs):
+                assert [int(t) for t in r.result(timeout=90)[0]] \
+                    == oracle(p, 10), f"request {i} diverged"
+        assert spec.fired == 1
+        s = srv.stats()
+        assert s["failovers"] >= 1 and s["failed"] == 0
+        assert s["spec_decode"]["verify_steps"] > 0
+        assert srv.accounted()
+    assert_drained_leak_free(cache)
+
+
+def test_eviction_pressure_with_pinned_forks_stays_exact():
+    # a pool small enough that each speculative generation (which pins
+    # its prefix via the draft fork while live) forces LRU evictions of
+    # the registered prefix pages left behind by completed requests
+    srv, cache = make_stack(drafter=OracleDrafter(), num_pages=12,
+                            max_pages_per_seq=8, replicas=1)
+    with srv:
+        for i in range(6):
+            p = prompt(i * 17 + 1, extra=6)   # distinct 14-token prompts
+            r = srv.submit_generate(p, 10)
+            assert [int(t) for t in r.result(timeout=60)[0]] \
+                == oracle(p, 10), f"request {i} diverged"
+        assert cache.evictions >= 1
+        assert srv.stats()["spec_decode"]["verify_steps"] > 0
+        assert srv.accounted()
+    assert_drained_leak_free(cache)
+
+
+# -- draft-model hook ---------------------------------------------------------
+
+def test_draft_model_drafter_via_second_decode_server():
+    # the "small draft model" is a second DecodeServer (here on the same
+    # toy weights, so drafts are perfect and every window fully accepts)
+    draft_cache = PagedKVCache(64, 4, 2, 32)
+    draft_srv = serving.DecodeServer(
+        make_step_fn(PARAMS, draft_cache), draft_cache, replicas=1,
+        config=serving.ServingConfig(max_batch=32, call_timeout_s=30.0,
+                                     batch_wait_s=0.002),
+        prefill_chunk=8, max_pages_per_seq=16)
+    srv, cache = make_stack(
+        drafter=DraftModelDrafter.from_decode_server(draft_srv),
+        replicas=1)
+    with draft_srv, srv:
+        p = prompt(3)
+        r = srv.submit_generate(p, 10)
+        assert [int(t) for t in r.result(timeout=90)[0]] == oracle(p, 10)
+        sd = srv.stats()["spec_decode"]
+        assert sd["accepted_tokens"] > 0
+        assert srv.accounted() and draft_srv.accounted()
+    assert_drained_leak_free(cache)

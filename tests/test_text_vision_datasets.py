@@ -186,6 +186,7 @@ def test_yolo_box_decode_matches_manual():
     np.testing.assert_allclose(float(boxes[0, 0, 0]), x1, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_yolo_loss_trains_down():
     import jax
     rng = np.random.RandomState(0)

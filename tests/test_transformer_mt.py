@@ -43,6 +43,7 @@ def test_weight_sharing_ties_embeddings():
     assert out.shape == (1, 3, V)
 
 
+@pytest.mark.slow
 def test_copy_task_trains_and_beam_decodes():
     """Learn the copy task, then beam search must reproduce the source
     (the classic seq2seq sanity fixture)."""

@@ -28,6 +28,13 @@ printed:
   shape compiled up front — serving traffic triggers zero fresh
   compiles no matter how decode steps coalesce. Reports decode
   tokens/sec goodput under the deadline contract.
+- **spec-decode** — the same paged-KV stack behind
+  ``SpeculativeDecodeServer``: an n-gram drafter proposes K = 4 tokens
+  per decode step, verify rides the batcher as a 1 + K-token chunk, and
+  decode tokens per target-model step must reach >= 1.5x the
+  non-speculative phase (1.0 by construction) with ``dense_generate``
+  exactness, a still-closed compiled-shape set, and zero leaked pages
+  after drain.
 
 Capacity is made deterministic on any machine by padding each batch
 execute with a fixed service time (the model itself is tiny), so
@@ -170,17 +177,32 @@ def run_phase(server, rate_rps: float, duration_s: float,
 
 
 def _prime_decode_shapes(step, width: int, token_buckets, rows_cap: int):
-    """Compile every (token-bucket, row-bucket) shape of BOTH executor
-    paths (mixed prefill and pure decode) before serving starts, so
-    traffic triggers zero fresh compiles regardless of how decode steps
-    coalesce into batches."""
+    """Compile every (token-bucket, row-bucket) shape of ALL executor
+    paths — ragged mixed prefill, pure decode, and the rectangular
+    uniform-extension (speculative-verify / same-width-prefill) repack —
+    before serving starts, so traffic triggers zero fresh compiles
+    regardless of how decode steps coalesce into batches."""
     for t_b in token_buckets:
         r_b = min(t_b, rows_cap)
         tables = np.zeros((r_b, width), np.int32)
-        # mixed/prefill shape: one cold row owning every token
+        # uniform extension shape: one cold row owning every token —
+        # for t_b >= 2 this repacks to the (r_b, t_b) verify rectangle
         step([np.zeros(t_b, np.int32), np.zeros(t_b, np.int32),
               np.arange(t_b, dtype=np.int32), np.ones(t_b, np.int32),
               tables, np.zeros(r_b, np.int32), np.zeros(r_b, np.int32)])
+        if t_b >= 2:
+            # ragged mixed shape: a 1-token decode row sharing the batch
+            # with a cold (t_b - 1)-token prefill row — non-uniform
+            # counts force the flattened varlen path
+            row_id = np.ones(t_b, np.int32)
+            row_id[0] = 0
+            ctx = np.zeros(r_b, np.int32)
+            ctx[0] = 1
+            last = np.zeros(r_b, np.int32)
+            last[1] = t_b - 1
+            step([np.zeros(t_b, np.int32), row_id,
+                  np.arange(t_b, dtype=np.int32), np.ones(t_b, np.int32),
+                  tables, ctx, last])
         # pure-decode shape: r_b rows with context, one token each
         valid = np.zeros(t_b, np.int32)
         valid[:r_b] = 1
@@ -189,6 +211,146 @@ def _prime_decode_shapes(step, width: int, token_buckets, rows_cap: int):
         step([np.zeros(t_b, np.int32), row_id, np.ones(t_b, np.int32),
               valid, tables, np.ones(r_b, np.int32),
               np.arange(r_b, dtype=np.int32)])
+
+
+def _prime_spec_shapes(step, width: int, rows_cap: int, spec_k: int):
+    """Compile the speculative-verify rectangle: a 1 + K chunk lands in
+    the bucketed (token, row) shape like any prefill chunk, and the
+    executor repacks it to (R, S) — prime that path so spec traffic
+    triggers zero fresh compiles."""
+    chunk = 1 + spec_k
+    t_b = 1 << (chunk - 1).bit_length()
+    r_b = min(t_b, rows_cap)
+    tables = np.zeros((r_b, width), np.int32)
+    valid = np.zeros(t_b, np.int32)
+    valid[:chunk] = 1
+    ctx = np.zeros(r_b, np.int32)
+    ctx[0] = 1
+    step([np.zeros(t_b, np.int32), np.zeros(t_b, np.int32),
+          np.arange(t_b, dtype=np.int32), valid, tables, ctx,
+          np.zeros(r_b, np.int32)])
+
+
+def run_spec_decode_bench(smoke: bool, seed: int) -> dict:
+    """Speculative-decode phase: the same paged-KV serving stack with an
+    n-gram drafter proposing K = 4 tokens per decode step, verified in
+    one target-model step each. The workload is prefix-heavy AND
+    repetitive (the toy LM's greedy stream falls into short cycles), so
+    drafting pays; ``dense_generate`` is the exactness oracle — every
+    completed generation must match plain greedy token-for-token. The
+    acceptance bar is decode tokens per target-model step >= 1.5x the
+    non-speculative decode phase (which is 1.0 by construction)."""
+    from paddle_tpu.inference import serving, spec_decode
+    from paddle_tpu.inference.decode_model import (dense_generate,
+                                                   init_decode_model,
+                                                   make_step_fn)
+    from paddle_tpu.inference.kv_cache import PagedKVCache
+
+    heads, head_dim, page_size = 2, 32, 16
+    num_pages, width = 32, 4
+    replicas, token_budget, rows_cap = 2, 8, 4
+    spec_k, max_new = 4, 24
+    pad_s = 0.01
+    rate_rps = 6.0 if smoke else 8.0
+    duration = 1.5 if smoke else 4.0
+    deadline_s = 8.0
+
+    # vocab 32 drives the toy LM's greedy stream into short cycles the
+    # n-gram drafter locks onto — the CPU proxy for repetitive text
+    params = init_decode_model(vocab=32, num_heads=heads,
+                               head_dim=head_dim, seed=5)
+    rng = np.random.RandomState(seed + 29)
+    system = [int(t) for t in rng.randint(0, 32, 2 * page_size)]
+    variants = [system + [int(t) for t in
+                          np.random.RandomState(1000 + i).randint(0, 32, 8)]
+                for i in range(6)]
+    oracle = {i: dense_generate(params, v, max_new)
+              for i, v in enumerate(variants)}
+
+    cache = PagedKVCache(num_pages, page_size, heads, head_dim)
+    step = make_step_fn(params, cache)
+    _prime_decode_shapes(step, width, (1, 2, 4, 8), rows_cap)
+    _prime_spec_shapes(step, width, rows_cap, spec_k)
+    jits_primed = sum(f._cache_size() for f in step.jit_fns)
+
+    cfg = serving.ServingConfig(
+        max_queue=8, max_batch=token_budget, batch_wait_s=0.002,
+        call_timeout_s=3.0, admission_safety=1.3, seed=seed)
+    server = spec_decode.SpeculativeDecodeServer(
+        make_step_executor(step, pad_s), cache,
+        drafter=spec_decode.NGramDrafter(), spec_k=spec_k,
+        replicas=replicas, config=cfg, prefill_chunk=8,
+        max_pages_per_seq=width, max_batch_rows=rows_cap)
+
+    with server:
+        server.submit_generate(variants[0], max_new,
+                               deadline_s=60.0).result(timeout=120)
+
+        reqs = []
+        t0 = time.monotonic()
+        next_t, i = t0, 0
+        while True:
+            next_t += rng.exponential(1.0 / rate_rps)
+            if next_t - t0 > duration:
+                break
+            lag = next_t - time.monotonic()
+            if lag > 0:
+                time.sleep(lag)
+            i += 1
+            reqs.append((i % len(variants),
+                         server.submit_generate(variants[i % len(variants)],
+                                                max_new,
+                                                deadline_s=deadline_s)))
+        elapsed = time.monotonic() - t0
+        settle = time.monotonic() + deadline_s + 20.0
+        for _, r in reqs:
+            r._done.wait(max(0.0, settle - time.monotonic()))
+        stats = server.stats()
+        accounted = server.accounted()
+        server.shutdown(drain=True)
+
+    jits_final = sum(f._cache_size() for f in step.jit_fns)
+    cstats = cache.stats()
+    spec = stats["spec_decode"]
+    completed = [(v, r) for v, r in reqs if r.state == "completed"]
+    exact = all([int(t) for t in r.outputs[0]] == oracle[v]
+                for v, r in completed)
+    by_state = {}
+    for _, r in reqs:
+        by_state[r.state] = by_state.get(r.state, 0) + 1
+    checks = {
+        "spec_exact_vs_dense": exact and len(completed) > 0,
+        "spec_tokens_per_step": spec["tokens_per_target_step"] >= 1.5,
+        "spec_accepts_drafts": spec["accepted_tokens"] > 0,
+        "spec_compiled_set_closed": jits_final == jits_primed,
+        "spec_zero_lost": (accounted and by_state.get("failed", 0) == 0
+                           and stats["failed"] == 0),
+        # nothing pinned after drain: every used page is evictable
+        # prefix registry — the same baseline state the warm generation
+        # left behind (no leaked sequence or draft-fork references)
+        "spec_pages_drained": cstats["pages_used"] == cstats["evictable"],
+    }
+    return {
+        "tokens_per_target_step": round(spec["tokens_per_target_step"], 3),
+        "nonspec_tokens_per_target_step": 1.0,
+        "accept_rate": round(spec["accept_rate"], 4),
+        "draft_tokens": spec["draft_tokens"],
+        "accepted_tokens": spec["accepted_tokens"],
+        "verify_steps": spec["verify_steps"],
+        "spec_k": spec_k,
+        "offered_rps": round(len(reqs) / elapsed, 1),
+        "duration_s": round(elapsed, 3),
+        "submitted": len(reqs),
+        "completed": by_state.get("completed", 0),
+        "shed": by_state.get("shed", 0),
+        "expired": by_state.get("expired", 0),
+        "failed": by_state.get("failed", 0),
+        "decode_tokens": stats["decode_tokens"],
+        "jit_shapes": {"primed": jits_primed, "final": jits_final},
+        "recompiles": stats["recompiles"],
+        "kv_cache": cstats,
+        "checks": checks,
+    }
 
 
 def run_decode_bench(smoke: bool, seed: int) -> dict:
@@ -401,6 +563,8 @@ def run_bench(smoke: bool, seed: int = 0) -> dict:
 
     decode = run_decode_bench(smoke, seed)
     decode_checks = decode.pop("checks")
+    spec = run_spec_decode_bench(smoke, seed)
+    spec_checks = spec.pop("checks")
 
     shed_total = (overload["shed"] + overload["expired"])
     goodput_band_ok = (
@@ -430,6 +594,7 @@ def run_bench(smoke: bool, seed: int = 0) -> dict:
         "trace_accounting_closed": trace_accounting_closed,
     }
     checks.update(decode_checks)
+    checks.update(spec_checks)
     return {
         "schema_version": 1,
         "metric": "serving_overload_goodput_rps",
@@ -454,6 +619,7 @@ def run_bench(smoke: bool, seed: int = 0) -> dict:
             },
             "accounted": accounted,
             "decode": decode,
+            "spec_decode": spec,
             "kv_cache_hit_rate": decode["kv_cache_hit_rate"],
             "stats": stats,
             "tracing": {

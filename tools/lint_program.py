@@ -6,8 +6,9 @@ comm_err / compressed grad-sync plumbing included) and runs every rule
 in paddle_tpu.analysis over it, plus the cost model's top-k
 most-expensive-equations table. The serving path is linted too: the
 DecodeServer executor programs (``decode-mixed`` ragged prefill,
-``decode-decode`` paged decode) are traced from ShapeDtypeStructs at
-the bench shapes.
+``decode-decode`` paged decode, ``decode-verify`` the rectangular
+speculative-verify repack) are traced from ShapeDtypeStructs at the
+bench shapes.
 
 Exit status is the CI contract: 0 when no error-severity finding on any
 model, 1 otherwise — warnings and infos print but do not fail unless
@@ -109,9 +110,17 @@ def _decode_jaxpr(which: str, smoke: bool):
     params = init_decode_model(vocab, heads, hd, max_len=1024)
     cache = PagedKVCache(pages, page, heads, hd, num_layers=1)
     step = make_step_fn(params, cache)
-    mixed, decode = step.jit_fns
+    mixed, decode, verify = step.jit_fns
     kp, vp = cache.pools(0)
     s = jax.ShapeDtypeStruct
+    if which == "verify":
+        # speculative-verify chunks: (R, S) rectangular repack, S = the
+        # bucketed 1 + K chunk width (K = 4 at the bench spec shapes)
+        sv = 8
+        args = (s(kp.shape, kp.dtype), s(vp.shape, vp.dtype),
+                s((r, sv), np.int32), s((r,), np.int32),
+                s((r, w), np.int32), s((r,), np.int32))
+        return jax.make_jaxpr(lambda *a: verify(*a))(*args)
     args = (s(kp.shape, kp.dtype), s(vp.shape, vp.dtype),
             s((t,), np.int32), s((t,), np.int32), s((t,), np.int32),
             s((t,), np.bool_), s((r, w), np.int32), s((r,), np.int32),
@@ -124,7 +133,8 @@ def _decode_jaxpr(which: str, smoke: bool):
 BUILDERS = {"gpt": _build_gpt, "bert": _build_bert}
 # Inference executor programs: plain ClosedJaxprs, no trainer.
 PROGRAMS = {"decode-mixed": lambda smoke: _decode_jaxpr("mixed", smoke),
-            "decode-decode": lambda smoke: _decode_jaxpr("decode", smoke)}
+            "decode-decode": lambda smoke: _decode_jaxpr("decode", smoke),
+            "decode-verify": lambda smoke: _decode_jaxpr("verify", smoke)}
 ALL_MODELS = tuple(BUILDERS) + tuple(PROGRAMS)
 
 
