@@ -18,10 +18,13 @@ from __future__ import annotations
 
 from typing import Iterable, Optional
 
-from . import cost, report, rules, sharding, walker
+from . import cost, report, rules, schedule, sharding, walker
 from .report import CostRow, CostSummary, Finding, Report
 from .rules import (RULES, AnalysisConfig, RuleContext, register_rule,
                     run_rules)
+from .schedule import (FAMILIES, CollectiveSite, ProgramFamily,
+                       ScheduleMismatch, crossrank_verify, extract_schedule,
+                       program_fingerprint, register_family, verify_family)
 from .sharding import ReshardSite, ShardingInfo, propagate, resharding_table
 from .walker import count_eqns, walk
 
@@ -29,7 +32,10 @@ __all__ = [
     "analyze", "analyze_jaxpr", "AnalysisConfig", "Report", "Finding",
     "CostRow", "CostSummary", "RULES", "register_rule", "run_rules",
     "RuleContext", "walker", "rules", "cost", "report", "sharding",
-    "ReshardSite", "ShardingInfo", "propagate", "resharding_table",
+    "schedule", "ReshardSite", "ShardingInfo", "propagate",
+    "resharding_table", "CollectiveSite", "ProgramFamily", "FAMILIES",
+    "ScheduleMismatch", "crossrank_verify", "extract_schedule",
+    "program_fingerprint", "register_family", "verify_family",
 ]
 
 

@@ -1219,6 +1219,27 @@ class ParallelTrainer:
         closed, _ = self._staged_jaxpr(step, inputs, labels, lr)
         return closed
 
+    def program_family(self, inputs, labels, lr=None):
+        """The integrity do_check pair as a declared
+        :class:`~paddle_tpu.analysis.schedule.ProgramFamily`: train_step
+        picks between the plain and fingerprint-check programs with
+        ``self._steps_run % integrity_check_every`` — a host-replicated
+        step counter, so the selection is rank-invariant by
+        construction. The schedule verifier checks both members are
+        individually hang-free."""
+        from ..analysis.schedule import ProgramFamily
+        return ProgramFamily(
+            name="trainer-step",
+            selector="steps_run % integrity_check_every "
+                     "(host-replicated step counter)",
+            rank_invariant=True,
+            members={
+                "step": lambda: self.staged_jaxpr(inputs, labels, lr),
+                "step-check": lambda: self.staged_jaxpr(
+                    inputs, labels, lr, do_check=True),
+            },
+            mesh=self.mesh)
+
     # -- run ----------------------------------------------------------------
     def train_step(self, inputs, labels, lr: Optional[float] = None,
                    grad_taint: Optional[float] = None):

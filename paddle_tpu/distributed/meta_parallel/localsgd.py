@@ -253,6 +253,25 @@ class LocalSGDTrainer:
             get_rng_key(), jnp.float32(0.1), jnp.asarray(inputs),
             jnp.asarray(labels))
 
+    def program_family(self, inputs, labels):
+        """The sync/no-sync pair as a declared
+        :class:`~paddle_tpu.analysis.schedule.ProgramFamily`: the member
+        is picked by ``step_no % k_steps`` — a host-replicated counter
+        every rank advances identically (the adaptive-k schedule updates
+        from the ALL-REDUCED drift, so it stays replicated too), making
+        the deliberately divergent schedules safe."""
+        from ...analysis.schedule import ProgramFamily
+        return ProgramFamily(
+            name="localsgd-step",
+            selector="step_no % k_steps (host-replicated step counter; "
+                     "adaptive k derives from all-reduced drift)",
+            rank_invariant=True,
+            members={
+                "sync": lambda: self.step_jaxpr(True, inputs, labels),
+                "no-sync": lambda: self.step_jaxpr(False, inputs, labels),
+            },
+            mesh=self.mesh)
+
     def train_step(self, inputs, labels, lr=None):
         lr = self.optimizer.get_lr() if lr is None else lr
         self._step_no += 1

@@ -47,7 +47,8 @@ import numpy as np
 from ..ops.pallas.paged_attention import (paged_decode_attention,
                                           paged_prefill_attention)
 
-__all__ = ["init_decode_model", "make_step_fn", "dense_generate"]
+__all__ = ["init_decode_model", "make_step_fn", "dense_generate",
+           "executor_family"]
 
 
 def init_decode_model(vocab: int = 128, num_heads: int = 2,
@@ -211,6 +212,39 @@ def make_step_fn(params: Dict[str, np.ndarray], cache,
     # compiled-shape set directly via _cache_size()
     step.jit_fns = (_mixed, _decode, _verify)
     return step
+
+
+def executor_family(step, arg_specs, mesh=None, name="decode-executor"):
+    """The mixed/decode/verify executor router as a declared
+    :class:`~paddle_tpu.analysis.schedule.ProgramFamily`.
+
+    The server picks which compiled program runs from the BATCH
+    COMPOSITION (prefill rows present / pure decode / speculative-verify
+    chunks) — a host decision every rank makes identically for a given
+    dispatched batch, so the members' schedules may legitimately
+    diverge. ``arg_specs`` maps member name to the abstract argument
+    tuple (``jax.ShapeDtypeStruct``) each executor fn is traced with;
+    members absent from it are skipped.
+    """
+    import jax as _jax
+
+    from ..analysis.schedule import ProgramFamily
+
+    fns = dict(zip(("mixed", "decode", "verify"), step.jit_fns))
+    members = {}
+    for member, fn in fns.items():
+        if member not in arg_specs:
+            continue
+        spec = tuple(arg_specs[member])
+        members[member] = (
+            lambda f=fn, a=spec: _jax.make_jaxpr(lambda *xs: f(*xs))(*a))
+    return ProgramFamily(
+        name=name,
+        selector="batch composition bucket (has-prefill / pure-decode / "
+                 "has-verify rows), host-uniform per dispatched batch",
+        rank_invariant=True,
+        members=members,
+        mesh=mesh)
 
 
 def dense_generate(params: Dict[str, np.ndarray], prompt_tokens,

@@ -303,7 +303,8 @@ class ElasticRuntime:
                  remesh_fn: Optional[Callable] = None, max_remeshes: int = 2,
                  poll: float = 0.25, stabilize_polls: int = 3,
                  stabilize_timeout: float = 60.0,
-                 barrier_timeout: float = 120.0):
+                 barrier_timeout: float = 120.0,
+                 schedule_fingerprints=None):
         self.manager = manager
         self.coordinator = coordinator
         self.remesh_fn = remesh_fn
@@ -312,6 +313,12 @@ class ElasticRuntime:
         self.stabilize_polls = stabilize_polls
         self.stabilize_timeout = stabilize_timeout
         self.barrier_timeout = barrier_timeout
+        # {program: collective-schedule fingerprint} (or a zero-arg
+        # callable producing it): cross-checked against every other
+        # rank through the coordinator on each enter() — trainer start
+        # AND every elastic remesh — aborting with a diff instead of
+        # wedging into the collective hang the divergence would cause
+        self.schedule_fingerprints = schedule_fingerprints
         self.remeshes = 0
         self.barrier_steps: List[int] = []   # common step of each entry
         self._adopted: Optional[set] = None  # host set training started on
@@ -414,6 +421,17 @@ class ElasticRuntime:
             hosts = self._stable_hosts()
             self._adopted = set(hosts if hosts is not None
                                 else self.manager.hosts())
+        if (self.schedule_fingerprints is not None
+                and self.coordinator is not None):
+            from ..analysis.schedule import crossrank_verify
+            fps = self.schedule_fingerprints
+            if callable(fps):
+                fps = fps()
+            # unique exchange name per entry: a remesh re-entry must not
+            # read the previous generation's stale allgather files
+            crossrank_verify(
+                self.coordinator, fps, self._coord_hosts, timeout=timeout,
+                name=f"schedule_fp_{len(self.barrier_steps)}")
         if self.coordinator is not None and ckpt_manager is not None:
             restored, common = coordinated_restore(
                 ckpt_manager, template, self.coordinator,

@@ -38,10 +38,11 @@ import time
 from typing import Dict, List, Optional
 
 __all__ = ["SimCluster", "worker_main", "HOST_LOSS_EXIT",
-           "HOST_HANG_EXIT"]
+           "HOST_HANG_EXIT", "SCHEDULE_MISMATCH_EXIT"]
 
 HOST_LOSS_EXIT = 9   # a host_loss death (distinct from every runner code)
 HOST_HANG_EXIT = 10  # hang-watchdog self-termination (wedged step)
+SCHEDULE_MISMATCH_EXIT = 11  # bootstrap collective-schedule verify abort
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -160,6 +161,10 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--fault", action="append", default=[],
                    metavar="KIND:STEP",
                    help="arm a deterministic fault, e.g. host_loss:12")
+    p.add_argument("--desync-schedule", action="store_true",
+                   help="advertise the WRONG program fingerprint (the "
+                        "integrity-check variant) so the bootstrap "
+                        "schedule verification must abort the cluster")
     args = p.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -226,6 +231,18 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
         # so a host loss exercises the R=2 -> R=1 residual remap
         return {"data": 2 if len(hosts) >= world else 1}
 
+    # Bootstrap collective-schedule fingerprint: every host hashes the
+    # canonical collective schedule of the program it is ABOUT to run and
+    # cross-checks it through the coordinator before the first step (and
+    # again after every elastic remesh). A --desync-schedule host hashes
+    # the integrity-check variant instead — a realistic skew (one host
+    # thinks this is a check step) whose schedules genuinely diverge.
+    from ..analysis.schedule import ScheduleMismatch, program_fingerprint
+    x0, y0 = _tiny_batches()[0]
+    fp_closed = trainer.staged_jaxpr(x0, y0,
+                                     do_check=args.desync_schedule)
+    fps = {"train-step": program_fingerprint(fp_closed, trainer.mesh)}
+
     runtime = ElasticRuntime(
         em, coordinator=coord,
         remesh_fn=data_parallel_remesh_fn(
@@ -233,7 +250,16 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
             degrees_fn=_degrees),
         max_remeshes=args.max_remeshes,
         poll=0.1, stabilize_polls=3, stabilize_timeout=30.0,
-        barrier_timeout=60.0)
+        barrier_timeout=60.0,
+        schedule_fingerprints=fps)
+
+    def _write_result(out: dict):
+        results_dir = os.path.join(args.root, "results")
+        os.makedirs(results_dir, exist_ok=True)
+        tmp = os.path.join(results_dir, f".{args.host}.tmp")
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+        os.replace(tmp, os.path.join(results_dir, args.host + ".json"))
 
     with contextlib.ExitStack() as stack:
         for spec in args.fault:
@@ -247,6 +273,21 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
         except faults.HostLost:
             # abrupt machine death: no deregister, no flush, no result
             os._exit(HOST_LOSS_EXIT)
+        except ScheduleMismatch as e:
+            # the bootstrap fingerprint exchange found rank disagreement:
+            # abort with the diffed report instead of wedging into the
+            # collective hang it predicts (the watchdog never fires)
+            _write_result({
+                "host": args.host,
+                "exit_code": SCHEDULE_MISMATCH_EXIT,
+                "status": "schedule_mismatch",
+                "schedule_diff": e.diff,
+                "telemetry": telemetry.get_registry().to_dict(),
+            })
+            mgr.close()
+            hb_stop.set()
+            em.close()
+            return SCHEDULE_MISMATCH_EXIT
 
     snap = telemetry.get_registry().to_dict()
     out = {
@@ -266,12 +307,7 @@ def worker_main(argv: Optional[List[str]] = None) -> int:
         "data_degree_final": int(trainer.mesh.shape.get("data", 1)),
         "telemetry": snap,
     }
-    results_dir = os.path.join(args.root, "results")
-    os.makedirs(results_dir, exist_ok=True)
-    tmp = os.path.join(results_dir, f".{args.host}.tmp")
-    with open(tmp, "w") as f:
-        json.dump(out, f)
-    os.replace(tmp, os.path.join(results_dir, args.host + ".json"))
+    _write_result(out)
     mgr.close()
     hb_stop.set()
     em.close()
@@ -308,7 +344,8 @@ class SimCluster:
         for i, upto in steps_by_host.items():
             seed_checkpoints(self.host_ckpt_dir(i), upto, seed=self.seed)
 
-    def _spawn(self, i: int, faults_for: List[tuple]) -> subprocess.Popen:
+    def _spawn(self, i: int, faults_for: List[tuple],
+               desync: bool = False) -> subprocess.Popen:
         cmd = [sys.executable, "-m", "paddle_tpu.resilience.hostsim",
                "--root", self.root, "--host", _host_name(i),
                "--world", str(self.n_hosts), "--np", self.np_spec,
@@ -317,6 +354,8 @@ class SimCluster:
                "--step-delay", str(self.step_delay)]
         if self.hang_timeout:
             cmd += ["--hang-timeout", str(self.hang_timeout)]
+        if desync:
+            cmd += ["--desync-schedule"]
         for kind, at in faults_for:
             cmd += ["--fault", f"{kind}:{at}"]
         env = dict(os.environ)
@@ -327,12 +366,17 @@ class SimCluster:
                                 stderr=subprocess.PIPE, text=True)
 
     def run(self, faults: Optional[Dict[int, List[tuple]]] = None,
-            timeout: float = 300.0) -> dict:
+            timeout: float = 300.0,
+            desync_hosts: Optional[set] = None) -> dict:
         """Run the cluster to completion. ``faults`` maps host index ->
-        [(kind, at_step), ...]. Returns per-host exit codes, parsed
-        result JSONs (None for dead hosts), and the host-loss count."""
+        [(kind, at_step), ...]; ``desync_hosts`` is a set of host indices
+        launched with ``--desync-schedule``. Returns per-host exit codes,
+        parsed result JSONs (None for dead hosts), and the host-loss
+        count."""
         faults = faults or {}
-        procs = {i: self._spawn(i, faults.get(i, []))
+        desync_hosts = desync_hosts or set()
+        procs = {i: self._spawn(i, faults.get(i, []),
+                                desync=i in desync_hosts)
                  for i in range(self.n_hosts)}
         deadline = time.time() + timeout
         exit_codes: Dict[str, Optional[int]] = {}

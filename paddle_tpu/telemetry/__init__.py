@@ -169,6 +169,15 @@ flight_dumps_total             counter    flight-recorder ring dumps
                                           slo_*}
 slo_alerts_total               counter    telemetry.slo rolling-window
                                           burn-rate breaches {rule=...}
+schedule_verify_total          counter    cross-rank collective-schedule
+                                          fingerprint verifications
+                                          (bootstrap + every elastic
+                                          remesh re-entry)
+collective_schedule_mismatch_total counter programs whose collective-
+                                          schedule fingerprints diverged
+                                          across hosts (the verify
+                                          aborts with a diff instead of
+                                          letting the ranks hang)
 =============================  =========  =================================
 
 Multi-host merge: ``telemetry.aggregate.gather_registries()`` allgathers

@@ -423,9 +423,11 @@ def test_numerics_smoke_cpu():
 
 def test_lint_program_smoke_strict():
     """lint_program --smoke --strict over every registered program
-    (bench trainers + decode executors): any future rule regression or
-    new warning on the shipped programs fails tier-1 here, not at
-    snapshot time."""
+    (bench trainers + decode executors) PLUS the declared program
+    families: any future rule regression, new warning, or schedule
+    hazard on the shipped programs fails tier-1 here, not at snapshot
+    time. Every per-program record must carry its collective-schedule
+    fingerprint and be individually ok."""
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "lint_program.py"),
          "--smoke", "--strict", "--json"],
@@ -434,7 +436,19 @@ def test_lint_program_smoke_strict():
         f"lint rc={proc.returncode}\nstdout tail: {proc.stdout[-3000:]}\n"
         f"stderr tail: {proc.stderr[-2000:]}")
     out = json.loads(proc.stdout.strip().splitlines()[-1])
-    assert set(out) == {"gpt", "bert", "decode-mixed", "decode-decode",
-                        "decode-verify"}
-    for name, rep in out.items():
+    programs = {"gpt", "bert", "decode-mixed", "decode-decode",
+                "decode-verify"}
+    assert programs | {"__families__"} <= set(out)
+    for name in programs:
+        rep = out[name]
         assert rep["ok"], f"{name}: {rep['findings']}"
+        fp = rep["schedule_fingerprint"]
+        assert isinstance(fp, str) and len(fp) == 64, (name, fp)
+        assert rep["num_collectives"] >= 0
+    fams = out["__families__"]
+    assert {"trainer-step", "localsgd-step", "decode-executor"} \
+        <= set(fams)
+    for fname, res in fams.items():
+        assert res["ok"], f"{fname}: {json.dumps(res)}"
+        for member, m in res["members"].items():
+            assert m["fingerprint"] == res["fingerprints"][member]

@@ -1,0 +1,577 @@
+"""Tests for the static collective-schedule verifier (ISSUE 17):
+``analysis/schedule.py`` extraction + fingerprints, the four deadlock
+rules (seeded-violation fixtures: exactly one violation, rule fires
+exactly once, clean variant stays silent — the test_analysis contract),
+program families, the cross-rank bootstrap check, and the hostsim
+schedule-divergence abort.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu import telemetry
+from paddle_tpu.analysis import schedule as S
+from paddle_tpu.analysis.rules import run_rules
+
+
+def mesh2():
+    return Mesh(np.array(jax.devices()[:2]), ("data",))
+
+
+def smap(fn, mesh, ins=None, outs=None):
+    return jax.shard_map(fn, mesh=mesh,
+                         in_specs=P("data") if ins is None else ins,
+                         out_specs=P("data") if outs is None else outs,
+                         check_vma=False)
+
+
+def hits(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+def run_sched_rules(closed, mesh):
+    return run_rules(closed, mesh=mesh, rules=S.SCHEDULE_RULE_IDS)
+
+
+# ---------------------------------------------------------------------------
+# extraction + fingerprints (walker corners the verifier depends on)
+# ---------------------------------------------------------------------------
+
+class TestScheduleExtraction:
+    def test_psum_site_identity(self):
+        mesh = mesh2()
+        cj = jax.make_jaxpr(smap(lambda x: lax.psum(x, "data"), mesh,
+                                 outs=P()))(jnp.ones((8, 4), jnp.float32))
+        sched = S.extract_schedule(cj, mesh=mesh)
+        assert len(sched) == 1
+        s = sched[0]
+        assert s.kind == "psum" and s.axes == ("data",)
+        assert s.wire_dtype == "float32"
+        # 4x4 f32 shard = 64 B, already a power of two
+        assert s.payload_bucket == 64
+        assert s.link == "ici"
+        assert s.context == ("shard_map",)
+
+    def test_while_cond_vs_body_contexts(self):
+        """Collectives in a while's PREDICATE and BODY must both be
+        extracted, with distinguishable control-flow contexts — the
+        global-termination-vote pattern puts a psum in the cond."""
+        mesh = mesh2()
+
+        def f(x):
+            def cond(c):
+                return lax.psum(c[1].sum(), "data") < 100.0
+
+            def body(c):
+                return (c[0] + 1, c[1] + lax.psum(c[1], "data"))
+
+            return lax.while_loop(cond, body, (0, x))[1]
+
+        cj = jax.make_jaxpr(smap(f, mesh))(jnp.ones((8, 4), jnp.float32))
+        sched = S.extract_schedule(cj, mesh=mesh)
+        contexts = sorted(s.context for s in sched)
+        assert contexts == [("shard_map", "while[body]"),
+                            ("shard_map", "while[cond]")]
+        assert all(s.in_loop for s in sched)
+
+    def test_shard_map_closed_over_axis_names(self):
+        """An inner function referencing axis names through a closure
+        (not parameters) still extracts with the right axes bound."""
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("data", "model"))
+        axis = "model"  # closed over
+
+        def inner(v):
+            return lax.psum(v, axis)
+
+        f = jax.shard_map(inner, mesh=mesh,
+                          in_specs=P("data", "model"),
+                          out_specs=P("data", None), check_vma=False)
+        cj = jax.make_jaxpr(f)(jnp.ones((4, 8), jnp.float32))
+        sched = S.extract_schedule(cj, mesh=mesh)
+        assert len(sched) == 1
+        assert sched[0].axes == ("model",)
+        # clean under the whole schedule rule set, too
+        assert not run_sched_rules(cj, mesh)
+
+    def test_psum2_rewrite_normalized(self):
+        """check_vma=True traces psum as psum2; the schedule must
+        normalize so both trace modes fingerprint identically."""
+        mesh = mesh2()
+        x = jnp.ones((8, 4), jnp.float32)
+        plain = jax.make_jaxpr(smap(lambda v: lax.psum(v, "data"), mesh,
+                                    outs=P()))(x)
+        rewritten = jax.make_jaxpr(jax.shard_map(
+            lambda v: lax.psum(v, "data"), mesh=mesh, in_specs=P("data"),
+            out_specs=P(), check_vma=True))(x)
+        s1 = S.extract_schedule(plain, mesh=mesh)
+        s2 = S.extract_schedule(rewritten, mesh=mesh)
+        assert [s.kind for s in s2] == ["psum"]
+        assert S.fingerprint(s1) == S.fingerprint(s2)
+
+    def test_payload_bucketed_to_pow2(self):
+        mesh = mesh2()
+        # 6x4 f32 shard = 96 B -> bucket 128
+        cj = jax.make_jaxpr(smap(lambda x: lax.psum(x, "data"), mesh,
+                                 outs=P()))(jnp.ones((12, 4), jnp.float32))
+        assert S.extract_schedule(cj, mesh=mesh)[0].payload_bucket == 128
+
+    def test_fingerprint_stable_and_schedule_sensitive(self):
+        mesh = mesh2()
+        x = jnp.ones((8, 4), jnp.float32)
+        f = smap(lambda v: lax.psum(v, "data"), mesh, outs=P())
+        fp1 = S.program_fingerprint(jax.make_jaxpr(f)(x), mesh)
+        fp2 = S.program_fingerprint(jax.make_jaxpr(f)(x), mesh)
+        assert fp1 == fp2  # retrace-stable
+        g = smap(lambda v: lax.pmax(v, "data"), mesh, outs=P())
+        assert fp1 != S.program_fingerprint(jax.make_jaxpr(g)(x), mesh)
+        # payload bucket is part of the identity
+        big = jax.make_jaxpr(f)(jnp.ones((64, 64), jnp.float32))
+        assert fp1 != S.program_fingerprint(big, mesh)
+        # collective-free program: stable empty-schedule fingerprint
+        empty = jax.make_jaxpr(lambda v: v * 2)(x)
+        assert S.extract_schedule(empty) == []
+        assert S.program_fingerprint(empty) == S.fingerprint([])
+
+    def test_format_and_rows(self):
+        mesh = mesh2()
+        cj = jax.make_jaxpr(smap(lambda x: lax.psum(x, "data"), mesh,
+                                 outs=P()))(jnp.ones((8, 4), jnp.float32))
+        sched = S.extract_schedule(cj, mesh=mesh)
+        rows = S.schedule_rows(sched)
+        assert rows[0]["kind"] == "psum" and rows[0]["link"] == "ici"
+        txt = S.format_schedule(sched)
+        assert "psum" in txt and "data" in txt
+        assert S.format_schedule([]) == "  (no collectives)"
+
+
+# ---------------------------------------------------------------------------
+# the four deadlock rules: seeded fixtures fire exactly once; clean
+# variants stay silent
+# ---------------------------------------------------------------------------
+
+class TestDeadlockRules:
+    def test_order_divergence_fires_once(self):
+        mesh = mesh2()
+
+        def div(x):
+            pred = x.sum() > 0
+            return lax.cond(pred, lambda v: lax.psum(v, "data"),
+                            lambda v: v * 2.0, x)
+
+        cj = jax.make_jaxpr(smap(div, mesh))(jnp.ones((8, 4), jnp.float32))
+        fs = run_sched_rules(cj, mesh)
+        assert len(hits(fs, "collective-order-divergence")) == 1
+        assert hits(fs, "collective-order-divergence")[0].severity == \
+            "error"
+
+    def test_order_divergence_clean_identical_branches(self):
+        mesh = mesh2()
+
+        def same(x):
+            pred = x.sum() > 0
+            return lax.cond(pred, lambda v: lax.psum(v, "data"),
+                            lambda v: lax.psum(v * 2.0, "data"), x)
+
+        cj = jax.make_jaxpr(smap(same, mesh))(
+            jnp.ones((8, 4), jnp.float32))
+        assert not hits(run_sched_rules(cj, mesh),
+                        "collective-order-divergence")
+
+    def test_order_divergence_remat_clone_dedup(self):
+        """jax.checkpoint re-traces the divergent cond inside the
+        backward pass: the fwd and bwd clones share source + branch
+        signature and must collapse to ONE finding."""
+        mesh = mesh2()
+
+        def loss(x):
+            @jax.checkpoint
+            def blk(v):
+                pred = v.sum() > 0
+                return lax.cond(pred, lambda u: lax.psum(u, "data"),
+                                lambda u: u * 2.0, v)
+            return blk(x).sum()
+
+        cj = jax.make_jaxpr(smap(jax.grad(loss), mesh))(
+            jnp.ones((8, 4), jnp.float32))
+        fs = hits(run_sched_rules(cj, mesh), "collective-order-divergence")
+        assert len(fs) == 1, [f.message for f in fs]
+
+    def test_data_dependent_while_fires_once(self):
+        mesh = mesh2()
+
+        def f(x):
+            return lax.while_loop(lambda c: c.sum() < 100.0,
+                                  lambda c: c + lax.psum(c, "data"), x)
+
+        cj = jax.make_jaxpr(smap(f, mesh))(jnp.ones((8, 4), jnp.float32))
+        fs = hits(run_sched_rules(cj, mesh),
+                  "collective-in-data-dependent-while")
+        assert len(fs) == 1 and fs[0].severity == "error"
+
+    def test_data_dependent_while_clean_counter(self):
+        """fori_loop-style scalar-integer counter predicate: the trip
+        count is rank-invariant, collectives in the body are safe."""
+        mesh = mesh2()
+
+        def f(x):
+            def cond(c):
+                return c[0] < 4
+
+            def body(c):
+                return (c[0] + 1, c[1] + lax.psum(c[1], "data"))
+
+            return lax.while_loop(cond, body, (0, x))[1]
+
+        cj = jax.make_jaxpr(smap(f, mesh))(jnp.ones((8, 4), jnp.float32))
+        assert not hits(run_sched_rules(cj, mesh),
+                        "collective-in-data-dependent-while")
+
+    def test_data_dependent_while_clean_no_collectives(self):
+        mesh = mesh2()
+
+        def f(x):
+            return lax.while_loop(lambda c: c.sum() < 100.0,
+                                  lambda c: c * 1.5, x)
+
+        cj = jax.make_jaxpr(smap(f, mesh))(jnp.ones((8, 4), jnp.float32))
+        assert not run_sched_rules(cj, mesh)
+
+    def test_rank_dependent_cond_fires_once(self):
+        """Identical branch sequences do NOT save a rank-varying
+        predicate: different staged program points = different channel
+        ids. This is the hazard order-divergence alone cannot see."""
+        mesh = mesh2()
+
+        def f(x):
+            idx = lax.axis_index("data")
+            return lax.cond(idx == 0, lambda v: lax.psum(v, "data"),
+                            lambda v: lax.psum(v * 2, "data"), x)
+
+        cj = jax.make_jaxpr(smap(f, mesh))(jnp.ones((8, 4), jnp.float32))
+        fs = hits(run_sched_rules(cj, mesh),
+                  "rank-dependent-collective-schedule")
+        assert len(fs) == 1 and fs[0].severity == "error"
+        # and identical branches keep order-divergence silent, so the
+        # program carries exactly this one hazard
+        assert not hits(run_sched_rules(cj, mesh),
+                        "collective-order-divergence")
+
+    def test_rank_dependent_clean_uniform_predicate(self):
+        mesh = mesh2()
+
+        def f(x, k):
+            return lax.cond(k > 0, lambda v: lax.psum(v, "data"),
+                            lambda v: lax.psum(v * 2, "data"), x)
+
+        cj = jax.make_jaxpr(
+            lambda x, k: smap(lambda v: f(v, k), mesh)(x))(
+                jnp.ones((8, 4), jnp.float32), jnp.int32(3))
+        assert not hits(run_sched_rules(cj, mesh),
+                        "rank-dependent-collective-schedule")
+
+    def test_rank_dependent_while_fires_once(self):
+        """A while whose trip BOUND is derived from axis_index: the
+        counter predicate looks rank-invariant shape-wise, but taint
+        through the carry proves it is not."""
+        mesh = mesh2()
+
+        def f(x):
+            idx = lax.axis_index("data")
+
+            def cond(c):
+                return c[0] < idx + 2
+
+            def body(c):
+                return (c[0] + 1, c[1] + lax.psum(c[1], "data"))
+
+            return lax.while_loop(cond, body, (0, x))[1]
+
+        cj = jax.make_jaxpr(smap(f, mesh))(jnp.ones((8, 4), jnp.float32))
+        fs = hits(run_sched_rules(cj, mesh),
+                  "rank-dependent-collective-schedule")
+        assert len(fs) == 1
+
+    def test_axis_index_alone_is_clean(self):
+        """axis_index feeding plain data flow (per-rank seeds, labels)
+        is the normal SPMD idiom — no finding without a collective-
+        bearing predicate downstream."""
+        mesh = mesh2()
+
+        def f(x):
+            idx = lax.axis_index("data")
+            return x + idx.astype(x.dtype)
+
+        cj = jax.make_jaxpr(smap(f, mesh))(jnp.ones((8, 4), jnp.float32))
+        assert not run_sched_rules(cj, mesh)
+
+
+# ---------------------------------------------------------------------------
+# program families
+# ---------------------------------------------------------------------------
+
+def _tracer(mesh, fn):
+    return lambda: jax.make_jaxpr(smap(fn, mesh))(
+        jnp.ones((8, 4), jnp.float32))
+
+
+class TestProgramFamily:
+    def test_drift_fires_once_on_primary(self):
+        mesh = mesh2()
+        fam = S.ProgramFamily(
+            name="t-drift", selector="undeclared host flag",
+            rank_invariant=False,
+            members={"sync": _tracer(mesh, lambda v: lax.psum(v, "data")),
+                     "nosync": _tracer(mesh, lambda v: v * 2.0)},
+            mesh=mesh)
+        res = S.verify_family(fam)
+        assert not res["ok"]
+        assert res["fingerprints"]["sync"] != res["fingerprints"]["nosync"]
+        drift = [f for m in res["members"].values()
+                 for f in m["findings"]
+                 if f["rule"] == "program-family-schedule-drift"]
+        assert len(drift) == 1  # exactly once, on the primary
+        assert not res["members"]["sync"]["ok"]
+        assert res["members"]["nosync"]["ok"]
+
+    def test_drift_clean_when_declared_rank_invariant(self):
+        mesh = mesh2()
+        fam = S.ProgramFamily(
+            name="t-ok", selector="step_no % k_steps (host-replicated "
+            "step counter)", rank_invariant=True,
+            members={"sync": _tracer(mesh, lambda v: lax.psum(v, "data")),
+                     "nosync": _tracer(mesh, lambda v: v * 2.0)},
+            mesh=mesh)
+        res = S.verify_family(fam)
+        assert res["ok"]
+        assert all(m["ok"] for m in res["members"].values())
+
+    def test_drift_clean_when_schedules_identical(self):
+        mesh = mesh2()
+        fam = S.ProgramFamily(
+            name="t-same", selector="anything", rank_invariant=False,
+            members={"a": _tracer(mesh, lambda v: lax.psum(v, "data")),
+                     "b": _tracer(mesh,
+                                  lambda v: lax.psum(v + 1.0, "data"))},
+            mesh=mesh)
+        res = S.verify_family(fam)
+        assert res["ok"]
+        assert res["fingerprints"]["a"] == res["fingerprints"]["b"]
+
+    def test_member_hazard_fails_family(self):
+        mesh = mesh2()
+
+        def bad(v):
+            pred = v.sum() > 0
+            return lax.cond(pred, lambda u: lax.psum(u, "data"),
+                            lambda u: u * 2.0, v)
+
+        fam = S.ProgramFamily(
+            name="t-bad-member", selector="step bucket",
+            rank_invariant=True,
+            members={"m": _tracer(mesh, bad)}, mesh=mesh)
+        res = S.verify_family(fam)
+        assert not res["ok"]
+        rules = [f["rule"] for f in res["members"]["m"]["findings"]]
+        assert "collective-order-divergence" in rules
+
+    def test_registry_duplicate_raises(self):
+        mesh = mesh2()
+        fam = S.ProgramFamily(
+            name="t-dup", selector="s", rank_invariant=True,
+            members={"m": _tracer(mesh, lambda v: v)}, mesh=mesh)
+        try:
+            S.register_family(fam)
+            with pytest.raises(ValueError):
+                S.register_family(fam)
+            S.register_family(fam, replace=True)  # explicit replace ok
+        finally:
+            S.FAMILIES.pop("t-dup", None)
+
+    def test_empty_family_rejected(self):
+        with pytest.raises(ValueError):
+            S.register_family(S.ProgramFamily(
+                name="t-empty", selector="s", rank_invariant=True,
+                members={}))
+
+
+# ---------------------------------------------------------------------------
+# shipped family hooks (trainer / LocalSGD / decode executors)
+# ---------------------------------------------------------------------------
+
+class TestShippedFamilyHooks:
+    def test_parallel_trainer_family(self):
+        """ParallelTrainer.program_family: the integrity do_check pair,
+        declared rank-invariant (step-counter cadence), both members
+        hang-free; fingerprints differ (do_check adds compare
+        collectives)."""
+        from paddle_tpu.resilience.hostsim import (_tiny_batches,
+                                                   _tiny_trainer)
+        trainer = _tiny_trainer()
+        x, y = _tiny_batches()[0]
+        fam = trainer.program_family(x, y)
+        assert set(fam.members) == {"step", "step-check"}
+        assert fam.rank_invariant
+        res = S.verify_family(fam)
+        assert res["ok"], json.dumps(res, indent=2)
+        assert res["fingerprints"]["step"] != \
+            res["fingerprints"]["step-check"]
+
+    def test_localsgd_family(self):
+        """LocalSGDTrainer.program_family: the sync/no-sync pair —
+        divergent schedules by design, safe because the k-step cadence
+        is a host-replicated counter."""
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.distributed.mesh import build_mesh
+        from paddle_tpu.distributed.meta_parallel.localsgd import \
+            LocalSGDTrainer
+
+        paddle.seed(5)
+        mesh = build_mesh({"data": 2})
+        model = nn.Linear(8, 4)
+        opt = paddle.optimizer.SGD(0.05, parameters=model.parameters())
+        # compressed param sync => the averaging collectives are
+        # EXPLICIT primitives the schedule extractor sees (fp32 sync is
+        # a GSPMD-implicit all-reduce outside the explicit schedule)
+        tr = LocalSGDTrainer(model, opt,
+                             lambda out, y: jnp.mean((out - y) ** 2),
+                             mesh=mesh, k_steps=4, param_sync="int8")
+        x = np.zeros((8, 8), np.float32)
+        y = np.zeros((8, 4), np.float32)
+        fam = tr.program_family(x, y)
+        assert set(fam.members) == {"sync", "no-sync"}
+        assert fam.rank_invariant
+        res = S.verify_family(fam)
+        assert res["ok"], json.dumps(res, indent=2)
+        # sync exchanges gradients; no-sync must not
+        assert res["members"]["sync"]["num_collectives"] > \
+            res["members"]["no-sync"]["num_collectives"]
+
+    def test_decode_executor_family(self):
+        """The decode/mixed/verify executor router registered as a
+        family keyed on batch composition (host-uniform per dispatch)."""
+        import importlib
+        lint = importlib.import_module("tools.lint_program")
+        fam = lint._decode_family(smoke=True)
+        assert set(fam.members) == {"mixed", "decode", "verify"}
+        res = S.verify_family(fam)
+        assert res["ok"], json.dumps(res, indent=2)
+
+
+# ---------------------------------------------------------------------------
+# cross-rank bootstrap agreement
+# ---------------------------------------------------------------------------
+
+def _fresh_registry():
+    old = telemetry.get_registry()
+    reg = telemetry.Registry()
+    telemetry._set_registry(reg)
+    telemetry.enable()
+    return old, reg
+
+
+class TestCrossRank:
+    def test_agreement_passes_and_counts(self, tmp_path):
+        from paddle_tpu.resilience.elastic import FileCoordinator
+        hosts = ["a", "b"]
+        old, reg = _fresh_registry()
+        out, errs = {}, {}
+
+        def _run(h):
+            coord = FileCoordinator(str(tmp_path), job_id="j", host=h,
+                                    poll=0.01)
+            try:
+                out[h] = S.crossrank_verify(
+                    coord, {"train-step": "fp0", "check": "fp1"},
+                    lambda: hosts, timeout=30.0)
+            except Exception as e:  # pragma: no cover
+                errs[h] = e
+
+        try:
+            ts = [threading.Thread(target=_run, args=(h,)) for h in hosts]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            assert not errs
+            for h in hosts:
+                assert set(out[h]) == {"a", "b"}
+            assert reg.counter("schedule_verify_total").value() == 2.0
+            assert reg.counter(
+                "collective_schedule_mismatch_total").value() == 0.0
+        finally:
+            telemetry.disable()
+            telemetry._set_registry(old)
+
+    def test_divergence_aborts_with_diff(self, tmp_path):
+        from paddle_tpu.resilience.elastic import FileCoordinator
+        hosts = ["a", "b"]
+        old, reg = _fresh_registry()
+        errs = {}
+
+        def _run(h, fp):
+            coord = FileCoordinator(str(tmp_path), job_id="j", host=h,
+                                    poll=0.01)
+            try:
+                S.crossrank_verify(coord, {"train-step": fp},
+                                   lambda: hosts, timeout=30.0)
+            except S.ScheduleMismatch as e:
+                errs[h] = e
+
+        try:
+            ts = [threading.Thread(target=_run, args=("a", "fpA")),
+                  threading.Thread(target=_run, args=("b", "fpB"))]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            # every host aborts with the same per-host diff
+            assert set(errs) == {"a", "b"}
+            for e in errs.values():
+                assert e.diff == {"train-step": {"a": "fpA", "b": "fpB"}}
+                assert "diverge" in str(e)
+            assert reg.counter(
+                "collective_schedule_mismatch_total").value() == 2.0
+        finally:
+            telemetry.disable()
+            telemetry._set_registry(old)
+
+
+# ---------------------------------------------------------------------------
+# hostsim: a deliberate schedule divergence aborts with a diff, fast
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.multihost(timeout=420)
+def test_hostsim_schedule_divergence_aborts_with_diff(tmp_path):
+    """2 subprocess hosts; host1 is forced onto a different program (the
+    integrity do_check step) at fingerprint time. The bootstrap check
+    must abort EVERY host with a diffed report — quickly, not at the
+    hang-watchdog deadline (armed at 600 s here)."""
+    from paddle_tpu.resilience import hostsim
+    cluster = hostsim.SimCluster(str(tmp_path), n_hosts=2, np_spec="2:2",
+                                 steps=6, hb_timeout=1.0, step_delay=0.05,
+                                 hang_timeout=600.0)
+    t0 = time.time()
+    out = cluster.run(desync_hosts={1}, timeout=240)
+    elapsed = time.time() - t0
+    assert out["hosts_hung"] == 0
+    assert elapsed < 240.0
+    for h, code in out["exit_codes"].items():
+        assert code == hostsim.SCHEDULE_MISMATCH_EXIT, (h, code,
+                                                        out["stderr"][h])
+    for h, res in out["results"].items():
+        assert res is not None, (h, out["stderr"][h])
+        assert res["status"] == "schedule_mismatch"
+        diff = res["schedule_diff"]
+        assert "train-step" in diff
+        fps = diff["train-step"]
+        assert fps["host0"] != fps["host1"]
+        assert hostsim._counter_total(
+            res["telemetry"], "collective_schedule_mismatch_total") >= 1

@@ -25,9 +25,16 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
-from _mesh_setup import data_mesh, ensure_repo_on_path, force_host_devices
+try:
+    from _mesh_setup import (data_mesh, ensure_repo_on_path,
+                             force_host_devices)
+except ImportError:  # imported as tools.lint_program (tests)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _mesh_setup import (data_mesh, ensure_repo_on_path,
+                             force_host_devices)
 
 
 def _build_gpt(smoke: bool):
@@ -138,10 +145,101 @@ PROGRAMS = {"decode-mixed": lambda smoke: _decode_jaxpr("mixed", smoke),
 ALL_MODELS = tuple(BUILDERS) + tuple(PROGRAMS)
 
 
+# ---------------------------------------------------------------------------
+# ProgramFamily registration: every shipped multi-program dispatch site
+# (trainer integrity pair, LocalSGD sync/no-sync, decode executor router)
+# declared so the schedule verifier can prove its member schedules are
+# picked by a rank-invariant host predicate.
+# ---------------------------------------------------------------------------
+
+def _trainer_family(smoke: bool):
+    """The bench GPT trainer's step / step-with-integrity-check pair."""
+    trainer, ids, labels = _build_gpt(smoke)
+    return trainer.program_family(ids, labels)
+
+
+def _localsgd_family(smoke: bool):
+    """A small LocalSGD trainer's sync / no-sync pair (the shapes don't
+    change the schedule contract, only the payload buckets)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.meta_parallel.localsgd import \
+        LocalSGDTrainer
+
+    paddle.seed(0)
+    mesh = data_mesh(1)
+    model = nn.Linear(8, 4)
+    opt = paddle.optimizer.SGD(0.05, parameters=model.parameters())
+    # compressed param sync: the averaging collectives are explicit
+    # primitives, so the verified sync schedule is non-trivial
+    tr = LocalSGDTrainer(model, opt,
+                         lambda out, y: jnp.mean((out - y) ** 2),
+                         mesh=mesh, k_steps=4, param_sync="int8")
+    x = np.zeros((8, 8), np.float32)
+    y = np.zeros((8, 4), np.float32)
+    return tr.program_family(x, y)
+
+
+def _decode_family(smoke: bool):
+    """The DecodeServer mixed/decode/verify executor router as a
+    declared family (same shapes as :func:`_decode_jaxpr`)."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.inference.decode_model import (executor_family,
+                                                   init_decode_model,
+                                                   make_step_fn)
+    from paddle_tpu.inference.kv_cache import PagedKVCache
+
+    if smoke:
+        vocab, heads, hd, t, r, w, pages, page = 128, 2, 16, 16, 4, 4, 16, 8
+    else:
+        vocab, heads, hd, t, r, w, pages, page = 256, 4, 32, 64, 8, 8, 64, 16
+    params = init_decode_model(vocab, heads, hd, max_len=1024)
+    cache = PagedKVCache(pages, page, heads, hd, num_layers=1)
+    step = make_step_fn(params, cache)
+    kp, vp = cache.pools(0)
+    s = jax.ShapeDtypeStruct
+    sv = 8
+    step_args = (s(kp.shape, kp.dtype), s(vp.shape, vp.dtype),
+                 s((t,), np.int32), s((t,), np.int32), s((t,), np.int32),
+                 s((t,), np.bool_), s((r, w), np.int32), s((r,), np.int32),
+                 s((r,), np.int32))
+    verify_args = (s(kp.shape, kp.dtype), s(vp.shape, vp.dtype),
+                   s((r, sv), np.int32), s((r,), np.int32),
+                   s((r, w), np.int32), s((r,), np.int32))
+    return executor_family(step, {"mixed": step_args, "decode": step_args,
+                                  "verify": verify_args})
+
+
+FAMILY_BUILDERS = {"trainer-step": _trainer_family,
+                   "localsgd-step": _localsgd_family,
+                   "decode-executor": _decode_family}
+
+
+def verify_families(smoke: bool, top: int = 10):
+    """Register + schedule-verify every shipped ProgramFamily. Returns
+    the per-family verdict dicts keyed by family name."""
+    from paddle_tpu.analysis import AnalysisConfig
+    from paddle_tpu.analysis import schedule as sched
+
+    cfg = AnalysisConfig(top_k=top)
+    out = {}
+    for name, build in FAMILY_BUILDERS.items():
+        fam = build(smoke)
+        sched.register_family(fam, replace=True)
+        out[name] = sched.verify_family(fam, config=cfg)
+    return out
+
+
 def lint_model(name: str, smoke: bool, top: int,
                dump_schedule: bool = False, dump_sharding: bool = False):
     from paddle_tpu import analysis
     from paddle_tpu.analysis import AnalysisConfig
+    from paddle_tpu.analysis import schedule as sched
 
     mesh = data_mesh(1)
     cfg = AnalysisConfig(top_k=top)
@@ -150,20 +248,21 @@ def lint_model(name: str, smoke: bool, top: int,
         trainer, inputs, labels = BUILDERS[name](smoke)
         _, report = trainer.compile(inputs, labels, analyze=True,
                                     config=cfg)
-        if dump_schedule or dump_sharding:
-            closed = trainer.staged_jaxpr(inputs, labels)
-            if dump_schedule:
-                from paddle_tpu.analysis import cost
-                schedule = cost.overlap_summary(closed, trainer.mesh,
-                                                include_timeline=True)
-            if dump_sharding:
-                from paddle_tpu.analysis.sharding import propagate
-                info = propagate(closed, trainer.mesh,
-                                 trainer.staged_in_specs(inputs, labels),
-                                 collect_table=True)
-                sharding = info.to_dict()
+        closed = trainer.staged_jaxpr(inputs, labels)
+        prog_mesh = trainer.mesh
+        if dump_schedule:
+            from paddle_tpu.analysis import cost
+            schedule = cost.overlap_summary(closed, trainer.mesh,
+                                            include_timeline=True)
+        if dump_sharding:
+            from paddle_tpu.analysis.sharding import propagate
+            info = propagate(closed, trainer.mesh,
+                             trainer.staged_in_specs(inputs, labels),
+                             collect_table=True)
+            sharding = info.to_dict()
     else:
         closed = PROGRAMS[name](smoke)
+        prog_mesh = mesh
         report = analysis.analyze_jaxpr(closed, mesh=mesh, config=cfg)
         if dump_schedule:
             from paddle_tpu.analysis import cost
@@ -174,7 +273,12 @@ def lint_model(name: str, smoke: bool, top: int,
             n = len(closed.jaxpr.invars)
             info = propagate(closed, mesh, [None] * n, collect_table=True)
             sharding = info.to_dict()
-    return report, schedule, sharding
+    sites = sched.extract_schedule(closed, mesh=prog_mesh)
+    collectives = {"fingerprint": sched.fingerprint(sites),
+                   "num_collectives": len(sites),
+                   "rows": sched.schedule_rows(sites),
+                   "text": sched.format_schedule(sites)}
+    return report, schedule, sharding, collectives
 
 
 def _schedule_text(name: str, sched: dict) -> str:
@@ -246,6 +350,11 @@ def main(argv=None) -> int:
                          "per-equation spec/conflict table and predicted "
                          "implicit collectives (with --json: a "
                          "'sharding' object per model)")
+    ap.add_argument("--dump-collectives", action="store_true",
+                    help="print the canonical ordered collective "
+                         "schedule per program (kind/axes/dtype/bucket/"
+                         "link/context + fingerprint; with --json: a "
+                         "'collectives' row list per model)")
     args = ap.parse_args(argv)
 
     force_host_devices(args.devices)
@@ -257,20 +366,34 @@ def main(argv=None) -> int:
         models = tuple(PROGRAMS)
     else:
         models = (args.model,)
-    reports, schedules, shardings = {}, {}, {}
+    reports, schedules, shardings, collectives = {}, {}, {}, {}
     for name in models:
-        reports[name], schedules[name], shardings[name] = lint_model(
+        (reports[name], schedules[name], shardings[name],
+         collectives[name]) = lint_model(
             name, args.smoke, args.top, dump_schedule=args.dump_schedule,
             dump_sharding=args.dump_sharding)
+    # every shipped program family is registered and schedule-verified
+    # whenever the full suite runs — tier-1 (--smoke --strict) fails on
+    # any new deadlock hazard or undeclared family drift
+    families = verify_families(args.smoke, args.top) \
+        if args.model == "all" else {}
 
     if args.json:
         out = {n: r.to_dict() for n, r in reports.items()}
+        for n in out:
+            out[n]["schedule_fingerprint"] = collectives[n]["fingerprint"]
+            out[n]["num_collectives"] = collectives[n]["num_collectives"]
         if args.dump_schedule:
             for n in out:
                 out[n]["schedule"] = schedules[n]
         if args.dump_sharding:
             for n in out:
                 out[n]["sharding"] = shardings[n]
+        if args.dump_collectives:
+            for n in out:
+                out[n]["collectives"] = collectives[n]["rows"]
+        if families:
+            out["__families__"] = families
         print(json.dumps(out))
     else:
         for name, rep in reports.items():
@@ -280,8 +403,21 @@ def main(argv=None) -> int:
                 print(_schedule_text(name, schedules[name]))
             if args.dump_sharding and shardings[name] is not None:
                 print(_sharding_text(name, shardings[name]))
+            if args.dump_collectives:
+                c = collectives[name]
+                print(f"-- {name} collective schedule: "
+                      f"{c['num_collectives']} collective(s), "
+                      f"fingerprint {c['fingerprint'][:16]}")
+                print(c["text"])
+        for fname, res in families.items():
+            status = "ok" if res["ok"] else "FAIL"
+            fps = {m: v["fingerprint"][:12]
+                   for m, v in res["members"].items()}
+            print(f"== family {fname} == {status} "
+                  f"(selector: {res['selector']}) {fps}")
     ok = all(r.ok for r in reports.values())
-    if ok and args.strict:
+    families_ok = all(res["ok"] for res in families.values())
+    if ok and families_ok and args.strict:
         n_warn = sum(1 for r in reports.values() for f in r.findings
                      if f.severity == "warning")
         if n_warn:
@@ -291,7 +427,11 @@ def main(argv=None) -> int:
     if not ok:
         print("lint_program: error-severity findings present",
               file=sys.stderr)
-    return 0 if ok else 1
+    if not families_ok:
+        bad = [n for n, res in families.items() if not res["ok"]]
+        print(f"lint_program: program-family schedule verification "
+              f"failed: {bad}", file=sys.stderr)
+    return 0 if ok and families_ok else 1
 
 
 if __name__ == "__main__":
