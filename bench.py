@@ -205,7 +205,11 @@ def _gpt_variant(jax, on_tpu, batch, seq, vocab, cfg, fused, chunk=8192,
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     out = {"tokens_per_sec": round(batch * seq * iters / dt, 1),
            "params": n_params, "final_loss": round(final_loss, 4),
-           "telemetry": _harvest_telemetry(tel.registry)}
+           "telemetry": _harvest_telemetry(tel.registry),
+           # predicted-vs-measured step time of this variant's last step
+           # (engine._record_step_telemetry pairs the overlap model's
+           # makespan with the wall clock; telemetry.calibration)
+           "calibration": telemetry.calibration.pair("step_time")}
     if on_tpu:
         # memory_stats peak is process-cumulative: attributable to THIS
         # variant only while the sweep runs smallest-footprint-first
@@ -680,12 +684,16 @@ def main():
     gpt = extra.get("gpt_base", {})
     ok = "tokens_per_sec" in gpt
     result = {
+        "schema_version": 2,
         "metric": "gpt_base_train_tokens_per_sec_per_chip",
         "value": gpt.get("tokens_per_sec", 0.0),
         "unit": "tokens/sec",
         "vs_baseline": 1.0 if ok else 0.0,
         "backend": backend,
         "flops_convention": "6N per token (no attention term)",
+        # best-variant {predicted, measured, drift} step-time triple
+        # (telemetry.calibration; schema_version 2)
+        "calibration": gpt.get("calibration"),
         "extra": extra,
     }
     if "mfu_6N" in gpt:

@@ -312,7 +312,7 @@ def overlap_summary(jaxpr, mesh, peak_flops=None, while_trips: float = 1.0,
     profiler.
     """
     import heapq
-    from ..distributed.mesh import axis_links, link_bandwidth
+    from ..distributed.mesh import axis_links, link_bandwidth, link_latency
     from .rules import collective_axes
     if peak_flops is None:
         from .. import telemetry as _telemetry
@@ -335,8 +335,9 @@ def overlap_summary(jaxpr, mesh, peak_flops=None, while_trips: float = 1.0,
             link = ("dcn" if any(links.get(ax) == "dcn" for ax in axes)
                     else "ici")
             wire = collective_wire_bytes(eqn, n_g) * node.trips
-            plans.append((True, wire / link_bandwidth(link), link, wire,
-                          axes))
+            dur = (wire / link_bandwidth(link)
+                   + link_latency(link) * node.trips)
+            plans.append((True, dur, link, wire, axes))
         else:
             f = (_atomic_flops(eqn, while_trips) if node.atomic
                  else eqn_flops(eqn)) * node.trips

@@ -231,12 +231,15 @@ class _Propagator:
         shape = dict(getattr(mesh, "shape", {}) or {})
         self.sizes = {ax: int(n) for ax, n in shape.items() if int(n) > 1}
         try:
-            from ..distributed.mesh import axis_links, link_bandwidth
+            from ..distributed.mesh import (axis_links, link_bandwidth,
+                                            link_latency)
             self.links = axis_links(mesh) if mesh is not None else {}
             self._bw = link_bandwidth
+            self._lat = link_latency
         except Exception:
             self.links = {}
             self._bw = lambda link: _FALLBACK_BW.get(link, _FALLBACK_BW["ici"])
+            self._lat = lambda link: 0.0
         self.while_trips = max(float(while_trips), 1.0)
         self.collect_table = collect_table
         self.sites: List[ReshardSite] = []
@@ -262,7 +265,8 @@ class _Propagator:
         wire = _RING[kind] * (n - 1) / n * float(payload)
         site = ReshardSite(
             kind=kind, axes=axes, bytes=float(payload), wire_bytes=wire,
-            time_s=wire / max(self._bw(link), 1.0), link=link,
+            time_s=wire / max(self._bw(link), 1.0) + self._lat(link),
+            link=link,
             trips=sctx.trips, path=sctx.path, eqn_index=sctx.index,
             primitive=sctx.eqn.primitive.name if sctx.eqn is not None
             else "", operand=operand, detail=detail,

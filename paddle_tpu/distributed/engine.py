@@ -1411,6 +1411,14 @@ class ParallelTrainer:
                 "fraction of the staged step's collective time the "
                 "overlap model predicts is hidden under compute").set(
                     cost["overlap"]["overlap_efficiency"])
+        if cost and cost.get("overlap") and dt > 0:
+            makespan = cost["overlap"].get("makespan")
+            if makespan:
+                # predicted-vs-measured step time (telemetry.calibration):
+                # the overlap model's makespan of the staged step vs this
+                # step's wall clock
+                _telemetry.calibration.record(
+                    "step_time", makespan, dt, step=step)
         res = None
         if self.state["comm_err"]:
             from .compressed import residual_norm

@@ -204,10 +204,28 @@ def axis_link(axis: str, mesh: Optional[Mesh] = None) -> str:
 LINK_BANDWIDTHS: Dict[str, float] = {"ici": 9.0e10, "dcn": 6.25e9}
 
 _LINK_BW_ENV = {"ici": "PADDLE_TPU_ICI_BPS", "dcn": "PADDLE_TPU_DCN_BPS"}
+_LINK_LAT_ENV = {"ici": "PADDLE_TPU_ICI_LAT_S", "dcn": "PADDLE_TPU_DCN_LAT_S"}
+
+
+def _calibration():
+    # lazy + fail-soft: mesh must import before telemetry and still price
+    # links if the calibration layer is somehow unavailable
+    try:
+        from paddle_tpu.telemetry import calibration
+        return calibration
+    except Exception:  # pragma: no cover
+        return None
 
 
 def link_bandwidth(link: str) -> float:
-    """Bytes/sec of one link class, honoring the env override."""
+    """Bytes/sec of one link class.
+
+    Precedence: env override > calibration-DB fitted constant
+    (``telemetry.calibration``, written by ``bench_collectives --suite
+    calibrate``) > the shipped :data:`LINK_BANDWIDTHS` figure. This is
+    the one choke point every wire-time consumer (cost.overlap_summary,
+    the sharding pass, auto.resharding_cost) prices through.
+    """
     import os
     env = os.environ.get(_LINK_BW_ENV.get(link, ""), "")
     if env:
@@ -215,7 +233,35 @@ def link_bandwidth(link: str) -> float:
             return float(env)
         except ValueError:
             pass
+    cal = _calibration()
+    if cal is not None:
+        fitted = cal.link_bandwidth_override(link)
+        if fitted is not None:
+            return fitted
     return LINK_BANDWIDTHS.get(link, LINK_BANDWIDTHS["ici"])
+
+
+def link_latency(link: str) -> float:
+    """Fixed per-collective latency (seconds) of one link class.
+
+    Same precedence as :func:`link_bandwidth` (env > calibration DB),
+    except the shipped default is 0.0 — the pure-bandwidth wire model —
+    so un-calibrated behavior is exactly what it was before this term
+    existed.
+    """
+    import os
+    env = os.environ.get(_LINK_LAT_ENV.get(link, ""), "")
+    if env:
+        try:
+            return max(0.0, float(env))
+        except ValueError:
+            pass
+    cal = _calibration()
+    if cal is not None:
+        fitted = cal.link_latency_override(link)
+        if fitted is not None:
+            return fitted
+    return 0.0
 
 
 class CommunicateTopology:

@@ -136,6 +136,10 @@ class Request:
         self.error: Optional[BaseException] = None
         self.t_dispatch: Optional[float] = None  # first dispatch only
         self.t_done: Optional[float] = None
+        # admission model's predicted wait (x admission_safety) at submit
+        # time; paired with the measured wait at first dispatch by
+        # telemetry.calibration ("serving_queue_wait")
+        self.t_predicted_wait: Optional[float] = None
         # invoked exactly once, after the request reaches ANY terminal
         # state (resource owners — e.g. the KV cache — hook cleanup here
         # so every seal path releases, not just the happy one)
@@ -375,6 +379,21 @@ class InferenceServer:
         self._rr = 0
         self._ewma_rows_per_s: Optional[float] = None
         self._ewma_batch_s: Optional[float] = None
+        # EWMA cold-start (ISSUE 18): seed the service rate from the
+        # calibration DB when one was fitted, so the very first admission
+        # decisions price wait with a measured rate instead of modeling
+        # zero wait until the first batch completes. _rate_source tracks
+        # where the current rate came from ({ewma|calibrated|default})
+        # and is surfaced by stats() as modeled_wait_source.
+        self._rate_source = "default"
+        try:
+            from ..telemetry import calibration as _calibration
+            seeded = _calibration.serving_rates()
+        except Exception:  # pragma: no cover - admission must not crash
+            seeded = None
+        if seeded is not None:
+            self._ewma_rows_per_s, self._ewma_batch_s = seeded
+            self._rate_source = "calibrated"
         self._draining = False
         self._stopped = False
         self._started = False
@@ -479,13 +498,15 @@ class InferenceServer:
             self._terminal(req, SHED, cause="draining")
             return req
         with self._cv:
+            wait = self._modeled_wait_locked(req.rows) \
+                * self.cfg.admission_safety
             if len(self._deque) >= self.cfg.max_queue:
                 cause = "queue_full"
-            elif req.deadline is not None and self._modeled_wait_locked(
-                    req.rows) * self.cfg.admission_safety \
-                    + req.arrival > req.deadline:
+            elif req.deadline is not None and \
+                    wait + req.arrival > req.deadline:
                 cause = "deadline_infeasible"
             else:
+                req.t_predicted_wait = wait
                 self._deque.append(req)
                 self._gauge("serving_queue_depth", len(self._deque))
                 self._cv.notify_all()
@@ -499,8 +520,10 @@ class InferenceServer:
         latency. The EWMA is a PER-REPLICA rate (one batch over its own
         execute time), so the drain rate scales with the healthy replica
         count — admission tightens by itself while a replica sits in
-        probation. Cold start (no completed batch yet) models zero wait —
-        admission cannot reject what it cannot estimate."""
+        probation. Cold start (no completed batch yet) uses the
+        calibration-DB seeded rate when one was fitted (see __init__ /
+        ``modeled_wait_source``), else models zero wait — admission
+        cannot reject what it cannot estimate."""
         if self._ewma_rows_per_s is None or self._ewma_rows_per_s <= 0:
             return 0.0
         healthy = max(1, sum(1 for r in self.replicas if r.healthy))
@@ -603,6 +626,14 @@ class InferenceServer:
                 r.t_dispatch = time.monotonic()
                 self._observe("serving_queue_wait_seconds",
                               r.t_dispatch - r.arrival)
+                if r.t_predicted_wait:
+                    # admission's modeled wait vs the wait that actually
+                    # happened (calibration records regardless of the
+                    # telemetry gate — server-owned accounting)
+                    from ..telemetry import calibration as _calibration
+                    _calibration.record("serving_queue_wait",
+                                        r.t_predicted_wait,
+                                        r.t_dispatch - r.arrival)
             sp = r._span_wait
             if sp is not None and not sp._ended:
                 sp.end("ok")
@@ -739,6 +770,8 @@ class InferenceServer:
                 else a * rate + (1 - a) * self._ewma_rows_per_s
             self._ewma_batch_s = dt if self._ewma_batch_s is None \
                 else a * dt + (1 - a) * self._ewma_batch_s
+            # a calibrated seed decays into the live EWMA from batch 1
+            self._rate_source = "ewma"
         off = 0
         for r in job.requests:
             sl = [o[off:off + r.rows] for o in outs]
@@ -909,6 +942,10 @@ class InferenceServer:
             "queue_depth": depth,
             "inflight_batches": inflight,
             "replicas_healthy": sum(1 for r in self.replicas if r.healthy),
+            # where the admission wait model's service rate came from:
+            # "ewma" once a batch completed, "calibrated" while running
+            # on the calibration-DB seed, "default" cold (models 0 wait)
+            "modeled_wait_source": self._rate_source,
         }
 
     def accounted(self) -> bool:
@@ -1077,6 +1114,7 @@ class DecodeServer(InferenceServer):
                         > req.deadline:
                     cause = "deadline_infeasible"
                 else:
+                    req.t_predicted_wait = wait * self.cfg.admission_safety
                     req.seq = self.cache.create(req.prompt[:-1])
                     req.on_terminal = self._release_request
                     self._assign_chunk(req)
@@ -1189,6 +1227,8 @@ class DecodeServer(InferenceServer):
                 else a * rate + (1 - a) * self._ewma_rows_per_s
             self._ewma_batch_s = dt if self._ewma_batch_s is None \
                 else a * dt + (1 - a) * self._ewma_batch_s
+            # a calibrated seed decays into the live EWMA from batch 1
+            self._rate_source = "ewma"
         # cache writes + sequence advance happen HERE (post-try_finish):
         # a cancelled job never touched the cache, so its requests re-run
         # the identical step on a survivor

@@ -713,6 +713,17 @@ def tune(cases: List[Tuple[str, Dict[str, int], Any]],
         if entry is None:
             log(f"{key}: no candidate passed numerics — not recorded")
             continue
+        if entry["mean_us"] is not None:
+            # predicted-vs-measured (telemetry.calibration): the DB's
+            # prior timing for this key is the "prediction" a fresh
+            # device sweep just re-measured — drift here means the
+            # stored entry went stale (driver bump, thermals, new part)
+            prior = get_db().lookup(key)
+            prior_us = prior.get("mean_us") if prior else None
+            if prior_us:
+                from paddle_tpu.telemetry import calibration
+                calibration.record(f"tuner:{kernel}", prior_us * 1e-6,
+                                   entry["mean_us"] * 1e-6)
         db.put(key, entry)
         log(f"{key} -> {entry['config']} "
             f"({entry['mean_us']} us, {entry['swept']} valid, "

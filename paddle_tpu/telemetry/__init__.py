@@ -178,6 +178,17 @@ collective_schedule_mismatch_total counter programs whose collective-
                                           across hosts (the verify
                                           aborts with a diff instead of
                                           letting the ranks hang)
+calibration_drift_ratio        gauge      measured / predicted per
+                                          calibration key {key=step_time|
+                                          serving_queue_wait|
+                                          collective_<link>|tuner:<k>}
+                                          (telemetry.calibration)
+calibration_samples_total      counter    (prediction, measurement)
+                                          pairs recorded {key=...}
+calibration_drift_breaches_total counter  latched |log drift| > bound
+                                          events per key; each fires one
+                                          reason-tagged flight dump
+                                          (calibration_drift)
 =============================  =========  =================================
 
 Multi-host merge: ``telemetry.aggregate.gather_registries()`` allgathers
@@ -198,6 +209,7 @@ from .scope import TelemetryScope, scope  # noqa: F401
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "DEFAULT_BUCKETS",
     "scope", "TelemetryScope", "aggregate", "tracing", "flight", "slo",
+    "calibration",
     "enable", "disable", "enabled", "is_enabled",
     "get_registry", "counter", "gauge", "histogram",
     "prometheus_text", "emit", "peak_flops_per_sec",
@@ -265,6 +277,7 @@ def emit(event: str, **fields):
 
 
 from . import aggregate  # noqa: E402,F401  (stdlib-only module, safe here)
+from . import calibration  # noqa: E402,F401
 from . import flight  # noqa: E402,F401
 from . import slo  # noqa: E402,F401
 from . import tracing  # noqa: E402,F401
@@ -273,14 +286,19 @@ from . import tracing  # noqa: E402,F401
 def peak_flops_per_sec() -> float:
     """Hardware peak used as the MFU denominator.
 
-    Override with ``PADDLE_TPU_PEAK_FLOPS`` (e.g. per-chip bf16 peak of
-    the actual slice); defaults to the v5e bf16 peak on TPU and a nominal
-    1 TFLOP/s elsewhere so MFU stays a positive, comparable-within-a-run
-    number on CPU test meshes.
+    Precedence: ``PADDLE_TPU_PEAK_FLOPS`` env (e.g. per-chip bf16 peak
+    of the actual slice) > the calibration DB's fitted effective peak
+    (``telemetry.calibration``, written by ``bench_collectives --suite
+    calibrate``) > the v5e bf16 peak on TPU and a nominal 1 TFLOP/s
+    elsewhere so MFU stays a positive, comparable-within-a-run number on
+    CPU test meshes.
     """
     env = os.environ.get("PADDLE_TPU_PEAK_FLOPS")
     if env:
         return float(env)
+    fitted = calibration.peak_flops_override()
+    if fitted is not None:
+        return fitted
     try:
         import jax
         backend = jax.default_backend()

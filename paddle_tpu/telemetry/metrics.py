@@ -24,7 +24,8 @@ import time
 from collections import deque
 from typing import Dict, Iterable, List, Optional, Tuple
 
-__all__ = ["Counter", "Gauge", "Histogram", "Registry", "DEFAULT_BUCKETS"]
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "DEFAULT_BUCKETS",
+           "StreamingQuantile"]
 
 # Wide enough to cover dataloader fetches (~us) through checkpoint saves
 # (~minutes); seconds everywhere.
@@ -34,6 +35,53 @@ DEFAULT_BUCKETS = (
 )
 
 LabelKey = Tuple[Tuple[str, str], ...]
+
+
+class StreamingQuantile:
+    """Quantile over the most recent ``maxlen`` observations.
+
+    The one shared streaming-percentile implementation (ISSUE 18):
+    ``tracing.KeepPolicy``'s tail-latency threshold and the calibration
+    drift summaries both use it instead of carrying their own reservoir
+    + sort. A bounded deque keeps the newest samples; the sorted view is
+    cached and recomputed at most every ``recompute_every`` appends, so
+    a quantile read between recomputes can be up to that many samples
+    stale — fine for thresholds and summaries, where staleness only
+    shifts borderline decisions. Not thread-safe on its own; callers
+    that share an instance across threads hold their own lock (the
+    pattern every user here already follows).
+    """
+
+    __slots__ = ("_values", "_adds", "_sorted", "recompute_every")
+
+    def __init__(self, maxlen: int = 512, recompute_every: int = 64):
+        self._values = deque(maxlen=maxlen)
+        self._adds = 0
+        self._sorted: Optional[List[float]] = None
+        self.recompute_every = max(1, int(recompute_every))
+
+    def add(self, v: float):
+        self._values.append(float(v))
+        self._adds += 1
+        if self._sorted is not None and \
+                self._adds % self.recompute_every == 0:
+            self._sorted = None
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """The ``q``-quantile of the reservoir (None when empty), with
+        the same nearest-rank index both former ad-hoc copies used."""
+        n = len(self._values)
+        if n == 0:
+            return None
+        if self._sorted is None or len(self._sorted) != n:
+            self._sorted = sorted(self._values)
+        return self._sorted[min(n - 1, int(float(q) * n))]
+
+    def median(self) -> Optional[float]:
+        return self.quantile(0.5)
 
 
 def _label_key(labels: Dict[str, object]) -> LabelKey:

@@ -82,21 +82,18 @@ class KeepPolicy:
         self.percentile_min_samples = percentile_min_samples
         self.keep_all = keep_all
         self.keep_none = keep_none
-        self._latencies = deque(maxlen=reservoir)
+        # shared streaming-quantile helper (telemetry.metrics): recomputes
+        # the sorted view every 64 closes; a stale threshold only shifts
+        # which borderline traces are kept, never breaks accounting.
+        from .metrics import StreamingQuantile
+        self._latencies = StreamingQuantile(maxlen=reservoir,
+                                            recompute_every=64)
         self._closes = 0
-        self._cached_threshold = None
 
     def _percentile_threshold(self):
-        n = len(self._latencies)
-        if n < self.percentile_min_samples:
+        if len(self._latencies) < self.percentile_min_samples:
             return None
-        # Recompute every 64 closes; a stale threshold only shifts which
-        # borderline traces are kept, never breaks accounting.
-        if self._cached_threshold is None or self._closes % 64 == 0:
-            xs = sorted(self._latencies)
-            idx = min(n - 1, int(self.latency_percentile * n))
-            self._cached_threshold = xs[idx]
-        return self._cached_threshold
+        return self._latencies.quantile(self.latency_percentile)
 
     def decide(self, outcome: str, duration_s: float,
                deadline_s: Optional[float], failover: bool) -> Optional[str]:
@@ -118,7 +115,7 @@ class KeepPolicy:
                 return "latency_percentile"
             return None
         finally:
-            self._latencies.append(duration_s)
+            self._latencies.add(duration_s)
 
 
 class Span:

@@ -141,6 +141,49 @@ def test_bench_collectives_overlap_suite_smoke():
 
 
 @pytest.mark.slow
+def test_bench_collectives_calibrate_suite_smoke():
+    """tools/bench_collectives.py --suite calibrate --smoke: the fitting
+    sweep (ISSUE 18) — measured psum ladder + real train steps fit
+    corrected constants into a tempdir overlay DB, and the re-priced
+    predicted step time must land strictly closer to measured than the
+    uncalibrated default (asserted in-process; re-checked here from the
+    schema-2 JSON contract)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_collectives.py"),
+         "--suite", "calibrate", "--smoke"],
+        capture_output=True, text=True, timeout=600, env=_env())
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    res = json.loads(lines[-1])
+    assert res["schema_version"] == 2
+    assert res["metric"] == "calibration_step_time_drift"
+    import math
+    assert abs(math.log(res["value"])) < abs(math.log(res["vs_baseline"]))
+    cal = res["calibration"]["step_time"]
+    assert cal["predicted"] > 0 and cal["measured"] > 0
+    assert cal["drift"] == pytest.approx(
+        cal["measured"] / cal["predicted"])
+    fitted = res["extra"]["fitted"]
+    assert fitted["links"]["ici"]["bandwidth_bps"] > 0
+    assert fitted["peak_flops_per_sec"] > 0
+
+
+def test_nightly_report_smoke():
+    """tools/nightly_report.py --smoke: the nightly-lane summary self-
+    test (green / red / missing-input flows against synthetic slow-lane
+    and tier-1 duration files in a tempdir)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "nightly_report.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=120, env=_env())
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    res = json.loads(lines[-1])
+    assert res["metric"] == "nightly_report_smoke"
+    assert res["value"] == 1
+
+
+@pytest.mark.slow
 @pytest.mark.multihost(timeout=420)
 def test_chaos_host_loss_scenario():
     """tools/chaos_smoke.py --scenario host_loss: the ISSUE acceptance

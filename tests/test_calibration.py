@@ -1,0 +1,429 @@
+"""ISSUE 18: the predicted-vs-measured calibration layer.
+
+Covers the pair registry (drift gauges, latched breach -> reason-tagged
+flight dump), the calibration DB (tuner-DB conventions: seed + overlay,
+atomic save, corrupt -> empty), the wire-model least-squares fit, every
+consumer choke point (mesh.link_bandwidth / link_latency,
+telemetry.peak_flops_per_sec, auto.resharding_cost, the serving
+admission EWMA seed), the shared StreamingQuantile helper, and the
+acceptance criterion itself: on the bench GPT CPU mesh, the calibrated
+predicted step time is strictly closer to measured than the
+uncalibrated default.
+"""
+import json
+import math
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import telemetry
+from paddle_tpu.telemetry import calibration
+from paddle_tpu.telemetry.metrics import StreamingQuantile
+
+
+@pytest.fixture(autouse=True)
+def _isolated_calibration(tmp_path, monkeypatch):
+    """Every test gets an empty overlay in a tempdir and a fresh pair
+    registry; nothing leaks into ~/.cache or across tests."""
+    monkeypatch.setenv("PADDLE_TPU_CALIBRATION_DB",
+                       str(tmp_path / "overlay.json"))
+    calibration.clear_cache()
+    calibration.reset()
+    yield
+    calibration.clear_cache()
+    calibration.reset()
+
+
+def _mesh(n, axis="data"):
+    devs = np.array(jax.devices()[:n]).reshape(n)
+    return Mesh(devs, (axis,))
+
+
+# ---------------------------------------------------------------------------
+# shared streaming quantile (satellite: one implementation)
+# ---------------------------------------------------------------------------
+
+class TestStreamingQuantile:
+    def test_nearest_rank_matches_sorted(self):
+        sq = StreamingQuantile(maxlen=64, recompute_every=1)
+        rng = np.random.RandomState(0)
+        vals = rng.rand(50).tolist()
+        for v in vals:
+            sq.add(v)
+        s = sorted(vals)
+        for q in (0.0, 0.5, 0.9, 0.99):
+            assert sq.quantile(q) == s[min(len(s) - 1, int(q * len(s)))]
+        assert sq.median() == s[len(s) // 2]
+
+    def test_bounded_window_and_empty(self):
+        sq = StreamingQuantile(maxlen=4)
+        assert sq.quantile(0.5) is None and len(sq) == 0
+        for v in (1.0, 2.0, 3.0, 4.0, 100.0):
+            sq.add(v)
+        assert len(sq) == 4          # 1.0 evicted
+        assert sq.quantile(0.0) == 2.0
+
+    def test_keep_policy_uses_shared_helper(self):
+        from paddle_tpu.telemetry.tracing import KeepPolicy
+        kp = KeepPolicy(latency_percentile=0.5)
+        assert isinstance(kp._latencies, StreamingQuantile)
+
+
+# ---------------------------------------------------------------------------
+# pair registry + drift rule
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_record_pair_and_drift(self):
+        assert calibration.record("step_time", 2.0, 1.0) == 0.5
+        p = calibration.pair("step_time")
+        assert p == {"key": "step_time", "predicted": 2.0, "measured": 1.0,
+                     "drift": 0.5, "n": 1}
+        assert calibration.drift("step_time") == 0.5
+        assert calibration.pair("nonexistent") is None
+
+    def test_non_positive_pairs_skipped(self):
+        assert calibration.record("k", 0.0, 1.0) is None
+        assert calibration.record("k", 1.0, -1.0) is None
+        assert calibration.record("k", None, 1.0) is None
+        assert calibration.pair("k") is None
+
+    def test_summary_quantiles(self):
+        for m in (1.0, 2.0, 4.0):
+            calibration.record("k", 1.0, m)
+        s = calibration.summary()["k"]
+        assert s["n"] == 3 and s["drift"] == 4.0
+        assert s["log_drift_p50"] == pytest.approx(math.log(2.0))
+        assert s["breaches"] == 0 and not s["latched"]
+
+    def test_gauges_exported_when_enabled(self):
+        with telemetry.scope(profile=False) as tel:
+            calibration.record("step_time", 1.0, 3.0)
+            reg = tel.registry
+            assert reg.get("calibration_drift_ratio").value(
+                key="step_time") == 3.0
+            assert reg.get("calibration_samples_total").value(
+                key="step_time") == 1
+            prom = telemetry.prometheus_text(reg)
+        assert "calibration_drift_ratio" in prom
+
+    def test_breach_fires_one_reason_tagged_flight_dump(self, tmp_path):
+        from paddle_tpu.telemetry import flight
+        out = tmp_path / "flight"
+        flight.configure(str(out))
+        try:
+            # 4 in-bound pairs arm the min-sample gate without breaching
+            for _ in range(4):
+                calibration.record("step_time", 1.0, 1.1)
+            assert not list(out.glob("flight_calibration_drift_*"))
+            # 5th pair drifts 10x: latch + dump
+            calibration.record("step_time", 1.0, 10.0, step=17)
+            dumps = list(out.glob("flight_calibration_drift_*.json"))
+            assert len(dumps) == 1
+            payload = json.loads(dumps[0].read_text())
+            assert payload["reason"] == "calibration_drift"
+            assert payload["step"] == 17
+            assert payload["extra"]["key"] == "step_time"
+            assert payload["extra"]["drift"] == pytest.approx(10.0)
+            # still drifting: latched, no second dump
+            calibration.record("step_time", 1.0, 10.0)
+            assert len(list(out.glob("flight_calibration_drift_*"))) == 1
+            s = calibration.summary()["step_time"]
+            assert s["breaches"] == 1 and s["latched"]
+            # recover to within bound/2 -> unlatch -> re-breach dumps again
+            calibration.record("step_time", 1.0, 1.0)
+            assert not calibration.summary()["step_time"]["latched"]
+            calibration.record("step_time", 1.0, 10.0)
+            assert len(list(out.glob("flight_calibration_drift_*"))) == 2
+            assert calibration.summary()["step_time"]["breaches"] == 2
+        finally:
+            flight.configure(None)
+
+
+# ---------------------------------------------------------------------------
+# calibration DB (tuner conventions)
+# ---------------------------------------------------------------------------
+
+class TestCalibrationDB:
+    def test_roundtrip_atomic(self, tmp_path):
+        path = str(tmp_path / "sub" / "db.json")
+        db = calibration.CalibrationDB()
+        db.put("cpu", {"peak_flops_per_sec": 5e9})
+        db.save(path)
+        assert not os.path.exists(path + ".tmp")
+        back = calibration.CalibrationDB.load(path)
+        assert back.lookup("cpu") == {"peak_flops_per_sec": 5e9}
+
+    def test_missing_and_corrupt_load_empty(self, tmp_path):
+        assert len(calibration.CalibrationDB.load(
+            str(tmp_path / "nope.json"))) == 0
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        with pytest.warns(UserWarning, match="unreadable"):
+            db = calibration.CalibrationDB.load(str(bad))
+        assert len(db) == 0
+        # wrong shape is corrupt too
+        bad.write_text('[1, 2]')
+        with pytest.warns(UserWarning):
+            assert len(calibration.CalibrationDB.load(str(bad))) == 0
+
+    def test_overlay_wins_over_seed(self):
+        base = calibration.CalibrationDB(
+            {"cpu": {"peak_flops_per_sec": 1.0}, "any": {"x": 1}})
+        over = calibration.CalibrationDB(
+            {"cpu": {"peak_flops_per_sec": 2.0}})
+        merged = over.merged_over(base)
+        assert merged.lookup("cpu")["peak_flops_per_sec"] == 2.0
+        assert merged.lookup("any") == {"x": 1}
+
+    def test_get_db_cache_and_refresh(self, tmp_path):
+        overlay = os.environ["PADDLE_TPU_CALIBRATION_DB"]
+        assert calibration.constants() == {}
+        db = calibration.CalibrationDB()
+        db.put(calibration.device_kind(), {"peak_flops_per_sec": 7e9})
+        db.save(overlay)
+        # cached merged view doesn't see the write until cleared
+        assert calibration.constants() == {}
+        calibration.clear_cache()
+        assert calibration.constants()["peak_flops_per_sec"] == 7e9
+
+    def test_generic_device_fallback(self):
+        db = calibration.CalibrationDB()
+        db.put(calibration.GENERIC_DEVICE, {"peak_flops_per_sec": 3e9})
+        db.save(os.environ["PADDLE_TPU_CALIBRATION_DB"])
+        calibration.clear_cache()
+        assert calibration.peak_flops_override() == 3e9
+
+
+# ---------------------------------------------------------------------------
+# fitting
+# ---------------------------------------------------------------------------
+
+class TestFit:
+    def test_fit_link_recovers_bandwidth_and_latency(self):
+        bw_true, lat_true = 2.0e9, 5e-5
+        pts = [(b, lat_true + b / bw_true)
+               for b in (1e5, 1e6, 4e6, 1e7)]
+        bw, lat, resid = calibration.fit_link(pts)
+        assert bw == pytest.approx(bw_true, rel=1e-6)
+        assert lat == pytest.approx(lat_true, rel=1e-6)
+        assert resid == pytest.approx(0.0, abs=1e-9)
+
+    def test_fit_link_single_sample_through_origin(self):
+        bw, lat, _ = calibration.fit_link([(1e6, 1e-3)])
+        assert bw == pytest.approx(1e9) and lat == 0.0
+
+    def test_fit_link_rejects_unusable(self):
+        assert calibration.fit_link([]) is None
+        assert calibration.fit_link([(0.0, 1.0), (-1.0, 2.0)]) is None
+        # negative-slope noise falls back to origin (positive bandwidth)
+        bw, lat, _ = calibration.fit_link([(1e6, 2e-3), (2e6, 1e-3)])
+        assert bw > 0 and lat == 0.0
+
+    def test_fit_writes_overlay_and_consumers_see_it(self):
+        from paddle_tpu.distributed.mesh import (LINK_BANDWIDTHS,
+                                                 link_bandwidth,
+                                                 link_latency)
+        assert link_bandwidth("ici") == LINK_BANDWIDTHS["ici"]
+        assert link_latency("ici") == 0.0
+        res = calibration.fit(
+            collective_samples=[
+                {"link": "ici", "wire_bytes": b, "seconds": 1e-4 + b / 5e9}
+                for b in (1e5, 1e6, 1e7)],
+            compute_samples=[{"flops": 1e9, "seconds": 0.5}],
+            serving_samples=[{"rows": 100, "seconds": 0.5}])
+        assert res["path"] == os.environ["PADDLE_TPU_CALIBRATION_DB"]
+        # fit() cleared the cache: every choke point now prices with the
+        # fitted constants
+        assert link_bandwidth("ici") == pytest.approx(5e9, rel=1e-6)
+        assert link_latency("ici") == pytest.approx(1e-4, rel=1e-6)
+        assert telemetry.peak_flops_per_sec() == pytest.approx(2e9)
+        assert calibration.serving_rates() == (pytest.approx(200.0),
+                                               pytest.approx(0.5))
+
+    def test_env_override_beats_calibration(self, monkeypatch):
+        from paddle_tpu.distributed.mesh import link_bandwidth
+        calibration.fit(collective_samples=[
+            {"link": "ici", "wire_bytes": 1e6, "seconds": 1e-3}])
+        assert link_bandwidth("ici") == pytest.approx(1e9)
+        monkeypatch.setenv("PADDLE_TPU_ICI_BPS", "123.0")
+        assert link_bandwidth("ici") == 123.0
+        monkeypatch.setenv("PADDLE_TPU_PEAK_FLOPS", "456.0")
+        assert telemetry.peak_flops_per_sec() == 456.0
+
+    def test_partial_fit_merges_into_existing_entry(self):
+        calibration.fit(compute_samples=[{"flops": 1e9, "seconds": 1.0}])
+        calibration.fit(collective_samples=[
+            {"link": "ici", "wire_bytes": 1e6, "seconds": 1e-3}])
+        e = calibration.constants()
+        assert e["peak_flops_per_sec"] == pytest.approx(1e9)
+        assert e["links"]["ici"]["bandwidth_bps"] == pytest.approx(1e9)
+
+
+# ---------------------------------------------------------------------------
+# consumers: planner pricing + serving admission
+# ---------------------------------------------------------------------------
+
+class TestConsumers:
+    def _gather_fixture(self):
+        mesh = _mesh(8, "sharding")
+
+        def fwd(w, x):
+            wf = jax.lax.with_sharding_constraint(
+                w, NamedSharding(mesh, P(None, None)))
+            return x @ wf
+
+        w = jnp.zeros((1024, 256), jnp.float32)
+        x = jnp.zeros((32, 1024), jnp.float32)
+        return jax.make_jaxpr(fwd)(w, x), mesh
+
+    def test_resharding_cost_consumes_calibrated_db(self):
+        from paddle_tpu.distributed.auto import resharding_cost
+        from paddle_tpu.distributed.mesh import LINK_BANDWIDTHS
+        closed, mesh = self._gather_fixture()
+        specs = [P("sharding", None), P()]
+        before = resharding_cost(closed, mesh, specs)
+        assert before["n_sites"] == 1
+        # halve the fitted bandwidth + add a fixed latency: the planner's
+        # time score must re-price through the same choke point
+        bw = LINK_BANDWIDTHS["ici"] / 2.0
+        calibration.fit(collective_samples=[
+            {"link": "ici", "wire_bytes": b, "seconds": 1e-3 + b / bw}
+            for b in (1e6, 4e6, 1e7)])
+        after = resharding_cost(closed, mesh, specs)
+        assert after["wire_bytes"] == before["wire_bytes"]
+        assert after["time_s"] == pytest.approx(
+            2.0 * before["time_s"] + 1e-3, rel=1e-3)
+
+    def test_overlap_summary_consumes_calibrated_db(self):
+        from paddle_tpu.analysis import cost
+        mesh = _mesh(4)
+
+        def step(x):
+            return jax.lax.psum(x @ x.T, "data")
+
+        closed = jax.make_jaxpr(
+            lambda x: jax.shard_map(step, mesh=mesh, in_specs=P("data"),
+                                    out_specs=P(), check_vma=False)(x)
+        )(jnp.zeros((4, 64), jnp.float32))
+        before = cost.overlap_summary(closed, mesh)
+        assert before["n_collectives"] >= 1
+        calibration.fit(
+            collective_samples=[
+                {"link": "ici", "wire_bytes": b, "seconds": b / 1e6}
+                for b in (1e4, 1e5)],
+            compute_samples=[{"flops": 1e12, "seconds": 1.0}])
+        after = cost.overlap_summary(closed, mesh)
+        assert after["peak_flops"] == pytest.approx(1e12)
+        # 90 GB/s -> 1 MB/s: collective time must grow by orders of
+        # magnitude through mesh.link_bandwidth
+        assert after["collective_time"] > before["collective_time"] * 1e3
+
+    def test_serving_ewma_seeded_from_calibration(self):
+        from paddle_tpu.inference.serving import (InferenceServer,
+                                                  ServingConfig)
+
+        def fn(arrs):
+            return arrs
+
+        cold = InferenceServer([fn], config=ServingConfig())
+        assert cold._ewma_rows_per_s is None
+        assert cold.stats()["modeled_wait_source"] == "default"
+        assert cold.modeled_wait(4) == 0.0
+
+        calibration.fit(serving_samples=[{"rows": 50, "seconds": 0.5}])
+        seeded = InferenceServer([fn], config=ServingConfig())
+        assert seeded._ewma_rows_per_s == pytest.approx(100.0)
+        assert seeded._ewma_batch_s == pytest.approx(0.5)
+        assert seeded.stats()["modeled_wait_source"] == "calibrated"
+        # the seeded rate prices a nonzero wait before any batch ran
+        assert seeded.modeled_wait(4) > 0.0
+
+    def test_serving_source_flips_to_ewma_after_real_batch(self):
+        from paddle_tpu.inference.serving import (InferenceServer,
+                                                  ServingConfig)
+        calibration.fit(serving_samples=[{"rows": 50, "seconds": 0.5}])
+
+        def fn(arrs):
+            return [np.asarray(a) * 2 for a in arrs]
+
+        with InferenceServer([fn], config=ServingConfig()) as srv:
+            assert srv.stats()["modeled_wait_source"] == "calibrated"
+            req = srv.submit([np.ones((2, 3), np.float32)])
+            assert req.result(timeout=10.0)
+            assert srv.stats()["modeled_wait_source"] == "ewma"
+            assert req.t_predicted_wait is not None
+            # the measured pair landed in the registry
+            assert calibration.pair("serving_queue_wait") is not None
+
+
+# ---------------------------------------------------------------------------
+# acceptance: calibrated strictly closer than default on the bench mesh
+# ---------------------------------------------------------------------------
+
+def test_calibrated_step_time_beats_default_on_bench_gpt_mesh():
+    """The acceptance criterion: fit() from measured CPU-mesh steps must
+    move the overlap model's predicted step time strictly closer to the
+    measured wall time than the uncalibrated defaults (whose 1 TFLOP/s
+    CPU peak is off by orders of magnitude)."""
+    from paddle_tpu import nn
+    from paddle_tpu.analysis import cost
+    from paddle_tpu.distributed.engine import ParallelTrainer
+    from paddle_tpu.text.models import GPTForPretraining
+
+    from paddle_tpu.distributed.mesh import build_mesh
+
+    paddle.seed(0)
+    mesh = build_mesh({"data": 2})
+    model = GPTForPretraining(
+        tensor_parallel=False, vocab_size=256, hidden_size=64,
+        num_layers=1, num_heads=2, max_position_embeddings=32,
+        attn_dropout=0.0, hidden_dropout=0.0)
+    model.bfloat16()
+    opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters())
+    trainer = ParallelTrainer(
+        model, opt,
+        lambda logits, lbl: nn.functional.cross_entropy(logits, lbl),
+        mesh=mesh, grad_sync="fp32", grad_sync_buckets=2)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 256, (4, 32)).astype("int32")
+    labels = rng.randint(0, 256, (4, 32)).astype("int32")
+
+    # stage + run under an enabled scope so the engine traces the step
+    # cost and records the live step_time pair itself
+    with telemetry.scope(profile=False) as tel:
+        closed = trainer.staged_jaxpr(ids, labels)
+        ov_default = cost.overlap_summary(closed, trainer.mesh)
+        flops = ov_default["compute_time"] * ov_default["peak_flops"]
+
+        # real steps: warmup (compile) then a few measured
+        for _ in range(2):
+            float(trainer.train_step(ids, labels))
+        dts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(trainer.train_step(ids, labels))
+            dts.append(time.perf_counter() - t0)
+        dts.sort()
+        measured = dts[len(dts) // 2]
+        assert tel.registry.get("calibration_drift_ratio") is not None
+
+    calibration.fit(
+        compute_samples=[{"flops": flops, "seconds": d} for d in dts])
+    ov_cal = cost.overlap_summary(closed, trainer.mesh)
+
+    err_default = abs(math.log(ov_default["makespan"] / measured))
+    err_cal = abs(math.log(ov_cal["makespan"] / measured))
+    assert err_cal < err_default, (
+        f"calibrated makespan {ov_cal['makespan']:.6f}s must beat default "
+        f"{ov_default['makespan']:.6f}s against measured {measured:.6f}s")
+    p = calibration.pair("step_time")
+    assert p is not None and p["predicted"] > 0 and p["measured"] > 0

@@ -196,12 +196,17 @@ def main(argv=None) -> int:
               and r["trace_accounting_closed"]
               and r["kept_traces_path"] is not None)
     extra = dict(r, smoke=bool(args.smoke))
+    from paddle_tpu.telemetry import calibration
     print(json.dumps({
-        "schema_version": 1,
+        "schema_version": 2,
         "metric": "ckpt_async_stall_ratio",
         "value": r["ratio"],
         "unit": "x",
         "vs_baseline": 1.0,
+        # step_time {predicted, measured, drift} from the train steps
+        # run under telemetry.scope (engine pairs makespan vs wall time;
+        # telemetry.calibration, schema_version 2)
+        "calibration": calibration.pair("step_time"),
         "extra": extra,
     }))
     return 0 if ok else 1

@@ -26,6 +26,19 @@ mesh and reports the analysis overlap model's ``overlap_efficiency`` —
 the fraction of collective wire time hidden under backward/optimizer
 compute. Bucketed (K>=2) must strictly beat monolithic.
 
+The ``calibrate`` suite is the fitting sweep behind
+``telemetry.calibration``: it times a psum size ladder and real
+train-step walltimes, runs ``calibration.fit()`` to regress corrected
+per-link bandwidth/latency constants and an effective
+``peak_flops_per_sec``, persists them to the calibration-DB overlay
+(``--calibration-db`` / ``PADDLE_TPU_CALIBRATION_DB``), and reports the
+predicted-vs-measured step-time drift before and after the fit (after
+must shrink; ``--smoke`` asserts it).
+
+Every suite prints one JSON line at ``schema_version`` 2: the
+``calibration`` block carries the run's ``{predicted, measured, drift}``
+triples so BENCH_*.json files double as model-accuracy evidence.
+
 Usage:
     python tools/bench_collectives.py                     # defaults
     python tools/bench_collectives.py --numel 4194304 --devices 4 \
@@ -33,6 +46,7 @@ Usage:
     python tools/bench_collectives.py --smoke   # tiny shapes + telemetry
                                                 # self-check (CI)
     python tools/bench_collectives.py --suite overlap --json
+    python tools/bench_collectives.py --suite calibrate --smoke
 """
 from __future__ import annotations
 
@@ -86,7 +100,26 @@ def overlap_case(buckets: int, smoke: bool, devices: int,
     return out
 
 
+def _timed_trainer_steps(trainer, ids, labels, warmup: int,
+                         iters: int) -> float:
+    """Median per-step wall seconds of real train steps (loss fetch is
+    the sync point, like bench.py's _timed_steps)."""
+    for _ in range(max(1, warmup)):
+        loss = trainer.train_step(ids, labels)
+    float(loss)
+    times = []
+    for _ in range(max(1, iters)):
+        t0 = time.perf_counter()
+        loss = trainer.train_step(ids, labels)
+        float(loss)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
 def run_overlap(args) -> None:
+    from paddle_tpu.telemetry import calibration
+
     k = max(2, args.buckets)
     base = overlap_case(1, args.smoke, args.devices, args.policy)
     bucketed = overlap_case(k, args.smoke, args.devices, args.policy)
@@ -95,6 +128,12 @@ def run_overlap(args) -> None:
     if args.smoke:
         assert effk is not None and effk > 0, bucketed
         assert eff1 is None or effk > eff1, (base, bucketed)
+    # predicted-vs-measured: the bucketed schedule's modeled makespan vs
+    # a couple of real steps of the same trainer configuration
+    trainer, ids, labels = _overlap_trainer(k, args.smoke, args.devices,
+                                            args.policy)
+    dt = _timed_trainer_steps(trainer, ids, labels, warmup=2, iters=3)
+    calibration.record("step_time", bucketed["makespan"], dt)
     extra = {"k": k, "devices": args.devices, "policy": args.policy,
              "smoke": bool(args.smoke),
              "overlap_efficiency_k1": eff1,
@@ -105,22 +144,140 @@ def run_overlap(args) -> None:
         extra["k1"] = base
         extra[f"k{k}"] = bucketed
     print(json.dumps({
-        "schema_version": 1,
+        "schema_version": 2,
         "metric": "grad_sync_overlap_efficiency",
         "value": effk,
         "unit": "frac",
         "vs_baseline": eff1,
+        "calibration": calibration.pair("step_time"),
         "extra": extra,
+    }))
+
+
+def run_calibrate(args) -> None:
+    """The fitting sweep: measured collectives + measured train steps ->
+    calibration.fit() -> overlay DB -> drift before/after."""
+    import math
+    import os
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from _mesh_setup import data_mesh
+    from paddle_tpu.analysis import cost
+    from paddle_tpu.telemetry import calibration
+
+    db_path = args.calibration_db
+    if not db_path and args.smoke:
+        # keep the smoke self-test hermetic: never write ~/.cache
+        db_path = os.path.join(tempfile.mkdtemp(prefix="paddle_calib_"),
+                               "calibration_db.json")
+    if db_path:
+        os.environ["PADDLE_TPU_CALIBRATION_DB"] = db_path
+    calibration.clear_cache()
+
+    mesh = data_mesh(args.devices)
+    n = mesh.devices.size
+
+    # -- collective ladder: psum wall time across payload sizes ---------
+    sizes = ([1 << 12, 1 << 14, 1 << 16] if args.smoke
+             else [1 << 14, 1 << 16, 1 << 18, 1 << 20, 1 << 22])
+    coll_samples = []
+    for numel in sizes:
+        xd = jax.device_put(
+            jnp.ones((n, numel), jnp.float32),
+            NamedSharding(mesh, P("data", None)))
+        jfn = jax.jit(jax.shard_map(
+            lambda xs: jax.lax.psum(xs, "data"), mesh=mesh,
+            in_specs=P("data", None), out_specs=P(None, None),
+            check_vma=False))
+        jfn(xd).block_until_ready()
+        times = []
+        for _ in range(max(2, args.iters)):
+            t0 = time.perf_counter()
+            jfn(xd).block_until_ready()
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        dt = times[len(times) // 2]
+        # the cost model's ring figure for psum: 2(n-1)/n payload bytes
+        wire = 2.0 * (n - 1) / n * numel * 4
+        coll_samples.append({"link": "ici", "wire_bytes": wire,
+                             "seconds": dt})
+
+    # -- compute samples: real steps of the bench-GPT trainer -----------
+    trainer, ids, labels = _overlap_trainer(
+        max(2, args.buckets), args.smoke, args.devices, args.policy)
+    closed = trainer.staged_jaxpr(ids, labels)
+    ov_before = cost.overlap_summary(closed, trainer.mesh)
+    flops = ov_before["compute_time"] * ov_before["peak_flops"]
+    steps = 3 if args.smoke else max(5, args.iters)
+    dts = []
+    _timed_trainer_steps(trainer, ids, labels, warmup=1, iters=1)
+    for _ in range(steps):
+        dts.append(_timed_trainer_steps(trainer, ids, labels,
+                                        warmup=0, iters=1))
+    dts.sort()
+    measured = dts[len(dts) // 2]
+    compute_samples = [{"flops": flops, "seconds": d} for d in dts]
+
+    drift_before = measured / ov_before["makespan"]
+    fitted = calibration.fit(collective_samples=coll_samples,
+                             compute_samples=compute_samples,
+                             save=True, db_path=db_path)
+    # every consumer reads the fitted constants through the same choke
+    # points, so re-pricing the identical jaxpr shows the correction
+    ov_after = cost.overlap_summary(closed, trainer.mesh)
+    drift_after = measured / ov_after["makespan"]
+    calibration.record("step_time", ov_after["makespan"], measured)
+    links = fitted["entry"].get("links", {}).get("ici", {})
+    if links.get("bandwidth_bps"):
+        for s in coll_samples:
+            calibration.record(
+                "collective_ici",
+                s["wire_bytes"] / links["bandwidth_bps"]
+                + links.get("latency_s", 0.0),
+                s["seconds"])
+    if args.smoke:
+        assert abs(math.log(drift_after)) < abs(math.log(drift_before)), (
+            drift_before, drift_after, fitted)
+    print(json.dumps({
+        "schema_version": 2,
+        "metric": "calibration_step_time_drift",
+        "value": drift_after,
+        "unit": "x",
+        "vs_baseline": drift_before,
+        "calibration": {
+            "step_time": calibration.pair("step_time"),
+            "collective_ici": calibration.pair("collective_ici"),
+        },
+        "extra": {
+            "devices": n, "smoke": bool(args.smoke),
+            "db_path": fitted["path"],
+            "predicted_before_s": ov_before["makespan"],
+            "predicted_after_s": ov_after["makespan"],
+            "measured_s": measured,
+            "n_collective_samples": len(coll_samples),
+            "n_compute_samples": len(compute_samples),
+            "fitted": fitted["entry"],
+        },
     }))
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--suite", choices=("exchange", "overlap"),
+    ap.add_argument("--suite", choices=("exchange", "overlap", "calibrate"),
                     default="exchange",
                     help="exchange: wire bytes/latency per policy; "
                          "overlap: staged-step overlap_efficiency at "
-                         "K=1 vs K=--buckets")
+                         "K=1 vs K=--buckets; calibrate: fit corrected "
+                         "wire/peak constants into the calibration DB "
+                         "from measured collectives + train steps")
+    ap.add_argument("--calibration-db", default=None,
+                    help="calibrate suite: overlay DB path to write "
+                         "(default: PADDLE_TPU_CALIBRATION_DB or the "
+                         "user cache overlay; --smoke uses a tempdir)")
     ap.add_argument("--numel", type=int, default=1 << 22,
                     help="total gradient elements (fp32)")
     ap.add_argument("--devices", type=int, default=4,
@@ -157,6 +314,8 @@ def main():
     ensure_repo_on_path()
     if args.suite == "overlap":
         return run_overlap(args)
+    if args.suite == "calibrate":
+        return run_calibrate(args)
 
     import math
 
@@ -238,6 +397,17 @@ def main():
     for policy in ("fp32", "bf16", "int8", "int4"):
         dt, rel = run_case(mesh, "data", policy, blocks[policy])
         wire = wire_bytes_per_rank(numel, n, policy, block=blocks[policy])
+        if policy == "fp32":
+            # predicted-vs-measured wire time of the plain exchange: the
+            # ring model over link_bandwidth/link_latency vs wall clock
+            # (on forced host devices this measures the code path, not
+            # ICI — still the honest drift of the model on this backend)
+            from paddle_tpu.distributed.mesh import (link_bandwidth,
+                                                     link_latency)
+            from paddle_tpu.telemetry import calibration
+            calibration.record(
+                "collective_ici",
+                wire / link_bandwidth("ici") + link_latency("ici"), dt)
         telemetry.counter(
             "grad_sync_bytes_total",
             "logical wire bytes per rank of the bucketed grad "
@@ -300,12 +470,14 @@ def main():
             "overlap_efficiency": ov["overlap_efficiency"],
             "n_collectives": ov["n_collectives"],
             "buckets": ov["buckets"]}
+    from paddle_tpu.telemetry import calibration as _calibration
     print(json.dumps({
-        "schema_version": 1,
+        "schema_version": 2,
         "metric": "int8_vs_fp32_bytes_x",
         "value": round(ratio, 3),
         "unit": "x",
         "vs_baseline": 1.0,
+        "calibration": _calibration.pair("collective_ici"),
         "extra": {"numel": numel, "devices": n, "block": args.block,
                   "int4_block": args.int4_block,
                   "bucket_mb": args.bucket_mb, "smoke": bool(args.smoke),

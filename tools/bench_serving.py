@@ -595,11 +595,15 @@ def run_bench(smoke: bool, seed: int = 0) -> dict:
     }
     checks.update(decode_checks)
     checks.update(spec_checks)
+    from paddle_tpu.telemetry import calibration
     return {
-        "schema_version": 1,
+        "schema_version": 2,
         "metric": "serving_overload_goodput_rps",
         "value": overload["goodput_rps"],
         "unit": "req/s",
+        # admission's modeled wait vs the measured queue wait, most
+        # recent pair (telemetry.calibration; schema_version 2)
+        "calibration": calibration.pair("serving_queue_wait"),
         "extra": {
             "smoke": smoke,
             "capacity_rps_nominal": capacity,

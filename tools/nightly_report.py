@@ -1,0 +1,196 @@
+"""Nightly lane report: fold the slow-lane JSON and the tier-1 duration
+budget into ONE summary file (ISSUE 18 satellite; ROADMAP carried item).
+
+``tools/run_slow_lane.sh`` already prints (and writes, via
+``SLOW_LANE_JSON``) a one-line outcome JSON, and ``tests/conftest.py``
+writes the per-file tier-1 duration budget to ``TIER1_DURATIONS_JSON``.
+This script is the missing last step of the nightly wiring: scrape both,
+emit a single machine-greppable summary, and exit non-zero when either
+lane is unhealthy. Cron it right after the slow lane:
+
+    17 3 * * * cd repo && tools/run_slow_lane.sh; tools/nightly_report.py
+
+Inputs (either may be missing — recorded as null, and counted unhealthy
+only if ``--require`` lists it):
+
+- ``--slow-lane``: the slow-lane JSON file (default ``SLOW_LANE_JSON``
+  env or /tmp/_slow_lane_summary.json). Plain log files work too: the
+  last line that parses as JSON with a "lane" key wins, so pointing this
+  at the cron log is fine.
+- ``--tier1-durations``: the conftest budget report (default
+  ``TIER1_DURATIONS_JSON`` env or /tmp/tier1_durations.json).
+
+Output: ``--out`` (default /tmp/nightly_report.json) plus the same
+summary on stdout as one JSON line:
+
+    {"report": "nightly", "ok": true, "slow_lane": {...},
+     "tier1_durations": {...}, "problems": []}
+
+``ok`` = slow lane rc 0 and not timed out, and tier-1 not over budget
+(for whichever inputs are present). ``--smoke`` self-checks the whole
+flow against synthetic inputs in a tempdir (registered in
+tests/test_bench_smoke.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+
+def _read_json_line(path: str, require_key: str) -> Optional[dict]:
+    """Last line of ``path`` that parses as a JSON object containing
+    ``require_key`` (None when the file is missing or has no such line).
+    Scanning backwards lets this read both the dedicated summary file
+    and an appended cron log."""
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return None
+    for line in reversed(lines):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and require_key in obj:
+            return obj
+    return None
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+        return obj if isinstance(obj, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def build_report(slow_lane_path: str, durations_path: str,
+                 require: tuple = ()) -> dict:
+    slow = _read_json_line(slow_lane_path, "lane")
+    durations = _read_json(durations_path)
+
+    problems = []
+    if slow is None:
+        if "slow" in require:
+            problems.append(f"slow-lane JSON missing: {slow_lane_path}")
+    else:
+        if slow.get("rc", 1) != 0:
+            problems.append(f"slow lane rc={slow.get('rc')} "
+                            f"(failed={slow.get('failed')}, "
+                            f"errors={slow.get('errors')})")
+        if slow.get("timed_out"):
+            problems.append("slow lane timed out")
+    if durations is None:
+        if "tier1" in require:
+            problems.append(f"tier-1 durations missing: {durations_path}")
+    else:
+        if durations.get("over_budget"):
+            problems.append(
+                f"tier-1 over budget: {durations.get('total_s')}s "
+                f"> {durations.get('budget_s')}s")
+
+    return {
+        "report": "nightly",
+        "ok": not problems,
+        "slow_lane": slow,
+        "tier1_durations": durations,
+        "problems": problems,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--slow-lane",
+                    default=os.environ.get("SLOW_LANE_JSON",
+                                           "/tmp/_slow_lane_summary.json"))
+    ap.add_argument("--tier1-durations",
+                    default=os.environ.get("TIER1_DURATIONS_JSON",
+                                           "/tmp/tier1_durations.json"))
+    ap.add_argument("--out", default="/tmp/nightly_report.json")
+    ap.add_argument("--require", default="",
+                    help="comma list of inputs that MUST be present "
+                         "(slow,tier1); missing ones then fail the "
+                         "report instead of reading as null")
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-test against synthetic inputs in a "
+                         "tempdir (ignores the path flags)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        return _smoke()
+
+    require = tuple(p for p in args.require.split(",") if p)
+    report = build_report(args.slow_lane, args.tier1_durations,
+                          require=require)
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+    os.replace(tmp, args.out)
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
+def _smoke() -> int:
+    """End-to-end self-check: green inputs -> ok, red inputs -> problems."""
+    import tempfile
+    with tempfile.TemporaryDirectory(prefix="nightly_report_") as td:
+        slow = os.path.join(td, "slow.json")
+        dur = os.path.join(td, "durations.json")
+        out = os.path.join(td, "report.json")
+
+        # green: a healthy slow lane buried in cron-log noise + an
+        # in-budget tier-1 report
+        with open(slow, "w") as f:
+            f.write("some cron banner\n"
+                    "not json {{{\n"
+                    '{"lane": "slow", "rc": 0, "passed": 38, "failed": 0,'
+                    ' "errors": 0, "skipped": 1, "duration_s": 1234.5,'
+                    ' "timed_out": false, "log": "/tmp/x.log"}\n')
+        with open(dur, "w") as f:
+            json.dump({"total_s": 500.0, "budget_s": 870.0,
+                       "over_budget": False, "markexpr": "not slow",
+                       "per_file": {"tests/test_x.py": 500.0}}, f)
+        rc = main(["--slow-lane", slow, "--tier1-durations", dur,
+                   "--out", out, "--require", "slow,tier1"])
+        assert rc == 0, rc
+        rep = _read_json(out)
+        assert rep and rep["ok"] and rep["slow_lane"]["passed"] == 38, rep
+
+        # red: failing slow lane + over-budget tier-1
+        with open(slow, "w") as f:
+            f.write('{"lane": "slow", "rc": 1, "passed": 30, "failed": 8,'
+                    ' "errors": 0, "skipped": 1, "duration_s": 2000,'
+                    ' "timed_out": false, "log": "/tmp/x.log"}\n')
+        with open(dur, "w") as f:
+            json.dump({"total_s": 900.0, "budget_s": 870.0,
+                       "over_budget": True, "markexpr": "not slow",
+                       "per_file": {}}, f)
+        rc = main(["--slow-lane", slow, "--tier1-durations", dur,
+                   "--out", out])
+        assert rc == 1, rc
+        rep = _read_json(out)
+        assert rep and not rep["ok"] and len(rep["problems"]) == 2, rep
+
+        # missing inputs: null without --require, problem with it
+        missing = os.path.join(td, "nope.json")
+        rc = main(["--slow-lane", missing, "--tier1-durations", missing,
+                   "--out", out])
+        assert rc == 0, rc
+        rc = main(["--slow-lane", missing, "--tier1-durations", missing,
+                   "--out", out, "--require", "slow,tier1"])
+        assert rc == 1, rc
+    print(json.dumps({"metric": "nightly_report_smoke", "value": 1,
+                      "unit": "ok", "extra": {"checks": 4}}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,8 +15,11 @@
 #    "skipped": 1, "duration_s": 1234.5, "timed_out": false, "log": "..."}
 #
 # rc 0 = all green; rc of pytest otherwise; rc 124/137 = lane timeout.
+# The same JSON line is also written to SLOW_LANE_JSON so
+# tools/nightly_report.py can scrape it without parsing the cron log.
 # Env knobs: SLOW_LANE_TIMEOUT (seconds, default 5400),
-#            SLOW_LANE_LOG (default /tmp/_slow_lane.log).
+#            SLOW_LANE_LOG (default /tmp/_slow_lane.log),
+#            SLOW_LANE_JSON (default /tmp/_slow_lane_summary.json).
 set -u
 cd "$(dirname "$0")/.."
 
@@ -46,7 +49,10 @@ failed=$(count failed)
 errors=$(count error)
 skipped=$(count skipped)
 
-printf '{"lane": "slow", "rc": %d, "passed": %s, "failed": %s, "errors": %s, "skipped": %s, "duration_s": %d, "timed_out": %s, "log": "%s"}\n' \
+JSON_OUT="${SLOW_LANE_JSON:-/tmp/_slow_lane_summary.json}"
+line=$(printf '{"lane": "slow", "rc": %d, "passed": %s, "failed": %s, "errors": %s, "skipped": %s, "duration_s": %d, "timed_out": %s, "log": "%s"}' \
     "$rc" "$passed" "$failed" "$errors" "$skipped" "$((end - start))" \
-    "$timed_out" "$LOG"
+    "$timed_out" "$LOG")
+echo "$line"
+echo "$line" >"$JSON_OUT"
 exit "$rc"
