@@ -153,7 +153,16 @@ class Tensor:
 # member. Keyed on (abspath, mtime_ns, size) of both artifact files so a
 # re-saved model is reloaded, not served stale. Weight-quantized views
 # are cached next to the raw layer under the quant spec.
+#
+# Hot-swap interplay: when a new model generation overwrites the artifact
+# in place, the incumbent generation's entry must survive until the
+# rollout is decided — a rollback that re-stats the file would key onto
+# the NEW bytes and load the very artifact it is rolling back from. The
+# fleet therefore pins the incumbent's key (``pin_layer``) before a
+# canary starts and loads through ``Predictor(config, layer_key=key)``;
+# pinned entries are immune to ``evict_stale_layers``.
 _LAYER_CACHE: Dict[tuple, object] = {}
+_LAYER_CACHE_PINS: Dict[tuple, int] = {}
 _LAYER_CACHE_LOCK = threading.Lock()
 
 
@@ -168,8 +177,66 @@ def _layer_cache_key(prefix: str, quant=None) -> tuple:
     return tuple(key)
 
 
-def _load_layer(prefix: str, quant=None):
-    key = _layer_cache_key(prefix, quant)
+def layer_cache_key(prefix: str, quant=None) -> tuple:
+    """Public form of the cache key for the artifact currently on disk —
+    capture it BEFORE a hot-swap overwrites the files, then pin it."""
+    return _layer_cache_key(prefix, quant)
+
+
+def pin_layer(key: tuple) -> None:
+    """Refcount-pin a cache entry so eviction never drops it; loading
+    through ``_load_layer(..., key=key)`` then serves the pinned bytes
+    regardless of what the artifact files say now."""
+    with _LAYER_CACHE_LOCK:
+        _LAYER_CACHE_PINS[key] = _LAYER_CACHE_PINS.get(key, 0) + 1
+
+
+def unpin_layer(key: tuple) -> None:
+    with _LAYER_CACHE_LOCK:
+        n = _LAYER_CACHE_PINS.get(key, 0) - 1
+        if n > 0:
+            _LAYER_CACHE_PINS[key] = n
+        else:
+            _LAYER_CACHE_PINS.pop(key, None)
+
+
+def evict_stale_layers() -> int:
+    """Drop cache entries whose artifact files changed since load
+    (stat key no longer matches) — EXCEPT pinned ones. Returns the
+    number evicted."""
+    evicted = 0
+    with _LAYER_CACHE_LOCK:
+        for key in list(_LAYER_CACHE):
+            if _LAYER_CACHE_PINS.get(key):
+                continue
+            prefix, quant = key[0], key[1]
+            if _layer_cache_key(prefix, quant) != key:
+                del _LAYER_CACHE[key]
+                evicted += 1
+    return evicted
+
+
+def _load_layer(prefix: str, quant=None, key: Optional[tuple] = None):
+    """Load (or fetch cached) the exported layer for ``prefix``.
+
+    ``key`` requests a SPECIFIC cached generation (normally pinned): the
+    load must not fall back to whatever bytes are on disk now — if the
+    entry is gone and the on-disk artifact no longer matches the key,
+    that generation is unrecoverable and this raises ``KeyError`` rather
+    than silently serving the wrong model.
+    """
+    if key is not None:
+        with _LAYER_CACHE_LOCK:
+            layer = _LAYER_CACHE.get(key)
+        if layer is not None:
+            return layer
+        if _layer_cache_key(prefix, quant) != key:
+            raise KeyError(
+                f"pinned layer generation {key!r} is not cached and the "
+                f"on-disk artifact no longer matches it")
+        # files still match the requested key: a normal load is that
+        # generation
+    key = _layer_cache_key(prefix, quant) if key is None else key
     with _LAYER_CACHE_LOCK:
         layer = _LAYER_CACHE.get(key)
     if layer is not None:
@@ -188,6 +255,7 @@ def _load_layer(prefix: str, quant=None):
 def clear_layer_cache():
     with _LAYER_CACHE_LOCK:
         _LAYER_CACHE.clear()
+        _LAYER_CACHE_PINS.clear()
 
 
 class Predictor:
@@ -195,9 +263,13 @@ class Predictor:
     (shared per prefix across a pool); ``run`` executes the compiled
     program on the serving device."""
 
-    def __init__(self, config: Config):
+    def __init__(self, config: Config, layer_key: Optional[tuple] = None):
         self._config = config
-        self._layer = _load_layer(config._prefix, config._weight_quant)
+        self._layer = _load_layer(config._prefix, config._weight_quant,
+                                  key=layer_key)
+        self._layer_key = (layer_key if layer_key is not None
+                           else _layer_cache_key(config._prefix,
+                                                 config._weight_quant))
         self._input_names = [f"x{i}" for i in range(self._n_user_inputs())]
         self._output_names = ["out0"]
         self._inputs: Dict[str, np.ndarray] = {}
@@ -249,7 +321,8 @@ def create_predictor(config: Config) -> Predictor:
 
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
-           "clear_layer_cache"]
+           "clear_layer_cache", "layer_cache_key", "pin_layer",
+           "unpin_layer", "evict_stale_layers"]
 
 
 class DataType:
@@ -324,7 +397,8 @@ __all__ += ["DataType", "PlaceType", "PrecisionType", "PredictorPool",
 def __getattr__(name):
     # lazy submodules: the serving runtime / weight quantizer are only
     # imported when asked for, keeping the base handle API import-light
-    if name in ("serving", "quant", "kv_cache", "decode_model"):
+    if name in ("serving", "quant", "kv_cache", "decode_model",
+                "fleet", "executor_cache", "spec_decode"):
         import importlib
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod

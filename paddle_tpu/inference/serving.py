@@ -375,6 +375,10 @@ class InferenceServer:
         self._inflight: set = set()
         self._inflight_rows = 0
         self._seen_shapes: set = set()
+        # fleet/executor-cache hook: called with (sig, bucket) whenever a
+        # first-seen shape pays a compile, so a persistent cache can
+        # record it and pre-warm future replicas (see executor_cache.py)
+        self.shape_observer: Optional[Callable[[str, int], None]] = None
         self._seq = 0
         self._rr = 0
         self._ewma_rows_per_s: Optional[float] = None
@@ -417,6 +421,19 @@ class InferenceServer:
         self._batcher.start()
         self._set_healthy_gauge()
         return self
+
+    def warm_start(self, shape_pairs) -> int:
+        """Pre-seed the seen-shape set with ``(sig, bucket)`` pairs whose
+        executables are already compiled (primed from the persistent
+        executor cache), so serving them does NOT count as a recompile.
+        Returns the number of newly seeded pairs."""
+        added = 0
+        for sig, bucket in shape_pairs:
+            pair = (sig, int(bucket))
+            if pair not in self._seen_shapes:
+                self._seen_shapes.add(pair)
+                added += 1
+        return added
 
     def __enter__(self) -> "InferenceServer":
         return self.start()
@@ -613,6 +630,12 @@ class InferenceServer:
         if (sig, bucket) not in self._seen_shapes:
             self._seen_shapes.add((sig, bucket))
             self._count("serving_recompiles_total")
+            obs = self.shape_observer
+            if obs is not None:
+                try:
+                    obs(sig, bucket)
+                except Exception:
+                    pass  # cache bookkeeping must never fail a batch
         replica = self._pick_replica(live)
         if replica is None:
             return  # everyone expired while no replica was healthy

@@ -169,6 +169,17 @@ flight_dumps_total             counter    flight-recorder ring dumps
                                           slo_*}
 slo_alerts_total               counter    telemetry.slo rolling-window
                                           burn-rate breaches {rule=...}
+fleet_replicas                 gauge      live serving-fleet members
+                                          (heartbeated membership files
+                                          under the coordinator root)
+fleet_scale_events_total       counter    fleet autoscale actions
+                                          {direction=up|down, reason=
+                                          modeled_wait|queue_depth|
+                                          slo_*|idle|...}
+hot_swap_total                 counter    model hot-swap rollouts
+                                          {outcome=promoted|rolled_back}
+canary_health_checks_total     counter    canary verdicts during hot-swap
+                                          {outcome=pass|fail}
 schedule_verify_total          counter    cross-rank collective-schedule
                                           fingerprint verifications
                                           (bootstrap + every elastic

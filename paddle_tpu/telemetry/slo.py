@@ -14,6 +14,15 @@ Hysteresis: a rule that fired stays latched until its burn rate falls
 below half the threshold, so a sustained breach produces one alert (and
 one dump), not one per poll.
 
+Actions: each rule carries a registry of alert actions
+(``rule.on_alert(fn)`` registers ``fn(rule, burn)``; usable as a
+decorator) so breaches can trigger behavior — scale up a serving fleet,
+open a breaker — not just dumps.  When no action is registered the
+flight-recorder dump remains the default.  ``rule.on_clear(fn)``
+registers the symmetric unlatch hook, fired once when a latched rule's
+burn falls below threshold/2.  Action exceptions are swallowed:
+monitoring must never take down the monitored.
+
 ``poll(now=)`` takes an explicit timestamp so tests drive time directly;
 ``maybe_poll`` rate-limits polling for hot-path callers (the serving
 admission path pokes it on shed).
@@ -26,7 +35,7 @@ from collections import deque
 from typing import Callable, List, Optional
 
 __all__ = ["SloRule", "SloMonitor", "get_monitor", "set_monitor",
-           "maybe_poll", "install_shed_rule", "reset"]
+           "maybe_poll", "install_shed_rule", "reset", "default_alert"]
 
 
 def _registry():
@@ -57,14 +66,38 @@ class SloRule:
         self.threshold = threshold
         self.window_s = window_s
         self.min_denominator = min_denominator
-        self.on_alert = on_alert
+        self._alert_actions: List[Callable] = []
+        self._clear_actions: List[Callable] = []
+        if on_alert is not None:
+            self._alert_actions.append(on_alert)
         self._samples = deque()   # (t, num_total, den_total)
         self.latched = False
         self.alerts = 0
+        self.clears = 0
         self.last_burn = 0.0
 
+    def on_alert(self, fn: Callable) -> Callable:
+        """Register ``fn(rule, burn)`` to run when this rule fires.
+
+        Registering any action replaces the default flight dump; register
+        ``slo.default_alert`` explicitly to keep the dump alongside other
+        actions.  Returns ``fn`` so this works as a decorator.
+        """
+        self._alert_actions.append(fn)
+        return fn
+
+    def on_clear(self, fn: Callable) -> Callable:
+        """Register ``fn(rule, burn)`` to run when a latched rule
+        unlatches (burn fell below threshold/2).  Decorator-friendly."""
+        self._clear_actions.append(fn)
+        return fn
+
     def sample(self, now: float, reg) -> Optional[float]:
-        """Record a sample; return the burn rate when the rule fires."""
+        """Record a sample; return the burn rate when the rule fires.
+
+        Unlatching bumps ``self.clears`` — ``SloMonitor.poll`` watches
+        the latch transition to run ``on_clear`` actions.
+        """
         num = _counter_total(reg, self.numerator)
         den = _counter_total(reg, self.denominator)
         self._samples.append((now, num, den))
@@ -79,6 +112,7 @@ class SloRule:
         if self.latched:
             if burn < self.threshold / 2.0:
                 self.latched = False
+                self.clears += 1
             return None
         if burn > self.threshold:
             self.latched = True
@@ -104,19 +138,30 @@ class SloMonitor:
         now = time.monotonic() if now is None else now
         reg = self._reg()
         fired = []
+        cleared = []
         with self._lock:
             self._last_poll = now
             for rule in self.rules:
+                was_latched = rule.latched
                 burn = rule.sample(now, reg)
                 if burn is not None:
                     fired.append((rule, burn))
+                elif was_latched and not rule.latched:
+                    cleared.append((rule, rule.last_burn))
         for rule, burn in fired:
             reg.counter("slo_alerts_total").inc(rule=rule.name)
-            cb = rule.on_alert or _default_alert
-            try:
-                cb(rule, burn)
-            except Exception:
-                pass   # monitoring must never take down the monitored
+            actions = rule._alert_actions or [_default_alert]
+            for cb in actions:
+                try:
+                    cb(rule, burn)
+                except Exception:
+                    pass   # monitoring must never take down the monitored
+        for rule, burn in cleared:
+            for cb in rule._clear_actions:
+                try:
+                    cb(rule, burn)
+                except Exception:
+                    pass
         return fired
 
     def maybe_poll(self, now: Optional[float] = None):
@@ -133,6 +178,11 @@ def _default_alert(rule: SloRule, burn: float):
     flight.dump(f"slo_{rule.name}",
                 extra={"burn_rate": burn, "threshold": rule.threshold,
                        "window_s": rule.window_s})
+
+
+#: Public name for the default dump action, so callers that register
+#: their own ``on_alert`` actions can keep the dump too.
+default_alert = _default_alert
 
 
 _monitor: Optional[SloMonitor] = None

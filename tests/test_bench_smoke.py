@@ -495,3 +495,56 @@ def test_lint_program_smoke_strict():
         assert res["ok"], f"{fname}: {json.dumps(res)}"
         for member, m in res["members"].items():
             assert m["fingerprint"] == res["fingerprints"][member]
+
+
+def test_nightly_scheduler_dry_run():
+    """tools/nightly_scheduler.sh --dry-run: the nightly cron/CI stanza's
+    self-check — run_slow_lane.sh and nightly_report.py present and
+    runnable, the report's synthetic self-check green, the CI workflow
+    file in place — without paying the slow lane. Keeps the scheduler
+    wiring itself from bit-rotting."""
+    script = os.path.join(REPO, "tools", "nightly_scheduler.sh")
+    proc = subprocess.run([script, "--dry-run"], capture_output=True,
+                          text=True, timeout=120, env=_env(), cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    res = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert res["scheduler"] == "nightly"
+    assert res["mode"] == "dry_run"
+    assert res["ok"] is True
+    assert res["problems"] == []
+    # cron points at the stanza itself, so cron and CI share one pipeline
+    assert "nightly_scheduler.sh" in res["cron"]
+    proc2 = subprocess.run([script, "--print-cron"], capture_output=True,
+                           text=True, timeout=60, env=_env(), cwd=REPO)
+    assert proc2.returncode == 0
+    assert proc2.stdout.strip() == res["cron"]
+
+
+def test_chaos_hot_swap_scenario():
+    """tools/chaos_smoke.py --scenario hot_swap: the ISSUE 19 serving-
+    fleet acceptance — an SLO burn-rate breach under overload fires the
+    rule's registered scale-up action; an exponent-poisoned checkpoint
+    (CRC-committed fine) is canaried on shadow traffic, fails the
+    output-sanity gate and rolls back with the pinned incumbent still
+    serving finite outputs; a good checkpoint then promotes fleet-wide.
+    Zero requests lost fleet-wide, zero compile cold starts (persistent
+    executor cache)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_smoke.py"),
+         "--scenario", "hot_swap"],
+        capture_output=True, text=True, timeout=400, env=_env())
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert lines, f"no stdout; stderr: {proc.stderr[-2000:]}"
+    res = json.loads(lines[-1])
+    assert res["exit_code"] == 0, res
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert res["scenario"] == "hot_swap"
+    assert res["slo_alerts"] >= 1 and res["scale_ups"] >= 1
+    assert res["members_after_burst"] >= 3
+    assert res["canary_rolled_back"] == 1
+    assert res["canary_checks_bad"]["sanity"] is False
+    assert res["canary_promoted"] == 1
+    assert res["generation_final"] == 2
+    assert res["requests_lost"] == 0
+    assert res["recompiles"] == 0 and res["cold_starts_closed"] is True
+    assert res["accounted"] is True

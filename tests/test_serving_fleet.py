@@ -1,0 +1,551 @@
+"""ISSUE 19: the self-healing serving fleet and its satellites.
+
+- SLO rule action registry (``on_alert`` / ``on_clear``): registered
+  actions replace the default flight dump, latch/unlatch drives them
+  exactly once per episode, a raising action never breaks the poll.
+- Persistent compiled-executor cache: round-trip, corrupt-file
+  degradation, ``warm_start`` / ``prime`` closing the recompile set.
+- Layer-cache generation pinning: a pinned entry survives the artifact
+  being overwritten on disk (the hot-swap rollback guarantee), eviction
+  skips pins, and a pinned key whose entry is gone fails loudly instead
+  of silently serving the wrong bytes.
+- ServingFleet: membership files, SLO-action + threshold autoscaling,
+  canary rollback / promotion, the hot-swap poller, SIGTERM draining
+  every member exactly once — all with fleet-wide closed accounting.
+
+Fleet unit tests use plain-numpy executors (no jax compile) so the
+whole file stays fast; the Predictor-backed end-to-end path is covered
+by ``tools/chaos_smoke.py --scenario hot_swap`` and the bench fleet
+phase (tests/test_bench_smoke.py).
+"""
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference, nn
+from paddle_tpu.inference import executor_cache as ec
+from paddle_tpu.inference import fleet as fleet_mod
+from paddle_tpu.inference.serving import InferenceServer, ServingConfig
+from paddle_tpu.jit import InputSpec
+from paddle_tpu.telemetry.metrics import Registry
+from paddle_tpu.telemetry.slo import SloMonitor, SloRule
+
+
+def _breach(reg, shed=10, total=20):
+    reg.counter("serving_requests_total").inc(total)
+    reg.counter("serving_requests_shed_total").inc(shed)
+
+
+# -- SLO action registry ------------------------------------------------------
+
+class TestSloActions:
+    def _rule(self):
+        return SloRule("shed_burn",
+                       numerator="serving_requests_shed_total",
+                       denominator="serving_requests_total",
+                       threshold=0.3, window_s=5.0, min_denominator=10.0)
+
+    def test_registered_actions_replace_default_dump(self, monkeypatch):
+        dumps = []
+        from paddle_tpu.telemetry import flight
+        monkeypatch.setattr(flight, "dump",
+                            lambda *a, **kw: dumps.append(a))
+        reg = Registry()
+        rule = self._rule()
+        hits = []
+        assert rule.on_alert(lambda r, burn: hits.append((r.name, burn))) \
+            is not None  # decorator-friendly: returns the fn
+        mon = SloMonitor([rule], registry=reg)
+        mon.poll(now=0.0)
+        _breach(reg)
+        mon.poll(now=1.0)
+        assert hits == [("shed_burn", pytest.approx(0.5))]
+        assert dumps == []          # custom action replaced the dump
+        assert rule.alerts == 1
+
+    def test_default_alert_can_be_kept_alongside(self, monkeypatch):
+        from paddle_tpu.telemetry import flight, slo
+        dumps = []
+        monkeypatch.setattr(flight, "dump",
+                            lambda *a, **kw: dumps.append(a))
+        reg = Registry()
+        rule = self._rule()
+        hits = []
+        rule.on_alert(lambda r, b: hits.append(b))
+        rule.on_alert(slo.default_alert)
+        mon = SloMonitor([rule], registry=reg)
+        mon.poll(now=0.0)
+        _breach(reg)
+        mon.poll(now=1.0)
+        assert len(hits) == 1 and len(dumps) == 1
+
+    def test_latch_unlatch_drives_alert_and_clear_once(self):
+        reg = Registry()
+        rule = self._rule()
+        alerts, clears = [], []
+        rule.on_alert(lambda r, b: alerts.append(b))
+        rule.on_clear(lambda r, b: clears.append(b))
+        mon = SloMonitor([rule], registry=reg)
+        mon.poll(now=0.0)
+        _breach(reg)
+        mon.poll(now=1.0)
+        assert len(alerts) == 1 and rule.latched
+        # sustained breach: latched, no re-fire, no clear
+        _breach(reg)
+        mon.poll(now=2.0)
+        assert len(alerts) == 1 and clears == []
+        # recovery: burn collapses below threshold/2 -> ONE clear action
+        reg.counter("serving_requests_total").inc(300)
+        mon.poll(now=6.5)
+        assert not rule.latched
+        assert len(clears) == 1 and rule.clears == 1
+        mon.poll(now=6.6)
+        assert len(clears) == 1     # clearing is edge-triggered too
+        # re-breach: a fresh episode re-fires the alert actions
+        _breach(reg, shed=15, total=20)
+        mon.poll(now=7.5)
+        assert len(alerts) == 2 and rule.alerts == 2
+
+    def test_raising_action_never_breaks_the_poll(self):
+        reg = Registry()
+        rule = self._rule()
+        hits = []
+
+        def bad_action(r, b):
+            raise RuntimeError("action exploded")
+
+        rule.on_alert(bad_action)
+        rule.on_alert(lambda r, b: hits.append(b))
+        mon = SloMonitor([rule], registry=reg)
+        mon.poll(now=0.0)
+        _breach(reg)
+        mon.poll(now=1.0)           # must not raise
+        assert len(hits) == 1       # later actions still ran
+
+
+# -- executor cache -----------------------------------------------------------
+
+class TestExecutorCache:
+    def test_roundtrip(self, tmp_path):
+        path = str(tmp_path / "cache.json")
+        c = ec.ExecutorCache(path=path)
+        sig = (((32,), "<f4"),)
+        assert c.record("k", sig, 4) is True
+        assert c.record("k", sig, 4) is False   # dedup
+        c.save()
+        c2 = ec.ExecutorCache.load(path)
+        assert c2.shapes("k") == [(sig, 4)]
+        assert len(c2) == 1
+
+    def test_corrupt_file_degrades_to_empty(self, tmp_path):
+        path = str(tmp_path / "cache.json")
+        with open(path, "w") as f:
+            f.write("{not json")
+        with pytest.warns(UserWarning, match="unreadable"):
+            c = ec.ExecutorCache.load(path)
+        assert len(c) == 0
+        # unparseable rows are skipped, parseable ones survive
+        with open(path, "w") as f:
+            json.dump({"version": 1, "entries":
+                       {"k": [["(((32,), '<f4'),)", 2],
+                              ["garbage(", 4]]}}, f)
+        assert ec.ExecutorCache.load(path).shapes("k") == \
+            [((((32,), "<f4"),), 2)]
+
+    def test_attach_records_and_prime_closes_recompiles(self, tmp_path):
+        path = str(tmp_path / "cache.json")
+        cache = ec.ExecutorCache(path=path)
+        calls = []
+
+        def fn(arrays):
+            calls.append(np.asarray(arrays[0]).shape)
+            return [np.asarray(arrays[0]) * 2.0]
+
+        # first server: attach observes its first-seen shapes
+        s1 = InferenceServer([fn], config=ServingConfig(max_batch=4))
+        ec.attach(s1, "art", cache)
+        with s1:
+            s1.submit([np.ones((1, 8), np.float32)],
+                      deadline_s=5.0).result(timeout=10)
+        assert s1.stats()["recompiles"] == 1
+        assert cache.shapes("art"), "observer must have recorded"
+        assert os.path.exists(path), "autosave on record"
+
+        # second server: primed from the manifest BEFORE traffic
+        s2 = InferenceServer([fn], config=ServingConfig(max_batch=4))
+        n_calls = len(calls)
+        assert ec.prime(s2, "art", cache) == len(cache.shapes("art"))
+        assert len(calls) > n_calls     # compiles paid off-path
+        with s2:
+            s2.submit([np.ones((1, 8), np.float32)],
+                      deadline_s=5.0).result(timeout=10)
+        assert s2.stats()["recompiles"] == 0, "warm_start must close it"
+
+    def test_prime_skips_broken_entries(self, tmp_path):
+        cache = ec.ExecutorCache(path=str(tmp_path / "c.json"))
+        cache.record("art", (((8,), "<f4"),), 1)
+        cache.record("art", (((8,), "not-a-dtype"),), 1)
+
+        def fn(arrays):
+            return [np.asarray(arrays[0])]
+
+        server = InferenceServer([fn], config=ServingConfig(max_batch=4))
+        with pytest.warns(UserWarning, match="prime skipped"):
+            n = ec.prime(server, "art", cache)
+        assert n == 1
+
+
+# -- layer-cache generation pinning -------------------------------------------
+
+@pytest.fixture()
+def saved_model(tmp_path):
+    paddle.seed(7)
+    net = nn.Linear(8, 4)
+    net.eval()
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([None, 8], "float32")])
+    return prefix
+
+
+def _overwrite_params(prefix, factor=100.0):
+    import pickle
+    with open(prefix + ".pdiparams", "rb") as f:
+        blob = pickle.load(f)
+    blob["params"] = {k: np.asarray(v) * factor
+                     for k, v in blob["params"].items()}
+    with open(prefix + ".pdiparams", "wb") as f:
+        pickle.dump(blob, f)
+
+
+class TestLayerPinning:
+    def test_pinned_layer_survives_artifact_overwrite(self, saved_model):
+        prefix = saved_model
+        inference.clear_layer_cache()
+        try:
+            key = inference.layer_cache_key(prefix)
+            pred = inference.Predictor(inference.Config(prefix),
+                                       layer_key=key)
+            x = np.ones((1, 8), np.float32)
+            before = np.asarray(pred.run([x])[0])
+            inference.pin_layer(key)
+            _overwrite_params(prefix)
+            # eviction must skip the pinned generation
+            assert inference.evict_stale_layers() == 0
+            # a REBUILD at the pinned key (rollback, scale-up) serves the
+            # incumbent weights, not the poisoned bytes now on disk
+            pred2 = inference.Predictor(inference.Config(prefix),
+                                        layer_key=key)
+            np.testing.assert_allclose(np.asarray(pred2.run([x])[0]),
+                                       before)
+            # released: the stale entry is evictable and a fresh load
+            # picks up the new artifact
+            inference.unpin_layer(key)
+            assert inference.evict_stale_layers() == 1
+            pred3 = inference.Predictor(inference.Config(prefix))
+            after = np.asarray(pred3.run([x])[0])
+            assert not np.allclose(after, before)
+        finally:
+            inference.clear_layer_cache()
+
+    def test_pinned_key_with_lost_entry_fails_loudly(self, saved_model):
+        prefix = saved_model
+        inference.clear_layer_cache()
+        try:
+            key = inference.layer_cache_key(prefix)
+            _overwrite_params(prefix)   # on-disk no longer matches key
+            with pytest.raises(KeyError, match="pinned layer generation"):
+                inference._load_layer(prefix, key=key)
+        finally:
+            inference.clear_layer_cache()
+
+    def test_pin_refcounting(self, saved_model):
+        prefix = saved_model
+        inference.clear_layer_cache()
+        try:
+            key = inference.layer_cache_key(prefix)
+            inference.Predictor(inference.Config(prefix), layer_key=key)
+            inference.pin_layer(key)
+            inference.pin_layer(key)
+            _overwrite_params(prefix)
+            inference.unpin_layer(key)
+            assert inference.evict_stale_layers() == 0   # still pinned
+            inference.unpin_layer(key)
+            assert inference.evict_stale_layers() == 1
+        finally:
+            inference.clear_layer_cache()
+
+
+# -- ServingFleet -------------------------------------------------------------
+
+def _np_gen(gen_id, scale=2.0, delay=0.0):
+    """A ModelGeneration over a plain-numpy executor: scale == nan makes
+    a generation the default sanity gate must reject."""
+
+    def fn(arrays):
+        if delay:
+            time.sleep(delay)
+        return [np.asarray(arrays[0]) * scale]
+
+    def make_server():
+        return InferenceServer([fn], config=ServingConfig(max_batch=4))
+
+    return fleet_mod.ModelGeneration(gen_id, make_server)
+
+
+def _pumped(fleet, stop, interval=0.005):
+    def pump():
+        while not stop.is_set():
+            try:
+                fleet.submit([np.ones((1, 4), np.float32)],
+                             deadline_s=5.0)
+            except RuntimeError:
+                pass
+            time.sleep(interval)
+
+    th = threading.Thread(target=pump, daemon=True)
+    th.start()
+    return th
+
+
+class TestServingFleet:
+    def test_bootstrap_membership_and_shutdown(self, tmp_path):
+        cfg = fleet_mod.FleetConfig(min_members=2, max_members=4)
+        fleet = fleet_mod.ServingFleet(_np_gen(0), config=cfg,
+                                       membership_root=str(tmp_path),
+                                       fleet_id="t")
+        fleet.start()
+        assert fleet.stats()["members"] == 2
+        assert len(fleet.live_members()) == 2
+        mdir = os.path.join(str(tmp_path), "members", "t")
+        assert len([f for f in os.listdir(mdir)
+                    if f.endswith(".json")]) == 2
+        out = fleet.submit([np.ones((1, 4), np.float32)],
+                           deadline_s=5.0).result(timeout=10)
+        np.testing.assert_allclose(np.asarray(out[0]), 2.0)
+        fleet.shutdown(drain=True)
+        assert fleet.accounted()
+        assert [f for f in os.listdir(mdir) if f.endswith(".json")] == []
+        # post-shutdown admission sheds as "draining" — never silently lost
+        fleet.submit([np.ones((1, 4), np.float32)], deadline_s=5.0)
+        assert fleet.stats()["shed_causes"].get("draining", 0) >= 1
+        assert fleet.accounted()
+
+    def test_stale_member_files_reaped(self, tmp_path):
+        cfg = fleet_mod.FleetConfig(min_members=1,
+                                    member_stale_after_s=0.05)
+        fleet = fleet_mod.ServingFleet(_np_gen(0), config=cfg,
+                                       membership_root=str(tmp_path),
+                                       fleet_id="t")
+        fleet.start()
+        mdir = os.path.join(str(tmp_path), "members", "t")
+        with open(os.path.join(mdir, "dead-host-m9.json"), "w") as f:
+            json.dump({"host": "dead-host", "member": "m9", "t": 0}, f)
+        old = time.time() - 60
+        os.utime(os.path.join(mdir, "dead-host-m9.json"), (old, old))
+        assert fleet.reap_stale_members() == 1
+        assert {m["member"] for m in fleet.live_members()} == {"m0"} or \
+            len(fleet.live_members()) == 1
+        fleet.shutdown(drain=True)
+
+    def test_autoscale_up_on_load_and_down_when_idle(self):
+        cfg = fleet_mod.FleetConfig(
+            min_members=1, max_members=2, cooldown_s=0.0,
+            scale_up_wait_s=0.01, scale_up_queue_depth=2,
+            scale_down_idle_s=5.0)
+        fleet = fleet_mod.ServingFleet(_np_gen(0, delay=0.05), config=cfg)
+        fleet.start()
+        reqs = [fleet.submit([np.ones((1, 4), np.float32)],
+                             deadline_s=30.0) for _ in range(12)]
+        fleet.poll_once()
+        st = fleet.stats()
+        assert st["members"] == 2 and st["scale_ups"] == 1
+        for r in reqs:
+            r.result(timeout=30)
+        # drain the queues, then present an idle fleet far in the future
+        t = time.monotonic() + 100.0
+        fleet.poll_once(now=t)              # idle episode starts
+        fleet.poll_once(now=t + 6.0)        # > scale_down_idle_s later
+        st = fleet.stats()
+        assert st["members"] == 1 and st["scale_downs"] == 1
+        fleet.shutdown(drain=True)
+        assert fleet.accounted()
+
+    def test_slo_action_scales_up(self):
+        cfg = fleet_mod.FleetConfig(min_members=1, max_members=2,
+                                    scale_up_wait_s=1e9,
+                                    scale_up_queue_depth=10**9)
+        fleet = fleet_mod.ServingFleet(_np_gen(0), config=cfg)
+        fleet.start()
+        reg = Registry()
+        rule = SloRule("shed_burn",
+                       numerator="serving_requests_shed_total",
+                       denominator="serving_requests_total",
+                       threshold=0.3, window_s=5.0, min_denominator=10.0)
+        rule.on_alert(fleet.scale_up_action())
+        mon = SloMonitor([rule], registry=reg)
+        mon.poll(now=0.0)
+        _breach(reg)
+        mon.poll(now=1.0)
+        st = fleet.stats()
+        assert st["members"] == 2 and st["scale_ups"] == 1
+        # at max_members the action is a safe no-op
+        _breach(reg, shed=15, total=20)
+        reg.counter("serving_requests_total").inc(300)
+        mon.poll(now=6.5)                   # unlatch
+        _breach(reg, shed=15, total=20)
+        mon.poll(now=7.5)                   # re-alert at max size
+        assert fleet.stats()["members"] == 2
+        fleet.shutdown(drain=True)
+
+    def test_hot_swap_bad_canary_rolls_back(self):
+        cfg = fleet_mod.FleetConfig(
+            min_members=2, max_members=4, canary_shadow_fraction=1.0,
+            canary_min_shadow=3, canary_timeout_s=10.0)
+        fleet = fleet_mod.ServingFleet(_np_gen(0), config=cfg)
+        fleet.start()
+        stop = threading.Event()
+        th = _pumped(fleet, stop)
+        try:
+            assert fleet.hot_swap(_np_gen(1, scale=np.nan)) is False
+        finally:
+            stop.set()
+            th.join(timeout=5)
+        st = fleet.stats()
+        assert st["rolled_back"] == 1 and st["promoted"] == 0
+        assert st["generation"] == 0
+        assert fleet.last_canary_checks["sanity"] is False
+        # live traffic still healthy on the incumbent generation
+        out = fleet.submit([np.ones((1, 4), np.float32)],
+                           deadline_s=5.0).result(timeout=10)
+        assert np.isfinite(np.asarray(out[0])).all()
+        fleet.shutdown(drain=True)
+        assert fleet.accounted()        # shadows included
+
+    def test_hot_swap_good_canary_promotes_all_members(self):
+        cfg = fleet_mod.FleetConfig(
+            min_members=3, max_members=4, canary_shadow_fraction=1.0,
+            canary_min_shadow=3, canary_timeout_s=10.0)
+        fleet = fleet_mod.ServingFleet(_np_gen(0), config=cfg)
+        fleet.start()
+        stop = threading.Event()
+        th = _pumped(fleet, stop)
+        try:
+            assert fleet.hot_swap(_np_gen(1, scale=3.0)) is True
+        finally:
+            stop.set()
+            th.join(timeout=5)
+        st = fleet.stats()
+        assert st["promoted"] == 1 and st["generation"] == 1
+        assert st["members"] == 3           # capacity preserved
+        assert set(st["member_generations"]) == {1}
+        out = fleet.submit([np.ones((1, 4), np.float32)],
+                           deadline_s=5.0).result(timeout=10)
+        np.testing.assert_allclose(np.asarray(out[0]), 3.0)
+        fleet.shutdown(drain=True)
+        assert fleet.accounted()
+
+    def test_hot_swap_poller_publishes_and_remembers_rejections(self):
+        published = []
+
+        def watch():
+            return 1
+
+        def publish(step):
+            published.append(step)
+            return _np_gen(step, scale=np.nan)
+
+        cfg = fleet_mod.FleetConfig(
+            min_members=1, canary_shadow_fraction=1.0,
+            canary_min_shadow=2, canary_timeout_s=10.0)
+        fleet = fleet_mod.ServingFleet(_np_gen(0), config=cfg,
+                                       watch_fn=watch, publish_fn=publish)
+        fleet.start()
+        stop = threading.Event()
+        th = _pumped(fleet, stop)
+        try:
+            fleet.poll_once()
+            assert published == [1]
+            assert fleet.stats()["rolled_back"] == 1
+            fleet.poll_once()       # rejected step is not retried
+            assert published == [1]
+        finally:
+            stop.set()
+            th.join(timeout=5)
+        fleet.shutdown(drain=True)
+
+    def test_hot_swap_poller_publish_failure_counts_as_rollback(self):
+        def publish(step):
+            raise OSError("artifact unreadable")
+
+        fleet = fleet_mod.ServingFleet(
+            _np_gen(0), config=fleet_mod.FleetConfig(min_members=1),
+            watch_fn=lambda: 5, publish_fn=publish)
+        fleet.start()
+        fleet.poll_once()
+        st = fleet.stats()
+        assert st["rolled_back"] == 1 and st["generation"] == 0
+        fleet.poll_once()           # remembered, not retried
+        assert fleet.stats()["rolled_back"] == 1
+        fleet.shutdown(drain=True)
+
+    def test_sigterm_drains_every_member_exactly_once(self):
+        """Satellite 4: SIGTERM -> one graceful fleet-wide drain; every
+        member server drained exactly once even when SIGTERM repeats or
+        shutdown is called again, with fleet-wide closed accounting —
+        and the previous SIGTERM handler still chains."""
+        chained = []
+        prev = signal.getsignal(signal.SIGTERM)
+        signal.signal(signal.SIGTERM,
+                      lambda signum, frame: chained.append(signum))
+        fleet = fleet_mod.ServingFleet(
+            _np_gen(0), config=fleet_mod.FleetConfig(min_members=2))
+        try:
+            fleet.start()
+            drains = {}
+            with fleet._lock:
+                members = list(fleet._members)
+            for m in members:
+                real = m.server.shutdown
+
+                def counting(drain=True, timeout=30.0, _real=real,
+                             _name=m.name):
+                    drains[_name] = drains.get(_name, 0) + 1
+                    return _real(drain=drain, timeout=timeout)
+
+                m.server.shutdown = counting
+            reqs = [fleet.submit([np.ones((1, 4), np.float32)],
+                                 deadline_s=10.0) for _ in range(6)]
+            fleet.install_sigterm_drain()
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.monotonic() + 10.0
+            while not fleet._stopped and time.monotonic() < deadline:
+                time.sleep(0.01)
+            os.kill(os.getpid(), signal.SIGTERM)    # repeat SIGTERM
+            time.sleep(0.1)
+            fleet.shutdown(drain=True)              # and a manual call
+            assert drains == {m.name: 1 for m in members}
+            assert fleet._shutdowns == 1
+            # graceful: in-flight work completed, nothing silently lost
+            for r in reqs:
+                assert r.done()
+            assert fleet.accounted()
+            assert len(chained) >= 2                # previous handler ran
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    def test_context_manager_and_double_shutdown(self):
+        with fleet_mod.ServingFleet(
+                _np_gen(0),
+                config=fleet_mod.FleetConfig(min_members=1)) as fleet:
+            fleet.submit([np.ones((1, 4), np.float32)],
+                         deadline_s=5.0).result(timeout=10)
+        fleet.shutdown(drain=True)      # idempotent
+        assert fleet._shutdowns == 1
+        assert fleet.accounted()

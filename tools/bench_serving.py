@@ -28,6 +28,14 @@ printed:
   shape compiled up front — serving traffic triggers zero fresh
   compiles no matter how decode steps coalesce. Reports decode
   tokens/sec goodput under the deadline contract.
+- **fleet** — a 2-member ``ServingFleet`` over the same artifact with
+  the persistent compiled-executor warm set pre-seeded: a 1.8x burst
+  must autoscale the fleet up, a live hot-swap under traffic must
+  canary-promote a republished model generation with zero lost
+  requests, and no member ever — bootstrap, autoscaled, canary, or
+  rolled — pays a compile cold start (fleet-wide
+  ``serving_recompiles_total == 0``). Reports the hot-swap rollout
+  wall time.
 - **spec-decode** — the same paged-KV stack behind
   ``SpeculativeDecodeServer``: an n-gram drafter proposes K = 4 tokens
   per decode step, verify rides the batcher as a 1 + K-token chunk, and
@@ -482,6 +490,123 @@ def run_decode_bench(smoke: bool, seed: int) -> dict:
     }
 
 
+def run_fleet_bench(smoke: bool, seed: int) -> dict:
+    """Fleet phase: a 2-member ``ServingFleet`` over the same artifact,
+    with the persistent compiled-executor warm set pre-seeded so every
+    member — bootstrap and autoscaled alike — pays zero compile cold
+    starts. A 1.8x burst must autoscale the fleet up (modeled wait /
+    queue depth, via the background control thread), and a live
+    hot-swap under traffic must promote a new model generation through
+    the canary with zero lost requests; the rollout wall time is the
+    reported metric."""
+    import threading
+
+    from paddle_tpu.inference import executor_cache as ec
+    from paddle_tpu.inference import fleet as fleet_mod
+    from paddle_tpu.inference import serving
+
+    pad_s, max_batch, deadline_s = 0.02, 4, 0.4
+    duration = 1.5 if smoke else 4.0
+    capacity = 2 * max_batch / pad_s          # bootstrap nominal rows/s
+
+    tmp = tempfile.mkdtemp(prefix="bench_fleet_")
+    prefix = build_model(tmp)
+    cache = ec.ExecutorCache(path=os.path.join(tmp, "exec_cache.json"))
+    sig = (((IN_DIM,), "<f4"),)
+    for bucket in (1, 2, 4):
+        cache.record(ec.artifact_key(prefix, None), sig, bucket)
+
+    def pad_wrap(fn):
+        def wrapped(arrays):
+            out = fn(arrays)
+            time.sleep(pad_s)
+            return out
+        return wrapped
+
+    scfg = serving.ServingConfig(
+        max_queue=256, max_batch=max_batch, batch_wait_s=0.004,
+        call_timeout_s=0.5, admission_safety=1.3, seed=seed)
+
+    def make_gen(gen_id):
+        return fleet_mod.predictor_generation(
+            gen_id, prefix, serving=scfg, executor_cache=cache,
+            executor_wrap=pad_wrap)
+
+    cfg = fleet_mod.FleetConfig(
+        min_members=2, max_members=3, cooldown_s=0.0,
+        scale_up_wait_s=0.2, scale_up_queue_depth=16,
+        scale_down_idle_s=1e9, canary_shadow_fraction=0.5,
+        canary_min_shadow=4, canary_timeout_s=20.0, seed=seed)
+    fleet = fleet_mod.ServingFleet(make_gen(0), config=cfg,
+                                   fleet_id="bench")
+    fleet.start(control=True)
+    rng = np.random.RandomState(seed + 7)
+
+    baseline = run_phase(fleet, 0.5 * capacity, duration, deadline_s, rng)
+    burst = run_phase(fleet, 1.8 * capacity, duration, deadline_s, rng)
+    members_after_burst = fleet.stats()["members"]
+
+    # live hot-swap under light traffic (the canary's shadow source):
+    # republish the artifact with scaled weights as generation 1
+    import pickle
+    with open(prefix + ".pdiparams", "rb") as fh:
+        blob = pickle.load(fh)
+    blob["params"] = {k: np.asarray(v) * 1.05
+                      for k, v in blob["params"].items()}
+    with open(prefix + ".pdiparams.tmp", "wb") as fh:
+        pickle.dump(blob, fh)
+    os.replace(prefix + ".pdiparams.tmp", prefix + ".pdiparams")
+
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            try:
+                fleet.submit([rng.rand(1, IN_DIM).astype("float32")],
+                             deadline_s=5.0)
+            except RuntimeError:
+                pass
+            time.sleep(0.02)
+
+    th = threading.Thread(target=pump, daemon=True)
+    th.start()
+    t_swap = time.monotonic()
+    promoted = fleet.hot_swap(make_gen(1))
+    swap_s = time.monotonic() - t_swap
+    member_gens = list(fleet.stats()["member_generations"])
+    stop.set()
+    th.join(timeout=5.0)
+
+    fleet.shutdown(drain=True)
+    accounted = fleet.accounted()     # post-drain: everything terminal
+    st = fleet.stats()
+    checks = {
+        "fleet_goodput_positive": baseline["goodput_rps"] > 0,
+        "fleet_scaled_up": st["scale_ups"] >= 1
+        and members_after_burst >= 3,
+        "fleet_hot_swap_promoted": bool(promoted)
+        and set(member_gens) == {1},
+        "fleet_zero_lost": accounted and st["failed"] == 0,
+        "fleet_cold_starts_closed": st["recompiles"] == 0,
+    }
+    return {
+        "hot_swap_rollout_s": round(swap_s, 3),
+        "baseline": baseline,
+        "burst": burst,
+        "members_after_burst": members_after_burst,
+        "scale_ups": st["scale_ups"],
+        "promoted": st["promoted"],
+        "member_generations_after_swap": member_gens,
+        "servers_ever": st["servers_ever"],
+        "submitted": st["submitted"],
+        "completed": st["completed"],
+        "shed": st["shed"],
+        "recompiles": st["recompiles"],
+        "accounted": accounted,
+        "checks": checks,
+    }
+
+
 def run_bench(smoke: bool, seed: int = 0) -> dict:
     from paddle_tpu import inference, telemetry
     from paddle_tpu.inference import serving
@@ -565,6 +690,8 @@ def run_bench(smoke: bool, seed: int = 0) -> dict:
     decode_checks = decode.pop("checks")
     spec = run_spec_decode_bench(smoke, seed)
     spec_checks = spec.pop("checks")
+    fleet = run_fleet_bench(smoke, seed)
+    fleet_checks = fleet.pop("checks")
 
     shed_total = (overload["shed"] + overload["expired"])
     goodput_band_ok = (
@@ -595,6 +722,7 @@ def run_bench(smoke: bool, seed: int = 0) -> dict:
     }
     checks.update(decode_checks)
     checks.update(spec_checks)
+    checks.update(fleet_checks)
     from paddle_tpu.telemetry import calibration
     return {
         "schema_version": 2,
@@ -624,6 +752,7 @@ def run_bench(smoke: bool, seed: int = 0) -> dict:
             "accounted": accounted,
             "decode": decode,
             "spec_decode": spec,
+            "fleet": fleet,
             "kv_cache_hit_rate": decode["kv_cache_hit_rate"],
             "stats": stats,
             "tracing": {

@@ -57,9 +57,28 @@ appears on disk — and a later clean verdict re-enables saves::
      "restored_step_staged": 3, "restored_step_mid_commit": 3,
      "restore_fallbacks": 0, "dirty_suppressed": 1, ...}
 
+``--scenario hot_swap`` is the ISSUE 19 serving-fleet acceptance: a
+2-member ``ServingFleet`` under Poisson overload sheds past the SLO
+burn-rate threshold, whose rule now carries a registered scale-up
+action (plus the default flight dump) — the fleet must scale up. A
+NaN-poisoned checkpoint is then committed (CRC-valid — only serving it
+reveals the damage): the hot-swap poller publishes it, the canary's
+shadow traffic fails the output-sanity gate, and the rollout is rolled
+back with the incumbent generation's pinned layer cache still serving
+finite outputs — even for members scaled up AFTER the bad artifact
+overwrote the files. A good checkpoint then promotes through the
+rolling drain path. Fleet-wide ``accounted()`` must hold across the
+whole episode (shadow copies included) and scale-up must pay zero
+compiled-executor cold starts (persistent executor cache)::
+
+    {"scenario": "hot_swap", "scale_ups": 1, "canary_rolled_back": 1,
+     "canary_promoted": 1, "requests_lost": 0, "cold_starts_closed": true,
+     ...}
+
 Run: ``python tools/chaos_smoke.py [--steps 10] [--ckpt-dir DIR]``
 (also wired as a ``-m 'not slow'`` pytest in tests/test_resilience.py;
-the host_loss/sdc/host_hang scenarios in tests/test_bench_smoke.py).
+the host_loss/sdc/host_hang/hot_swap scenarios in
+tests/test_bench_smoke.py).
 """
 from __future__ import annotations
 
@@ -439,6 +458,219 @@ def run_crash_during_async_save(steps: int, root: str):
     return out
 
 
+def run_hot_swap(root: str):
+    """ISSUE 19 serving-fleet acceptance (see module docstring): SLO
+    burn-rate scale-up, canary rollback of a poisoned checkpoint, then
+    promotion of a good one — zero lost requests, zero compile cold
+    starts."""
+    import pickle
+    import threading
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, telemetry
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+    from paddle_tpu.inference import executor_cache as ec
+    from paddle_tpu.inference import fleet as fleet_mod
+    from paddle_tpu.inference.serving import ServingConfig
+    from paddle_tpu.jit import InputSpec
+    from paddle_tpu.telemetry import slo
+
+    IN_DIM, PAD_S, QUANT = 8, 0.02, ("int8", None)
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(IN_DIM, 16)
+            self.fc2 = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.fc2(nn.functional.relu(self.fc1(x)))
+
+    telemetry.enable()
+    slo.reset()
+    paddle.seed(0)
+    net = MLP()
+    net.eval()
+    prefix = os.path.join(root, "model")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([None, IN_DIM], "float32")])
+    with open(prefix + ".pdiparams", "rb") as fh:
+        good_params = {k: np.asarray(v)
+                       for k, v in pickle.load(fh)["params"].items()}
+
+    # The persistent compiled-executor warm set: pre-seed every row
+    # bucket a 1-row workload under max_batch=4 can produce, so every
+    # server this scenario ever builds (bootstrap, SLO scale-up, canary,
+    # rollout) primes its compiles BEFORE taking traffic. The acceptance
+    # assert is fleet-wide recompiles == 0 — zero cold starts, ever.
+    cache = ec.ExecutorCache(path=os.path.join(root, "exec_cache.json"))
+    sig = (((IN_DIM,), "<f4"),)
+    for bucket in (1, 2, 4):
+        cache.record(ec.artifact_key(prefix, QUANT), sig, bucket)
+
+    def pad_wrap(fn):            # fixed service pad: machine-independent
+        def wrapped(arrays):     # capacity, so overload is deterministic
+            time.sleep(PAD_S)
+            return fn(arrays)
+        return wrapped
+
+    scfg = ServingConfig(max_batch=4, max_queue=64)
+
+    def make_gen(gen_id):
+        return fleet_mod.predictor_generation(
+            gen_id, prefix, quant=QUANT, serving=scfg,
+            executor_cache=cache, executor_wrap=pad_wrap)
+
+    manager = CheckpointManager(os.path.join(root, "ckpt"), max_to_keep=3,
+                                use_async=False)
+
+    def publish(step):
+        state = manager.restore(step)
+        with open(prefix + ".pdiparams", "rb") as fh:
+            blob = pickle.load(fh)
+        blob["params"] = {k: np.asarray(state[k]) for k in blob["params"]}
+        tmp = prefix + ".pdiparams.tmp"
+        with open(tmp, "wb") as fh:
+            pickle.dump(blob, fh)
+        os.replace(tmp, prefix + ".pdiparams")
+        return make_gen(step)
+
+    # Autoscaler thresholds parked at infinity: the ONLY scale-up path
+    # left is the SLO rule's registered action — clean attribution.
+    cfg = fleet_mod.FleetConfig(
+        min_members=2, max_members=4, cooldown_s=0.0,
+        scale_up_wait_s=1e9, scale_up_queue_depth=10**9,
+        scale_down_idle_s=1e9, canary_shadow_fraction=0.6,
+        canary_min_shadow=6, canary_timeout_s=20.0)
+    fleet = fleet_mod.ServingFleet(
+        make_gen(0), config=cfg,
+        membership_root=os.path.join(root, "coord"), fleet_id="chaos",
+        watch_fn=manager.latest_valid_step, publish_fn=publish)
+    fleet.start()
+
+    slo_hits = []
+    mon = slo.install_shed_rule(threshold=0.2, window_s=2.0,
+                                min_denominator=10.0)
+    rule = mon.rules[0]
+    rule.on_alert(lambda r, burn: slo_hits.append(burn))
+    rule.on_alert(fleet.scale_up_action())
+
+    # warmup: establish the service-rate EWMA the admission model needs
+    for r in [fleet.submit([np.random.rand(1, IN_DIM).astype(np.float32)],
+                           deadline_s=10.0) for _ in range(12)]:
+        r.result(timeout=30.0)
+
+    stop = threading.Event()
+
+    def pump(interval_s, deadline_s):
+        while not stop.is_set():
+            try:
+                fleet.submit(
+                    [np.random.rand(1, IN_DIM).astype(np.float32)],
+                    deadline_s=deadline_s)
+            except RuntimeError:
+                pass
+            time.sleep(interval_s)
+
+    # --- phase 1: overload past the SLO burn threshold ------------------
+    # 2 members x (4 rows / 0.02 s) = 400 rows/s capacity; 500 rps of
+    # 80 ms-deadline traffic must shed, the shed burn must breach the
+    # rule, and the rule's action must scale the fleet up.
+    stop.clear()
+    th = threading.Thread(target=pump, args=(0.002, 0.08), daemon=True)
+    th.start()
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 8.0 and not slo_hits:
+        time.sleep(0.05)
+    stop.set()
+    th.join(timeout=5.0)
+    st = fleet.stats()
+    members_after_burst = st["members"]
+    scale_ups_from_slo = st["scale_ups"]
+    # let the backlog fully drain before the canary phases
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 10.0 and \
+            fleet.stats()["queue_depth"] > 0:
+        time.sleep(0.05)
+
+    # --- phase 2: poisoned checkpoint must canary-fail and roll back ----
+    # Exponent corruption (every weight 3e38): CRC-committed fine — only
+    # SERVING it reveals the damage, as non-finite outputs the canary's
+    # sanity gate catches.
+    manager.save(1, {k: np.full_like(v, 3.0e38)
+                     for k, v in good_params.items()})
+    manager.flush()
+    stop.clear()
+    th = threading.Thread(target=pump, args=(0.03, 5.0), daemon=True)
+    th.start()
+    fleet.poll_once()            # watch -> publish -> canary -> verdict
+    bad_checks = dict(fleet.last_canary_checks or {})
+    rolled_back = fleet.stats()["rolled_back"]
+    gen_after_bad = fleet.stats()["generation"]
+    # the incumbent generation must still serve finite outputs — its
+    # pinned layer-cache entry, not the poisoned bytes now on disk
+    out0 = fleet.submit([np.ones((1, IN_DIM), np.float32)],
+                        deadline_s=10.0).result(timeout=30.0)
+    incumbent_finite = bool(np.isfinite(np.asarray(out0[0])).all())
+
+    # --- phase 3: good checkpoint must promote fleet-wide ---------------
+    manager.save(2, {k: v * 1.05 for k, v in good_params.items()})
+    manager.flush()
+    fleet.poll_once()
+    good_checks = dict(fleet.last_canary_checks or {})
+    member_gens = list(fleet.stats()["member_generations"])
+    stop.set()
+    th.join(timeout=5.0)
+    manager.close()
+
+    fleet.shutdown(drain=True)
+    st = fleet.stats()
+    lost = st["submitted"] - (st["completed"] + st["shed"]
+                              + st["expired"] + st["failed"])
+    checks = {
+        "slo_scale_up": len(slo_hits) >= 1 and scale_ups_from_slo >= 1,
+        "members_scaled": members_after_burst >= 3,
+        "shed_seen": st["shed"] >= 1,
+        "bad_rolled_back": rolled_back == 1 and gen_after_bad == 0
+        and not bad_checks.get("sanity", True),
+        "incumbent_finite_after_rollback": incumbent_finite,
+        "good_promoted": st["promoted"] == 1 and st["generation"] == 2,
+        "members_on_new_gen": set(member_gens) == {2},
+        "zero_lost": lost == 0 and st["failed"] == 0,
+        "accounted": fleet.accounted(),
+        "cold_starts_closed": st["recompiles"] == 0,
+    }
+    return {
+        "scenario": "hot_swap",
+        "members_after_burst": int(members_after_burst),
+        "slo_alerts": int(rule.alerts),
+        "scale_ups": int(st["scale_ups"]),
+        "shed": int(st["shed"]),
+        "shed_causes": {k: int(v) for k, v in st["shed_causes"].items()},
+        "canary_rolled_back": int(st["rolled_back"]),
+        "canary_promoted": int(st["promoted"]),
+        "canary_checks_bad": {k: (bool(v) if isinstance(v, (bool,))
+                                  else int(v))
+                              for k, v in bad_checks.items()},
+        "canary_checks_good": {k: (bool(v) if isinstance(v, (bool,))
+                                   else int(v))
+                               for k, v in good_checks.items()},
+        "generation_final": int(st["generation"]),
+        "servers_ever": int(st["servers_ever"]),
+        "submitted": int(st["submitted"]),
+        "completed": int(st["completed"]),
+        "requests_lost": int(lost),
+        "recompiles": int(st["recompiles"]),
+        "cold_starts_closed": bool(checks["cold_starts_closed"]),
+        "accounted": bool(checks["accounted"]),
+        "checks": {k: bool(v) for k, v in checks.items()},
+        "exit_code": 0 if all(checks.values()) else 1,
+    }
+
+
 def run_plain(steps: int, ckpt_dir: str):
     """Fault-free twin of run_chaos (same seed/data) for loss comparison."""
     from paddle_tpu.distributed.checkpoint import CheckpointManager
@@ -463,14 +695,16 @@ def main(argv=None) -> int:
                    help="fault-free reference run instead of the chaos loop")
     p.add_argument("--scenario",
                    choices=["faults", "host_loss", "sdc", "host_hang",
-                            "crash_during_async_save"],
+                            "crash_during_async_save", "hot_swap"],
                    default="faults",
                    help="faults: the in-process chaos loop (default); "
                         "host_loss: the 3-subprocess elastic scenario; "
                         "sdc: silent-corruption detect/quarantine/rollback; "
                         "host_hang: wedged host + hang watchdog; "
                         "crash_during_async_save: SIGKILL in the async "
-                        "commit windows + dirty-suppression proof")
+                        "commit windows + dirty-suppression proof; "
+                        "hot_swap: serving-fleet SLO scale-up + canary "
+                        "rollback/promotion of live model updates")
     p.add_argument("--async-crash-child", default=None,
                    choices=["staged", "mid_commit"],
                    help=argparse.SUPPRESS)  # internal: the SIGKILL victim
@@ -490,6 +724,8 @@ def main(argv=None) -> int:
         out = run_sdc(max(args.steps, 10), ckpt)
     elif args.scenario == "host_hang":
         out = run_host_hang(max(args.steps, 24), ckpt)
+    elif args.scenario == "hot_swap":
+        out = run_hot_swap(ckpt)
     elif args.plain:
         out = run_plain(args.steps, ckpt)
     else:

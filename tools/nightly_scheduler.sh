@@ -1,0 +1,89 @@
+#!/usr/bin/env bash
+# Nightly scheduler stanza (ISSUE 19 satellite; closes the ROADMAP
+# carried item "point an actual scheduler at run_slow_lane.sh &&
+# nightly_report.py").
+#
+# One entrypoint, three modes:
+#
+#   tools/nightly_scheduler.sh               # run the nightly pipeline:
+#                                            #   run_slow_lane.sh && nightly_report.py
+#   tools/nightly_scheduler.sh --dry-run     # validate the wiring without
+#                                            # running the slow lane: scripts
+#                                            # present+executable, report
+#                                            # self-check green, cron line
+#                                            # printed. ONE JSON line out.
+#   tools/nightly_scheduler.sh --install     # idempotently append the cron
+#                                            # line to the user's crontab
+#   tools/nightly_scheduler.sh --print-cron  # print the crontab line only
+#
+# The CI twin of the cron line lives in .github/workflows/nightly.yml
+# (schedule: the same 03:17 UTC slot) and calls this script with no
+# arguments, so cron and CI run the identical pipeline. `--dry-run` is
+# the CI/test hook (registered in tests/test_bench_smoke.py): it proves
+# the stanza stays runnable without paying the slow lane.
+set -u
+cd "$(dirname "$0")/.."
+REPO="$(pwd)"
+CRON_LINE="17 3 * * * cd ${REPO} && tools/nightly_scheduler.sh >> /var/log/nightly_lane.log 2>&1"
+
+mode="run"
+case "${1:-}" in
+    --dry-run)    mode="dry_run" ;;
+    --install)    mode="install" ;;
+    --print-cron) mode="print_cron" ;;
+    "")           mode="run" ;;
+    *) echo "usage: $0 [--dry-run|--install|--print-cron]" >&2; exit 2 ;;
+esac
+
+if [ "$mode" = "print_cron" ]; then
+    echo "$CRON_LINE"
+    exit 0
+fi
+
+if [ "$mode" = "install" ]; then
+    existing="$(crontab -l 2>/dev/null || true)"
+    if printf '%s\n' "$existing" | grep -Fq "tools/nightly_scheduler.sh"; then
+        echo "nightly_scheduler: cron line already installed"
+        exit 0
+    fi
+    printf '%s\n%s\n' "$existing" "$CRON_LINE" | crontab -
+    echo "nightly_scheduler: installed: $CRON_LINE"
+    exit 0
+fi
+
+if [ "$mode" = "dry_run" ]; then
+    ok=true
+    problems=()
+    for f in tools/run_slow_lane.sh tools/nightly_report.py; do
+        if [ ! -f "$f" ]; then
+            ok=false; problems+=("missing:$f")
+        elif [ "$f" = "tools/run_slow_lane.sh" ] && [ ! -x "$f" ]; then
+            ok=false; problems+=("not_executable:$f")
+        fi
+    done
+    # the report's own synthetic self-check — the whole scrape/fold/exit
+    # contract, no slow lane needed
+    if ! python tools/nightly_report.py --smoke >/dev/null 2>&1; then
+        ok=false; problems+=("report_smoke_failed")
+    fi
+    if [ ! -f .github/workflows/nightly.yml ]; then
+        ok=false; problems+=("missing:.github/workflows/nightly.yml")
+    fi
+    probs=$(printf '"%s",' "${problems[@]:-}"); probs="[${probs%,}]"
+    [ "$probs" = '[""]' ] && probs="[]"
+    printf '{"scheduler": "nightly", "mode": "dry_run", "ok": %s, "problems": %s, "cron": "%s"}\n' \
+        "$ok" "$probs" "$(printf '%s' "$CRON_LINE" | sed 's/"/\\"/g')"
+    [ "$ok" = true ] && exit 0 || exit 1
+fi
+
+# mode=run: the real nightly pipeline. The report runs even when the
+# lane fails (its rc folds the lane's health), but the stanza's exit
+# code reflects BOTH, so cron/CI alerting sees any failure.
+tools/run_slow_lane.sh
+lane_rc=$?
+python tools/nightly_report.py --require slow_lane
+report_rc=$?
+if [ "$lane_rc" -ne 0 ] || [ "$report_rc" -ne 0 ]; then
+    exit 1
+fi
+exit 0
