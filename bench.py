@@ -301,8 +301,48 @@ def bench_gpt(jax, on_tpu):
         _emit(out)
     if best is None:
         raise RuntimeError(f"all GPT-base variants failed: {sweep}")
+    # auto-parallel planner over the same shape: search the config
+    # space on the calibrated cost model, RUN the chosen config, and
+    # record planned-vs-measured step time so the drift lands in
+    # calibration_drift_ratio{key=planner_step_time}
+    try:
+        out["planner"] = _gpt_planner(jax, on_tpu, vocab, seq, cfg)
+    except Exception as e:
+        out["planner"] = {"error": f"{type(e).__name__}: {e}"}
     out.pop("partial", None)
     return out
+
+
+def _gpt_planner(jax, on_tpu, vocab, seq, cfg):
+    """plan_search at the bench GPT shape + a measured run of its pick.
+
+    Closes the planner's own calibration loop: predicted step time (the
+    search's scoring model under the calibrated constants) vs the
+    measured step time of actually running the chosen ParallelTrainer
+    config, recorded under the planner_step_time key."""
+    from paddle_tpu import telemetry
+    from tools import bench_plan
+
+    spec = dict(vocab=vocab, h=cfg["h"], layers=cfg["l"], heads=cfg["n"],
+                seq=seq, batch_per_device=8 if on_tpu else 4)
+    n = len(jax.devices()) if on_tpu else 1
+    builder = bench_plan.make_gpt_builder(
+        spec, spec["batch_per_device"] * n)
+    ranked, baselines, n_params = bench_plan.search(
+        spec, n, stage_top_k=1, builder=builder)
+    pick = ranked[0]
+    predicted = pick.predicted.total
+    trainer, ids, labels = builder(pick)
+    iters = 16 if on_tpu else 2
+    warmup = 8 if on_tpu else 1
+    dt, final_loss = _timed_steps(trainer, ids, labels, warmup, iters)
+    measured = dt / iters
+    telemetry.calibration.record("planner_step_time", predicted, measured)
+    return {"pick": pick.to_dict(), "baselines": baselines,
+            "predicted_s": predicted, "measured_s": measured,
+            "final_loss": round(final_loss, 4),
+            "calibration": telemetry.calibration.pair(
+                "planner_step_time")}
 
 
 def bench_gpt_1p3b(jax, on_tpu):

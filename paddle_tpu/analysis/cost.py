@@ -28,6 +28,7 @@ __all__ = [
     "aval_bytes", "eqn_flops", "eqn_bytes", "dot_general_flops",
     "total_flops", "matmul_flops", "peak_live_bytes", "top_equations",
     "summarize", "collective_wire_bytes", "overlap_summary",
+    "overlap_plan", "replay_overlap",
 ]
 
 
@@ -274,6 +275,225 @@ def _atomic_flops(eqn, while_trips: float) -> float:
     return tot
 
 
+def overlap_plan(jaxpr, mesh, while_trips: float = 1.0,
+                 reshard_sites=None) -> dict:
+    """Stage-once classification behind :func:`overlap_summary`: walk the
+    linearized program, classify every node as compute (FLOPs) or
+    collective (ring wire bytes + link class), attach predicted
+    implicit-resharding sites, and build the dataflow edges — everything
+    that requires the jaxpr, and nothing that requires price constants.
+
+    The returned plan dict is pure data (no jaxpr references beyond
+    labels), so a candidate staged once can be re-priced many times via
+    :func:`replay_overlap` under different calibrated constants — the
+    auto-parallel planner's two-tier search re-scores its staged top-k
+    this way instead of re-tracing per pricing change.
+
+    Plan keys: ``nodes`` (per-node dicts: ``coll``, ``link``, ``wire``,
+    ``trips``, ``axes``, ``flops``, ``primitive``, ``path``, ``index``),
+    ``consumers`` / ``indeg`` (dataflow edges), ``reshard`` (node index
+    -> list of site dicts with ``time_s``/``wire_bytes``/``trips``/
+    ``link``/``axes``/``kind``).
+    """
+    from ..distributed.mesh import axis_links
+    from .rules import collective_axes
+    links = axis_links(mesh) if mesh is not None else {}
+    nodes = list(linear_schedule(jaxpr))
+
+    entries = []
+    for node in nodes:
+        eqn = node.eqn
+        axes = ()
+        if not node.atomic and mesh is not None \
+                and node.primitive in _COLL_RING:
+            axes = tuple(ax for ax in collective_axes(eqn)
+                         if ax in node.bound_axes and ax in mesh.shape)
+        n_g = _group_size(axes, mesh) if axes else 1
+        entry = {"primitive": node.primitive,
+                 "path": "/".join(node.path) or "<top>",
+                 "index": node.index}
+        if axes and n_g > 1:
+            entry["coll"] = True
+            entry["link"] = ("dcn" if any(links.get(ax) == "dcn"
+                                          for ax in axes) else "ici")
+            entry["wire"] = collective_wire_bytes(eqn, n_g) * node.trips
+            entry["trips"] = float(node.trips)
+            entry["axes"] = list(axes)
+        else:
+            entry["coll"] = False
+            entry["flops"] = (_atomic_flops(eqn, while_trips) if node.atomic
+                              else eqn_flops(eqn)) * node.trips
+        entries.append(entry)
+
+    # Attach predicted implicit-resharding sites (analysis/sharding) to
+    # the node they fire at: innermost anchor first, falling back to the
+    # enclosing atomic control-flow equation's node.
+    pending = {}
+    if reshard_sites:
+        node_pos = {}
+        for j, node in enumerate(nodes):
+            node_pos.setdefault((node.path, node.index), j)
+        for s in reshard_sites:
+            anchors = list(getattr(s, "anchors", ()) or ())
+            anchors.reverse()
+            anchors.append((getattr(s, "path", ()),
+                            getattr(s, "eqn_index", -1)))
+            for key in anchors:
+                j = node_pos.get(tuple(key))
+                if j is not None:
+                    pending.setdefault(j, []).append({
+                        "time_s": float(getattr(s, "time_s", 0.0)),
+                        "wire_bytes": float(getattr(s, "wire_bytes", 0.0)),
+                        "trips": max(float(getattr(s, "trips", 1.0)), 1.0),
+                        "link": getattr(s, "link", "ici"),
+                        "axes": list(getattr(s, "axes", ())),
+                        "kind": getattr(s, "kind", "")})
+                    break
+
+    # Dataflow edges over canonical var ids (linear_schedule already
+    # resolved call-boundary aliases).
+    producer = {}
+    for j, node in enumerate(nodes):
+        for o in node.out_ids:
+            producer[o] = j
+    consumers = [[] for _ in nodes]
+    indeg = [0] * len(nodes)
+    for j, node in enumerate(nodes):
+        deps = {producer[i] for i in node.in_ids
+                if i in producer and producer[i] != j}
+        indeg[j] = len(deps)
+        for d in deps:
+            consumers[d].append(j)
+    return {"nodes": entries, "consumers": consumers, "indeg": indeg,
+            "reshard": pending}
+
+
+def replay_overlap(plan: dict, peak_flops=None, bandwidths=None,
+                   latencies=None, include_timeline: bool = False) -> dict:
+    """Run the two-stream list-scheduling simulation over a staged
+    :func:`overlap_plan` under a given set of price constants. With all
+    constants defaulted this reproduces :func:`overlap_summary` exactly;
+    passing ``peak_flops`` / ``bandwidths`` / ``latencies`` (dicts keyed
+    by link class) re-prices the SAME staged program under different
+    calibrated constants without re-tracing — candidate re-pricing for
+    the planner, what-if pricing for tools. Resharding sites are
+    re-priced from their wire bytes when ``bandwidths`` overrides their
+    link; otherwise their sharding-pass ``time_s`` is used as-is.
+    """
+    import heapq
+    from ..distributed.mesh import link_bandwidth, link_latency
+    if peak_flops is None:
+        from .. import telemetry as _telemetry
+        peak_flops = _telemetry.peak_flops_per_sec()
+    peak_flops = max(float(peak_flops), 1.0)
+
+    def _bw(link):
+        if bandwidths and link in bandwidths:
+            return float(bandwidths[link])
+        return link_bandwidth(link)
+
+    def _lat(link):
+        if latencies and link in latencies:
+            return float(latencies[link])
+        return link_latency(link)
+
+    entries = plan["nodes"]
+    consumers = plan["consumers"]
+    indeg = list(plan["indeg"])
+    pending = plan["reshard"]
+    node_ready = [0.0] * len(entries)
+    heap = [(0.0, j) for j in range(len(entries)) if indeg[j] == 0]
+    heapq.heapify(heap)
+    wire_free = {}                # link class -> busy-until
+    t = 0.0                       # compute-stream cursor
+    coll_total = compute_total = 0.0
+    n_coll = n_reshard = 0
+    reshard_total = 0.0
+    timeline = [] if include_timeline else None
+    while heap:
+        rt, j = heapq.heappop(heap)
+        e = entries[j]
+        # implicit resharding this node forces: charged on the wire
+        # stream, and the node itself waits for the result to land
+        for s in pending.get(j, ()):
+            r_link = s["link"]
+            if bandwidths and r_link in bandwidths:
+                r_dur = (s["wire_bytes"] / _bw(r_link)
+                         + _lat(r_link)) * s["trips"]
+            else:
+                r_dur = s["time_s"] * s["trips"]
+            r_start = max(rt, wire_free.get(r_link, 0.0))
+            r_done = r_start + r_dur
+            wire_free[r_link] = r_done
+            coll_total += r_dur
+            reshard_total += r_dur
+            n_coll += 1
+            n_reshard += 1
+            rt = max(rt, r_done)
+            if timeline is not None:
+                timeline.append({
+                    "kind": "reshard", "primitive": e["primitive"],
+                    "path": e["path"], "eqn_index": e["index"],
+                    "axes": s["axes"], "link": r_link,
+                    "bytes": s["wire_bytes"],
+                    "start": r_start, "end": r_done,
+                    "reshard_kind": s["kind"]})
+        if e["coll"]:
+            link = e["link"]
+            dur = e["wire"] / _bw(link) + _lat(link) * e["trips"]
+            start = max(rt, wire_free.get(link, 0.0))
+            done = start + dur
+            wire_free[link] = done
+            coll_total += dur
+            n_coll += 1
+            if timeline is not None:
+                timeline.append({
+                    "kind": "collective", "primitive": e["primitive"],
+                    "path": e["path"], "eqn_index": e["index"],
+                    "axes": e["axes"], "link": link, "bytes": e["wire"],
+                    "start": start, "end": done})
+        else:
+            dur = e["flops"] / peak_flops
+            start = max(t, rt)
+            idle = start - t
+            done = start + dur
+            t = done
+            compute_total += dur
+            if timeline is not None and (e["flops"] > 0 or idle > 0):
+                timeline.append({
+                    "kind": "compute", "primitive": e["primitive"],
+                    "path": e["path"], "eqn_index": e["index"],
+                    "flops": e["flops"], "start": start, "end": done,
+                    "stall": idle})
+        for c in consumers[j]:
+            if done > node_ready[c]:
+                node_ready[c] = done
+            indeg[c] -= 1
+            if indeg[c] == 0:
+                heapq.heappush(heap, (node_ready[c], c))
+    end = max([t] + list(wire_free.values()))
+    stall = max(0.0, end - compute_total)
+    shadowed = max(0.0, coll_total - stall)
+    eff = (None if coll_total <= 0.0
+           else max(0.0, min(1.0, shadowed / coll_total)))
+    if timeline is not None:
+        timeline.sort(key=lambda e: (e["start"], e["eqn_index"]))
+    out = {
+        "compute_time": compute_total,
+        "collective_time": coll_total,
+        "stalled_time": stall,
+        "overlap_efficiency": eff,
+        "n_collectives": n_coll,
+        "n_reshard": n_reshard,
+        "reshard_time": reshard_total,
+        "makespan": end,
+        "peak_flops": peak_flops,
+    }
+    if timeline is not None:
+        out["timeline"] = timeline
+    return out
+
+
 def overlap_summary(jaxpr, mesh, peak_flops=None, while_trips: float = 1.0,
                     include_timeline: bool = False,
                     reshard_sites=None) -> dict:
@@ -310,161 +530,15 @@ def overlap_summary(jaxpr, mesh, peak_flops=None, while_trips: float = 1.0,
     start/end entries sorted by start time (zero-cost bookkeeping nodes
     omitted). Estimates rank schedules — they are a model, not a
     profiler.
+
+    Internally a thin wrapper: :func:`overlap_plan` (jaxpr-dependent
+    classification, staged once) + :func:`replay_overlap` (pricing +
+    scheduling, re-runnable under different constants).
     """
-    import heapq
-    from ..distributed.mesh import axis_links, link_bandwidth, link_latency
-    from .rules import collective_axes
-    if peak_flops is None:
-        from .. import telemetry as _telemetry
-        peak_flops = _telemetry.peak_flops_per_sec()
-    peak_flops = max(float(peak_flops), 1.0)
-    links = axis_links(mesh) if mesh is not None else {}
-    nodes = list(linear_schedule(jaxpr))
-
-    # Classify every node once: (is_collective, duration, link, wire_bytes).
-    plans = []
-    for node in nodes:
-        eqn = node.eqn
-        axes = ()
-        if not node.atomic and mesh is not None \
-                and node.primitive in _COLL_RING:
-            axes = tuple(ax for ax in collective_axes(eqn)
-                         if ax in node.bound_axes and ax in mesh.shape)
-        n_g = _group_size(axes, mesh) if axes else 1
-        if axes and n_g > 1:
-            link = ("dcn" if any(links.get(ax) == "dcn" for ax in axes)
-                    else "ici")
-            wire = collective_wire_bytes(eqn, n_g) * node.trips
-            dur = (wire / link_bandwidth(link)
-                   + link_latency(link) * node.trips)
-            plans.append((True, dur, link, wire, axes))
-        else:
-            f = (_atomic_flops(eqn, while_trips) if node.atomic
-                 else eqn_flops(eqn)) * node.trips
-            plans.append((False, f / peak_flops, None, f, ()))
-
-    # Attach predicted implicit-resharding sites (analysis/sharding) to
-    # the node they fire at: innermost anchor first, falling back to the
-    # enclosing atomic control-flow equation's node.
-    pending = {}
-    if reshard_sites:
-        node_pos = {}
-        for j, node in enumerate(nodes):
-            node_pos.setdefault((node.path, node.index), j)
-        for s in reshard_sites:
-            anchors = list(getattr(s, "anchors", ()) or ())
-            anchors.reverse()
-            anchors.append((getattr(s, "path", ()),
-                            getattr(s, "eqn_index", -1)))
-            for key in anchors:
-                j = node_pos.get(tuple(key))
-                if j is not None:
-                    pending.setdefault(j, []).append(s)
-                    break
-
-    # Dataflow edges over canonical var ids (linear_schedule already
-    # resolved call-boundary aliases).
-    producer = {}
-    for j, node in enumerate(nodes):
-        for o in node.out_ids:
-            producer[o] = j
-    consumers = [[] for _ in nodes]
-    indeg = [0] * len(nodes)
-    for j, node in enumerate(nodes):
-        deps = {producer[i] for i in node.in_ids
-                if i in producer and producer[i] != j}
-        indeg[j] = len(deps)
-        for d in deps:
-            consumers[d].append(j)
-
-    node_ready = [0.0] * len(nodes)
-    heap = [(0.0, j) for j in range(len(nodes)) if indeg[j] == 0]
-    heapq.heapify(heap)
-    wire_free = {}                # link class -> busy-until
-    t = 0.0                       # compute-stream cursor
-    coll_total = compute_total = 0.0
-    n_coll = n_reshard = 0
-    reshard_total = 0.0
-    timeline = [] if include_timeline else None
-    while heap:
-        rt, j = heapq.heappop(heap)
-        node = nodes[j]
-        is_coll, dur, link, amount, axes = plans[j]
-        # implicit resharding this node forces: charged on the wire
-        # stream, and the node itself waits for the result to land
-        for s in pending.get(j, ()):
-            r_dur = float(getattr(s, "time_s", 0.0)) \
-                * max(float(getattr(s, "trips", 1.0)), 1.0)
-            r_link = getattr(s, "link", "ici")
-            r_start = max(rt, wire_free.get(r_link, 0.0))
-            r_done = r_start + r_dur
-            wire_free[r_link] = r_done
-            coll_total += r_dur
-            reshard_total += r_dur
-            n_coll += 1
-            n_reshard += 1
-            rt = max(rt, r_done)
-            if timeline is not None:
-                timeline.append({
-                    "kind": "reshard", "primitive": node.primitive,
-                    "path": "/".join(node.path) or "<top>",
-                    "eqn_index": node.index,
-                    "axes": list(getattr(s, "axes", ())), "link": r_link,
-                    "bytes": float(getattr(s, "wire_bytes", 0.0)),
-                    "start": r_start, "end": r_done,
-                    "reshard_kind": getattr(s, "kind", "")})
-        if is_coll:
-            start = max(rt, wire_free.get(link, 0.0))
-            done = start + dur
-            wire_free[link] = done
-            coll_total += dur
-            n_coll += 1
-            if timeline is not None:
-                timeline.append({
-                    "kind": "collective", "primitive": node.primitive,
-                    "path": "/".join(node.path) or "<top>",
-                    "eqn_index": node.index, "axes": list(axes),
-                    "link": link, "bytes": amount, "start": start,
-                    "end": done})
-        else:
-            start = max(t, rt)
-            idle = start - t
-            done = start + dur
-            t = done
-            compute_total += dur
-            if timeline is not None and (amount > 0 or idle > 0):
-                timeline.append({
-                    "kind": "compute", "primitive": node.primitive,
-                    "path": "/".join(node.path) or "<top>",
-                    "eqn_index": node.index, "flops": amount,
-                    "start": start, "end": done, "stall": idle})
-        for c in consumers[j]:
-            if done > node_ready[c]:
-                node_ready[c] = done
-            indeg[c] -= 1
-            if indeg[c] == 0:
-                heapq.heappush(heap, (node_ready[c], c))
-    end = max([t] + list(wire_free.values()))
-    stall = max(0.0, end - compute_total)
-    shadowed = max(0.0, coll_total - stall)
-    eff = (None if coll_total <= 0.0
-           else max(0.0, min(1.0, shadowed / coll_total)))
-    if timeline is not None:
-        timeline.sort(key=lambda e: (e["start"], e["eqn_index"]))
-    out = {
-        "compute_time": compute_total,
-        "collective_time": coll_total,
-        "stalled_time": stall,
-        "overlap_efficiency": eff,
-        "n_collectives": n_coll,
-        "n_reshard": n_reshard,
-        "reshard_time": reshard_total,
-        "makespan": end,
-        "peak_flops": peak_flops,
-    }
-    if timeline is not None:
-        out["timeline"] = timeline
-    return out
+    return replay_overlap(
+        overlap_plan(jaxpr, mesh, while_trips=while_trips,
+                     reshard_sites=reshard_sites),
+        peak_flops=peak_flops, include_timeline=include_timeline)
 
 
 # -- top-k table -------------------------------------------------------------

@@ -225,6 +225,20 @@ class ParallelTrainer:
                 if hook is not None:
                     hook(self)
 
+    @classmethod
+    def from_plan(cls, plan, model, optimizer, loss_fn: Callable,
+                  mesh=None, **overrides) -> "ParallelTrainer":
+        """Build a trainer from an auto-parallel :class:`~.auto.Plan`.
+
+        The plan's searched knobs (mesh degrees, grad_sync policy /
+        dcn-gating / bucket count, remat, zero_stage, microbatch or
+        accumulate count) become constructor kwargs via ``plan.apply()``;
+        explicit ``overrides`` win over the plan, and an explicit
+        ``mesh`` suppresses building (and installing) the plan's own."""
+        kw = plan.apply(mesh=mesh, build_mesh=mesh is None)
+        kw.update(overrides)
+        return cls(model, optimizer, loss_fn, **kw)
+
     # -- state -------------------------------------------------------------
     def _param_spec(self, name, p):
         return p.pspec if p.pspec is not None else P()

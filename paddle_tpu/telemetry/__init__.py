@@ -192,7 +192,8 @@ collective_schedule_mismatch_total counter programs whose collective-
 calibration_drift_ratio        gauge      measured / predicted per
                                           calibration key {key=step_time|
                                           serving_queue_wait|
-                                          collective_<link>|tuner:<k>}
+                                          collective_<link>|tuner:<k>|
+                                          planner_step_time}
                                           (telemetry.calibration)
 calibration_samples_total      counter    (prediction, measurement)
                                           pairs recorded {key=...}
@@ -200,6 +201,13 @@ calibration_drift_breaches_total counter  latched |log drift| > bound
                                           events per key; each fires one
                                           reason-tagged flight dump
                                           (calibration_drift)
+planner_candidates_total       counter    auto.plan_search candidates per
+                                          processing tier {tier=enumerated|
+                                          pruned_bounds|pruned_memory|
+                                          scored_analytic|scored_staged}
+planner_search_ms              histogram  plan_search wall time
+                                          (enumeration + pruning +
+                                          analytic/staged scoring)
 =============================  =========  =================================
 
 Multi-host merge: ``telemetry.aggregate.gather_registries()`` allgathers

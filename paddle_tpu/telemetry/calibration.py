@@ -26,6 +26,10 @@ key                    prediction vs measurement
                        (bench_collectives --suite exchange|calibrate)
 ``tuner:<kernel>``     tuning-DB ``mean_us`` vs a fresh device timing of
                        the same entry (ops.pallas.tuner.tune)
+``planner_step_time``  ``auto.plan_search`` winner's predicted step time
+                       vs the measured step time of running that chosen
+                       config (tools/bench_plan.py, bench.py planner
+                       block) — closes the loop on the planner itself
 =====================  ====================================================
 
 Every record exports ``calibration_drift_ratio{key}`` (= measured /

@@ -63,6 +63,13 @@ def test_bench_parent_orchestration_all_configs_cpu():
     assert tel["mfu"] > 0
     assert tel["step_time_avg_s"] > 0
     assert tel["wire_bytes"] >= 0  # 0 on the single-device CPU data mesh
+    # the auto-parallel planner ran its pick and closed the drift loop
+    planner = res["extra"]["gpt_base"]["planner"]
+    assert "error" not in planner, f"planner block failed: {planner}"
+    assert planner["measured_s"] > 0
+    assert planner["calibration"]["key"] == "planner_step_time"
+    assert planner["calibration"]["n"] >= 1
+    assert planner["baselines"]["pick_beats_all_dp"] in (True, False)
 
 
 def test_bench_child_failure_is_isolated():
@@ -166,6 +173,36 @@ def test_bench_collectives_calibrate_suite_smoke():
     fitted = res["extra"]["fitted"]
     assert fitted["links"]["ici"]["bandwidth_bps"] > 0
     assert fitted["peak_flops_per_sec"] > 0
+
+
+@pytest.mark.slow
+def test_bench_plan_smoke():
+    """tools/bench_plan.py --smoke: the auto-parallel planner searches
+    the space at 8 simulated chips, its pick strictly beats the all-DP
+    and memory-ordered baselines on calibrated predicted time, the
+    chosen config RUNS, and the predicted/measured pair lands under the
+    planner_step_time calibration key (schema_version 2 contract)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_plan.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=600, env=_env())
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    res = json.loads(lines[-1])
+    assert res["schema_version"] == 2
+    assert res["metric"] == "planner_step_time_ms"
+    assert res["devices"] == 8
+    assert res["value"] > 0 and res["measured_ms"] > 0
+    assert res["baselines"]["pick_beats_all_dp"] is True
+    assert res["baselines"]["pick_beats_memory_pick"] is True
+    # the staged tier re-scored the pick from its real staged step and
+    # refined the memory estimate's provenance
+    assert res["pick"]["predicted"]["tier"] == "staged"
+    assert res["pick"]["memory"]["source"] == "peak-live-bytes/chip"
+    cal = res["calibration"]
+    assert cal["key"] == "planner_step_time"
+    assert cal["predicted"] > 0 and cal["measured"] > 0
+    assert cal["drift"] == pytest.approx(cal["measured"] / cal["predicted"])
 
 
 def test_nightly_report_smoke():
@@ -479,8 +516,8 @@ def test_lint_program_smoke_strict():
         f"lint rc={proc.returncode}\nstdout tail: {proc.stdout[-3000:]}\n"
         f"stderr tail: {proc.stderr[-2000:]}")
     out = json.loads(proc.stdout.strip().splitlines()[-1])
-    programs = {"gpt", "bert", "decode-mixed", "decode-decode",
-                "decode-verify"}
+    programs = {"gpt", "gpt-planner", "bert", "decode-mixed",
+                "decode-decode", "decode-verify"}
     assert programs | {"__families__"} <= set(out)
     for name in programs:
         rep = out[name]

@@ -1,0 +1,227 @@
+"""Auto-parallel planner: plan_search ranking, sep axis, acceptance.
+
+The load-bearing tests for ISSUE 20:
+
+* degree products: every enumerated candidate's degrees multiply to the
+  chip count, sep included (the `auto.plan()` docstring/space drift fix)
+* acceptance: `plan_search()`'s pick strictly beats BOTH the naive
+  all-data-parallel layout and `auto.plan()`'s memory-ordered pick on
+  calibrated predicted step time for the bench-config GPT at 8
+  simulated chips
+* the chosen config passes the dryrun-style equality harness against
+  the all-DP baseline (trajectory match under a lossless-policy search)
+  and is bitwise deterministic run-to-run
+* determinism: two fresh processes produce the identical ranked list
+* the staged tier re-scores from the real staged step and swaps the
+  activation estimate's provenance to peak-live-bytes
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from paddle_tpu.distributed import auto
+
+
+# ---------------------------------------------------------------------------
+# satellite: sep axis + degree-product regression
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [1, 2, 4, 6, 8, 12, 16, 32])
+def test_factorization_degree_products_equal_chip_count(n):
+    cands = auto._factorizations(n)
+    assert cands, f"no factorizations for n={n}"
+    for deg in cands:
+        assert set(deg) == {"data", "sharding", "model", "pipe", "sep"}
+        prod = 1
+        for v in deg.values():
+            prod *= v
+        assert prod == n, f"degrees {deg} multiply to {prod}, not {n}"
+
+
+def test_plan_searches_sep_axis():
+    """plan() now covers the full five-axis ROADMAP space; sep shows up
+    in the returned degrees (1 when not worth engaging) and the product
+    still matches the chip count."""
+    p = auto.plan(1e8, 8, hbm_bytes=16e9)
+    assert "sep" in p.degrees
+    prod = 1
+    for v in p.degrees.values():
+        prod *= v
+    assert prod == 8
+
+
+def test_plan_search_products_and_ranking():
+    plans = auto.plan_search(1e9, 8, layers=24, hidden=2048,
+                             seq_len=2048, hbm_bytes=16e9)
+    assert plans
+    for p in plans:
+        prod = 1
+        for v in p.degrees.values():
+            prod *= v
+        assert prod == 8
+        assert p.predicted is not None and p.predicted.total > 0
+        assert p.rationale  # per-candidate time breakdown is present
+    totals = [p.predicted.total for p in plans]
+    assert totals == sorted(totals)
+
+
+# ---------------------------------------------------------------------------
+# satellite: MemoryEstimate provenance
+# ---------------------------------------------------------------------------
+
+def test_memory_estimate_source_defaults_to_coefficient():
+    est = auto._estimate(1e9, {"data": 8, "sharding": 1, "model": 1,
+                               "pipe": 1},  # legacy no-sep dict works
+                         layers=24, hidden=2048, seq_len=2048,
+                         batch_per_device=8, param_bytes=2,
+                         zero_stage=1, remat=False)
+    assert est.source == "act-coefficient"
+    assert est.total > 0
+
+
+# ---------------------------------------------------------------------------
+# Plan.apply / ParallelTrainer.from_plan plumbing
+# ---------------------------------------------------------------------------
+
+def test_plan_apply_emits_trainer_kwargs():
+    p = auto.Plan(degrees={"data": 4, "sharding": 2, "model": 1,
+                           "pipe": 1, "sep": 1},
+                  per_device=auto.MemoryEstimate(1, 1, 1, 1),
+                  hbm_bytes=16e9, grad_sync="int8",
+                  grad_sync_buckets=2, micro_batches=4, zero_stage=1)
+    kw = p.apply()
+    assert kw["grad_sync"] == "int8"
+    assert kw["grad_sync_buckets"] == 2
+    assert kw["zero_stage"] == 1
+    # no pipe degree: searched microbatches become grad accumulation
+    assert kw["micro_batches"] == 1 and kw["accumulate_steps"] == 4
+    pp = auto.Plan(degrees={"data": 2, "sharding": 1, "model": 1,
+                            "pipe": 2, "sep": 1},
+                   per_device=auto.MemoryEstimate(1, 1, 1, 1),
+                   hbm_bytes=16e9, micro_batches=4)
+    kw = pp.apply()
+    assert kw["micro_batches"] == 4 and kw["accumulate_steps"] == 1
+
+
+# ---------------------------------------------------------------------------
+# acceptance: strict beat of both baselines at 8 simulated chips
+# ---------------------------------------------------------------------------
+
+def test_planner_pick_beats_all_dp_and_memory_pick_at_8_chips():
+    """The ISSUE 20 acceptance criterion, on the analytic calibrated
+    scale all three candidates share: bench-config GPT (the bench.py
+    CPU gpt_base shape), 8 chips."""
+    from tools import bench_plan
+
+    spec = bench_plan._gpt_spec(smoke=False)
+    ranked, baselines, n_params = bench_plan.search(spec, 8)
+    assert n_params > 0
+    assert baselines["pick_beats_all_dp"] is True
+    assert baselines["pick_beats_memory_pick"] is True
+    assert baselines["pick_predicted_s"] < baselines["all_dp_predicted_s"]
+    assert baselines["pick_predicted_s"] < \
+        baselines["memory_pick_predicted_s"]
+
+
+# ---------------------------------------------------------------------------
+# staged tier: exact re-scoring off the real staged step
+# ---------------------------------------------------------------------------
+
+def _tiny_spec():
+    return dict(vocab=64, h=32, layers=1, heads=2, seq=16,
+                batch_per_device=2)
+
+
+def test_staged_tier_rescored_from_real_step():
+    import jax
+
+    from tools import bench_plan
+
+    spec = _tiny_spec()
+    n = len(jax.devices())
+    builder = bench_plan.make_gpt_builder(
+        spec, spec["batch_per_device"] * n)
+    ranked, _b, _p = bench_plan.search(spec, n, stage_top_k=1,
+                                       builder=builder)
+    top = ranked[0]
+    assert top.predicted.tier == "staged"
+    assert top.predicted.total > 0
+    assert top.per_device.source == "peak-live-bytes/chip"
+    assert any("staged: makespan" in r for r in top.rationale)
+    # analytic tail keeps its tier
+    assert any(p.predicted.tier == "analytic" for p in ranked[1:])
+
+
+# ---------------------------------------------------------------------------
+# acceptance: chosen config passes the equality harness vs baseline
+# ---------------------------------------------------------------------------
+
+def _losses(builder, plan, steps=3):
+    trainer, ids, labels = builder(plan)
+    return [float(trainer.train_step(ids, labels)) for _ in range(steps)]
+
+
+def test_chosen_config_matches_baseline_trajectory_and_is_bitwise():
+    """dryrun_multichip-style equality: restrict the search to lossless
+    wire policies (quantized grad exchange changes numerics BY DESIGN),
+    then the planner's chosen config must reproduce the all-DP baseline
+    loss trajectory (the __graft_entry__ harness tolerance) and be
+    bitwise deterministic across two runs of itself."""
+    import jax
+
+    from paddle_tpu.distributed import auto as auto_mod
+    from tools import bench_plan
+
+    spec = _tiny_spec()
+    n = len(jax.devices())
+    global_batch = spec["batch_per_device"] * n
+    builder = bench_plan.make_gpt_builder(spec, global_batch)
+    n_params = bench_plan.count_gpt_params(spec)
+    ranked = auto_mod.plan_search(
+        n_params, n, layers=spec["layers"], hidden=spec["h"],
+        seq_len=spec["seq"], global_batch=global_batch,
+        hbm_bytes=16e9, zero_stage=1, max_pipe=1, max_sep=1,
+        policies=("fp32",), micro_choices=(1,))
+    pick = ranked[0]
+
+    all_dp = auto_mod.Plan(
+        degrees={"data": n, "sharding": 1, "model": 1, "pipe": 1,
+                 "sep": 1},
+        per_device=pick.per_device, hbm_bytes=16e9, zero_stage=1)
+    base = _losses(builder, all_dp)
+    got = _losses(builder, pick)
+    # the __graft_entry__ dryrun harness tolerance (trajectory match)
+    np.testing.assert_allclose(got, base, rtol=1e-3, atol=1e-5)
+    # bitwise determinism of the chosen config itself
+    again = _losses(builder, pick)
+    assert got == again, f"chosen config not bitwise stable: " \
+        f"{got} vs {again}"
+
+
+# ---------------------------------------------------------------------------
+# satellite: determinism across processes
+# ---------------------------------------------------------------------------
+
+def test_ranked_plan_list_identical_across_processes():
+    """Same model spec + chip count + calibration DB in two FRESH
+    processes -> byte-identical ranked plan list (no dict-order or
+    set-iteration nondeterminism anywhere in enumeration/scoring)."""
+    def run():
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "bench_plan.py"),
+             "--smoke", "--plan-only"],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ))
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+        return json.loads(lines[-1])
+    a, b = run(), run()
+    assert a["plans"] == b["plans"]
+    assert a["pick"] == b["pick"]
+    assert a["baselines"] == b["baselines"]

@@ -65,6 +65,23 @@ def _build_gpt(smoke: bool):
     return trainer, ids, labels
 
 
+def _build_gpt_planner(smoke: bool):
+    """The auto-parallel planner's chosen config at the lint device
+    count: ``plan_search`` over the bench GPT spec, winner realized via
+    ``ParallelTrainer.from_plan`` (tools/bench_plan.py's builder). The
+    shipped planner path must stage and lint as clean as the
+    hand-written configs."""
+    import jax
+
+    from bench_plan import _gpt_spec, make_gpt_builder, search
+
+    spec = _gpt_spec(smoke)
+    n = len(jax.devices())
+    builder = make_gpt_builder(spec, spec["batch_per_device"] * n)
+    ranked, _baselines, _n_params = search(spec, n)
+    return builder(ranked[0])
+
+
 def _build_bert(smoke: bool):
     import numpy as np
 
@@ -137,7 +154,8 @@ def _decode_jaxpr(which: str, smoke: bool):
 
 
 # ParallelTrainer programs: staged via trainer.compile(analyze=True).
-BUILDERS = {"gpt": _build_gpt, "bert": _build_bert}
+BUILDERS = {"gpt": _build_gpt, "gpt-planner": _build_gpt_planner,
+            "bert": _build_bert}
 # Inference executor programs: plain ClosedJaxprs, no trainer.
 PROGRAMS = {"decode-mixed": lambda smoke: _decode_jaxpr("mixed", smoke),
             "decode-decode": lambda smoke: _decode_jaxpr("decode", smoke),
